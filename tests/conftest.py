@@ -1,0 +1,26 @@
+"""Test harness: 8 virtual CPU devices (SURVEY.md §4).
+
+The JAX-native analog of a fake backend: mesh/psum/sharding/checkpoint tests
+run hermetically with no TPU. Must run before any JAX backend is initialized;
+the axon site shim imports jax at interpreter start, so we override via
+jax.config (backend creation is lazy) rather than env vars.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_fake_devices():
+    assert jax.device_count() == 8, "tests expect 8 virtual CPU devices"
+    yield
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

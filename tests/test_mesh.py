@@ -1,0 +1,56 @@
+"""Mesh + sharding-rule unit tests (SURVEY.md §4: sharding arithmetic)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tfde_tpu.runtime.mesh import MeshSpec, make_mesh, data_parallel_mesh
+from tfde_tpu.parallel import sharding as shd
+
+
+def test_data_parallel_mesh_spans_all_devices():
+    mesh = data_parallel_mesh()
+    assert mesh.shape == {"data": 8}
+
+
+def test_meshspec_fill():
+    assert MeshSpec({"data": -1, "tensor": 2}).resolve(8) == {"data": 4, "tensor": 2}
+
+
+def test_meshspec_rejects_nondivisible():
+    with pytest.raises(ValueError):
+        MeshSpec({"data": 3}).resolve(8)
+
+
+def test_meshspec_rejects_unknown_axis():
+    with pytest.raises(ValueError):
+        MeshSpec({"bogus": 2})
+
+
+def test_mesh_canonical_axis_order():
+    mesh = make_mesh({"tensor": 2, "data": 4})
+    assert tuple(mesh.axis_names) == ("data", "tensor")  # canonical order
+
+
+def test_batch_spec_dp():
+    mesh = make_mesh({"data": 8})
+    assert shd.batch_spec(mesh) == P("data")
+
+
+def test_batch_spec_dp_fsdp():
+    mesh = make_mesh({"data": 2, "fsdp": 4})
+    assert shd.batch_spec(mesh) == P(("data", "fsdp"))
+
+
+def test_shard_pytree_largest_divisible_dim():
+    mesh = make_mesh({"data": 4, "tensor": 2})
+    tree = {
+        "big": np.zeros((3, 256, 128)),   # 256 divisible by 4 -> dim 1
+        "small": np.zeros((8,)),          # below min_elems -> replicated
+        "odd": np.zeros((333, 777)),      # nothing divisible -> replicated
+    }
+    spec = shd.shard_pytree_spec(tree, mesh, "data", min_elems=1024)
+    assert spec["big"] == P(None, "data", None)
+    assert spec["small"] == P()
+    assert spec["odd"] == P()

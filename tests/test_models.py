@@ -1,0 +1,59 @@
+"""Model forward shape/dtype tests + parameter-count parity with the
+reference architectures (SURVEY.md §4 "unit tests")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfde_tpu.models.cnn import PlainCNN, BatchNormCNN
+
+
+def test_plain_cnn_shapes():
+    m = PlainCNN()
+    x = jnp.zeros((4, 28, 28, 1))
+    vars_ = m.init(jax.random.key(0), x, train=False)
+    logits = m.apply(vars_, x, train=False)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_plain_cnn_param_count_matches_keras():
+    # dwk:32-44: conv 32*(3*3*1)+32=320; dense 64: 13*13*32*64+64=346176+64? ->
+    # after valid conv 26x26, pool 13x13 -> flatten 5408; 5408*64+64=346176;
+    # dense 10: 64*10+10=650. Total 347146.
+    m = PlainCNN()
+    vars_ = m.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)), train=False)
+    n = sum(p.size for p in jax.tree_util.tree_leaves(vars_["params"]))
+    assert n == 347146
+
+
+def test_bn_cnn_shapes_and_batch_stats():
+    m = BatchNormCNN()
+    x = jnp.zeros((4, 784))
+    vars_ = m.init(jax.random.key(0), x, train=False)
+    assert "batch_stats" in vars_
+    logits, mutated = m.apply(
+        vars_, x, train=True, mutable=["batch_stats"],
+        rngs={"dropout": jax.random.key(1)},
+    )
+    assert logits.shape == (4, 10)
+    assert "batch_stats" in mutated
+
+
+def test_bn_cnn_param_count_matches_keras():
+    # mnist_keras:79-109 trainable params:
+    # conv1 3*3*1*6=54, bn beta 6; conv2 6*6*6*12=2592, bn 12;
+    # conv3 6*6*12*24=10368, bn 24; dense 7*7*24*200=235200, bn 200;
+    # dense10 200*10+10=2010. total trainable = 250466.
+    m = BatchNormCNN()
+    vars_ = m.init(jax.random.key(0), jnp.zeros((1, 784)), train=False)
+    n = sum(p.size for p in jax.tree_util.tree_leaves(vars_["params"]))
+    assert n == 250466
+
+
+def test_bn_cnn_accepts_flat_and_image_input():
+    m = BatchNormCNN()
+    vars_ = m.init(jax.random.key(0), jnp.zeros((1, 784)), train=False)
+    a = m.apply(vars_, jnp.ones((2, 784)), train=False)
+    b = m.apply(vars_, jnp.ones((2, 28, 28, 1)), train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

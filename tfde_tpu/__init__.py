@@ -1,0 +1,27 @@
+"""tfde_tpu — a TPU-native distributed-training framework.
+
+A from-scratch JAX/XLA/pjit framework providing the capabilities of the
+reference `lowc1012/tensorflow-distributed-example` (three TF distributed
+training recipes on MNIST: multi-worker collective all-reduce, parameter-server
+training, and mirrored single-host data parallelism), re-designed TPU-first:
+
+- SPMD over a `jax.sharding.Mesh` (ICI within a slice, DCN across slices)
+  instead of NCCL/gRPC collectives.
+- `jit`/`pjit`-compiled train steps; gradient aggregation via XLA collectives
+  (`lax.psum`) inserted by the partitioner, not hand-written rings.
+- Flax modules for the model zoo (reference CNNs plus ResNet-50, ViT-B/16 and
+  BERT-base scale configs).
+- Per-host sharded input pipelines with on-device double-buffered prefetch
+  (the tf.data analog).
+- Estimator-style lifecycle: `train_and_evaluate` with eval throttling,
+  periodic checkpointing (Orbax, auto-resume), TensorBoard summaries, and a
+  serving export artifact (landing per SURVEY.md §7's layer order).
+
+See SURVEY.md at the repo root for the blueprint and reference file:line
+citations throughout the docstrings.
+"""
+
+__version__ = "0.1.0"
+
+from tfde_tpu.runtime.mesh import MeshSpec, make_mesh  # noqa: F401
+from tfde_tpu.runtime.cluster import ClusterInfo, bootstrap  # noqa: F401

@@ -1,0 +1,11 @@
+"""Host data pipeline — the tf.data capability (SURVEY.md §2b row 3), TPU-native.
+
+`Dataset` reproduces the reference pipelines' semantics
+(shuffle/repeat/batch/prefetch/cache/shard — mnist_keras_distributed.py:123-148,
+distributed_with_keras.py:18-30,54-57); `device.device_prefetch` adds the
+on-device double-buffered feed; `datasets` provides MNIST/CIFAR-class sources
+with a deterministic synthetic fallback for hermetic (zero-egress) runs.
+"""
+
+from tfde_tpu.data.pipeline import Dataset, AutoShardPolicy  # noqa: F401
+from tfde_tpu.data.device import device_prefetch  # noqa: F401

@@ -1,0 +1,151 @@
+"""Dataset sources: MNIST / CIFAR-10 class data with hermetic fallback.
+
+The reference pulls MNIST via `tf.keras.datasets.mnist.load_data()`
+(mnist_keras_distributed.py:207-208) or `tfds.load('mnist')`
+(distributed_with_keras.py:25-28). This environment has zero network egress,
+so the loaders here resolve, in order:
+
+1. a local file (``$TFDE_DATA_DIR``, ``~/.keras/datasets``, ``/tmp/data``) in
+   the standard ``mnist.npz`` / cifar pickle layout;
+2. a **deterministic synthetic dataset** with the same shapes/dtypes and a
+   real learnable structure (class-conditional glyph templates + noise +
+   jitter), so integration tests can assert that loss *decreases* (SURVEY.md
+   §4) and benchmarks exercise the identical compute/IO path.
+
+All arrays follow the reference's conventions: images float in [0,1]
+(mnist_keras:211), labels int in a column vector (mnist_keras:215-216).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Tuple
+
+import numpy as np
+
+Arrays = Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]
+
+_SEARCH_DIRS = [
+    lambda: os.environ.get("TFDE_DATA_DIR"),
+    lambda: os.path.expanduser("~/.keras/datasets"),
+    lambda: "/tmp/data",
+]
+
+
+def _find(name: str):
+    for get in _SEARCH_DIRS:
+        d = get()
+        if d and (Path(d) / name).exists():
+            return Path(d) / name
+    return None
+
+
+def _glyph_templates(num_classes: int, side: int, rng: np.random.Generator) -> np.ndarray:
+    """Distinct smooth per-class templates: random low-frequency patterns.
+
+    Built from a few random 2-D cosine modes per class — smooth, well-separated
+    in pixel space, and trivially reproducible from the seed.
+    """
+    yy, xx = np.mgrid[0:side, 0:side] / side
+    t = np.zeros((num_classes, side, side), np.float32)
+    for c in range(num_classes):
+        for _ in range(4):
+            fx, fy = rng.integers(1, 5, size=2)
+            phase = rng.uniform(0, 2 * np.pi, size=2)
+            t[c] += np.cos(2 * np.pi * fx * xx + phase[0]) * np.cos(
+                2 * np.pi * fy * yy + phase[1]
+            )
+        t[c] -= t[c].min()
+        t[c] /= t[c].max() + 1e-8
+    return t
+
+
+def _synthetic_images(
+    n_train: int, n_test: int, side: int, num_classes: int, seed: int, channels: int = 0
+) -> Arrays:
+    rng = np.random.default_rng(seed)
+    templates = _glyph_templates(num_classes, side, rng)
+
+    def make(n, rng):
+        labels = rng.integers(0, num_classes, size=n).astype(np.int64)
+        imgs = templates[labels].copy()
+        # per-example jitter: random shift ±2 px and gaussian noise
+        shifts = rng.integers(-2, 3, size=(n, 2))
+        imgs = np.stack(
+            [np.roll(np.roll(im, s0, 0), s1, 1) for im, (s0, s1) in zip(imgs, shifts)]
+        )
+        imgs += rng.normal(0, 0.25, imgs.shape).astype(np.float32)
+        imgs = np.clip(imgs, 0, 1).astype(np.float32)
+        if channels:
+            imgs = np.repeat(imgs[..., None], channels, axis=-1)
+        return imgs, labels.reshape(-1, 1)
+
+    return make(n_train, rng), make(n_test, rng)
+
+
+def mnist(flatten: bool = True, n_train: int = 60000, n_test: int = 10000) -> Arrays:
+    """MNIST (or its hermetic synthetic stand-in): float [0,1], labels [N,1].
+
+    `flatten=True` returns [N,784] as the Estimator paths consume
+    (serving signature [None,784], mnist_keras:159); else [N,28,28,1]
+    (distributed_with_keras.py models).
+    """
+    path = _find("mnist.npz")
+    if path is not None:
+        with np.load(path) as d:
+            tr_x, tr_y = d["x_train"], d["y_train"]
+            te_x, te_y = d["x_test"], d["y_test"]
+        tr_x = (tr_x / 255.0).astype(np.float32)  # mnist_keras:211
+        te_x = (te_x / 255.0).astype(np.float32)
+        tr_y = np.asarray(tr_y).astype(np.int64).reshape(-1, 1)  # mnist_keras:215
+        te_y = np.asarray(te_y).astype(np.int64).reshape(-1, 1)
+        tr_x = tr_x[..., None]
+        te_x = te_x[..., None]
+        train, test = (tr_x[:n_train], tr_y[:n_train]), (te_x[:n_test], te_y[:n_test])
+    else:
+        train, test = _synthetic_images(n_train, n_test, 28, 10, seed=0, channels=1)
+    if flatten:
+        train = (train[0].reshape(len(train[0]), -1), train[1])
+        test = (test[0].reshape(len(test[0]), -1), test[1])
+    return train, test
+
+
+def cifar10(n_train: int = 50000, n_test: int = 10000) -> Arrays:
+    """CIFAR-10 class data: [N,32,32,3] float [0,1], labels [N,1].
+
+    Scale config `CIFAR-10 ResNet-50` (BASELINE.json configs[2]). Resolves a
+    local ``cifar10.npz`` (keys x_train/y_train/x_test/y_test, uint8 images)
+    from the standard search dirs first; synthetic stand-in otherwise.
+    """
+    path = _find("cifar10.npz")
+    if path is not None:
+        with np.load(path) as d:
+            tr = (
+                (d["x_train"] / 255.0).astype(np.float32)[:n_train],
+                d["y_train"].astype(np.int64).reshape(-1, 1)[:n_train],
+            )
+            te = (
+                (d["x_test"] / 255.0).astype(np.float32)[:n_test],
+                d["y_test"].astype(np.int64).reshape(-1, 1)[:n_test],
+            )
+        return tr, te
+    train, test = _synthetic_images(n_train, n_test, 32, 10, seed=1)
+    tr = np.repeat(train[0][..., None], 3, axis=-1), train[1]
+    te = np.repeat(test[0][..., None], 3, axis=-1), test[1]
+    return tr, te
+
+
+def synthetic_tokens(
+    n: int, seq_len: int, vocab: int = 30522, seed: int = 2
+) -> np.ndarray:
+    """Token id sequences for the BERT-base MLM config (BASELINE.json
+    configs[4]): a Markov-ish stream so MLM has learnable structure."""
+    rng = np.random.default_rng(seed)
+    # transitions concentrated on a per-token successor set => predictable
+    base = rng.integers(0, vocab, size=(n, seq_len), dtype=np.int32)
+    succ = (np.arange(vocab, dtype=np.int32) * 31 + 7) % vocab
+    for t in range(1, seq_len):
+        follow = rng.random((n,)) < 0.7
+        base[follow, t] = succ[base[follow, t - 1]]
+    return base

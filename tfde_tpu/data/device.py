@@ -1,0 +1,85 @@
+"""Host->device feed: sharded device placement with double-buffered prefetch.
+
+The analog of tf.data's device prefetch plus the distribution-strategy input
+splitting (SURVEY.md §2b row 3). Batches come off the host pipeline as numpy;
+we place each as a *global* jax.Array laid out by the mesh's batch sharding
+and keep `buffer_size` batches in flight so the host copy overlaps the
+device step — the overlap that the ≥90 % scaling-efficiency target depends on
+(SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tfde_tpu.data.pipeline import AutoShardPolicy
+
+
+def local_slice_for_process(global_batch: int) -> Tuple[int, slice]:
+    """(per-host batch, this host's slice of a global batch).
+
+    Global-batch accounting per distributed_with_keras.py:13-15: the global
+    batch divides evenly across processes; under OFF each host materializes
+    the full global batch and takes its slice (dwk:54-57), under DATA each
+    host produces only its per-host portion.
+    """
+    n = jax.process_count()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} processes")
+    per = global_batch // n
+    i = jax.process_index()
+    return per, slice(i * per, (i + 1) * per)
+
+
+def _to_global(batch, sharding: NamedSharding, policy: AutoShardPolicy):
+    def place(x):
+        x = np.asarray(x)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        if policy is AutoShardPolicy.OFF:
+            _, sl = local_slice_for_process(x.shape[0])
+            x = x[sl]
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(place, batch)
+
+
+def device_prefetch(
+    batches: Iterable,
+    mesh: Mesh,
+    spec: Optional[P] = None,
+    buffer_size: int = 2,
+    policy: AutoShardPolicy = AutoShardPolicy.DATA,
+) -> Iterator:
+    """Yield global device arrays, keeping `buffer_size` transfers in flight.
+
+    `jax.device_put` is async: enqueueing the next batch's transfer before the
+    consumer blocks on the current step gives copy/compute overlap (the
+    `prefetch(100)` capability of mnist_keras:145 plus `experimental_prefetch_
+    to_device`, without the 100-deep host queue — device HBM holds the window).
+    """
+    if spec is None:
+        from tfde_tpu.parallel.sharding import batch_spec
+
+        spec = batch_spec(mesh)
+    sharding = NamedSharding(mesh, spec)
+
+    buf: collections.deque = collections.deque()
+    it = iter(batches)
+    try:
+        while len(buf) < max(1, buffer_size):
+            buf.append(_to_global(next(it), sharding, policy))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(_to_global(next(it), sharding, policy))
+        except StopIteration:
+            pass
+        yield out

@@ -1,0 +1,4 @@
+"""Model zoo: the reference's two MNIST CNNs; the BASELINE.json scale configs
+(ResNet-50, ViT-B/16, BERT-base) are added per SURVEY.md §7 layer 7."""
+
+from tfde_tpu.models.cnn import PlainCNN, BatchNormCNN  # noqa: F401

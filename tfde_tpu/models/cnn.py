@@ -1,0 +1,95 @@
+"""The reference's two MNIST models as Flax modules.
+
+- `PlainCNN` — distributed_with_keras.py:32-44 (and the dead estimator
+  model_fn, tf2_mnist_distributed.py:66-72): Conv2D(32,3,valid,relu) ->
+  MaxPool(2) -> Flatten -> Dense(64,relu) -> Dense(10 logits).
+- `BatchNormCNN` — mnist_keras_distributed.py:67-120 (duplicate
+  tf2_mnist_distributed.py:93-146): Reshape 784->28x28x1; three
+  Conv(no-bias)->BN(center,no-scale)->ReLU blocks with filters 6/12/24,
+  kernels 3/6/6, strides 1/2/2, padding 'same'; Flatten; Dense(200,
+  no-bias)->BN->ReLU->Dropout(0.5); Dense(10).
+
+Deviation from the reference, on purpose: the Keras BN-CNN ends in
+`softmax` and feeds probabilities to the loss (mnist_keras:108,114). We return
+*logits* and take softmax only at the serving boundary (the export layer) —
+numerically safer and one fused op cheaper; the observable serving signature
+([N,784] -> 10 probabilities, SURVEY.md §3.4) is unchanged.
+
+BatchNorm semantics under data parallelism: under `jit` over a sharded batch
+axis XLA computes *global-batch* statistics (sync-BN). TF MirroredStrategy
+instead normalizes with *per-replica local* statistics (SURVEY.md §7). The
+idiomatic sync-BN is the default here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class PlainCNN(nn.Module):
+    """distributed_with_keras.py:32-44. Input [N,28,28,1] float; returns logits."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        if x.ndim == 2:  # accept flat [N, 784] too
+            x = x.reshape(-1, 28, 28, 1)
+        x = x.astype(self.dtype)
+        # Keras Conv2D default padding is VALID (dwk:34).
+        x = nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(64, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+class BatchNormCNN(nn.Module):
+    """mnist_keras_distributed.py:67-120. Input [N,784] or [N,28,28,1]; logits.
+
+    BN matches Keras `BatchNormalization(scale=False, center=True)`
+    (mnist_keras:86): bias (beta) yes, gamma no, momentum 0.99, eps 1e-3.
+    """
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.5
+    features: Sequence[int] = (6, 12, 24)
+    kernels: Sequence[int] = (3, 6, 6)
+    strides: Sequence[int] = (1, 2, 2)
+
+    def _bn(self, train: bool) -> Callable[[jax.Array], jax.Array]:
+        return nn.BatchNorm(
+            use_running_average=not train,
+            use_scale=False,
+            use_bias=True,
+            momentum=0.99,
+            epsilon=1e-3,
+            dtype=self.dtype,
+        )
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = x.reshape(-1, 28, 28, 1).astype(self.dtype)  # Reshape (mnist_keras:81)
+        for f, k, s in zip(self.features, self.kernels, self.strides):
+            x = nn.Conv(
+                f, (k, k), strides=(s, s), padding="SAME", use_bias=False,
+                dtype=self.dtype,
+            )(x)
+            x = self._bn(train)(x)
+            x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)  # Flatten (mnist_keras:102)
+        x = nn.Dense(200, use_bias=False, dtype=self.dtype)(x)
+        x = self._bn(train)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,8 @@
+"""Numerical ops: losses and metrics (attention and Pallas kernels join as
+the transformer model families land — SURVEY.md §7 layer order)."""
+
+from tfde_tpu.ops.losses import (  # noqa: F401
+    sparse_categorical_crossentropy,
+    softmax_cross_entropy_with_integer_labels,
+)
+from tfde_tpu.ops.metrics import accuracy  # noqa: F401

@@ -1,0 +1,51 @@
+"""Loss functions with the reference's distributed loss-scaling convention.
+
+The reference's canonical pattern (tf2_mnist_distributed.py:81-83) is
+
+    loss = tf.reduce_sum(per_example_ce) * (1. / BATCH_SIZE)
+
+i.e. *sum over examples divided by the global batch size* — so that when the
+batch is split across replicas and gradients are summed (all-reduce), the
+result equals the single-replica gradient of the global-batch mean. Under
+`jit` over a mesh the batch is one logical array, so `jnp.mean` over the batch
+axis is exactly this convention; XLA inserts the `psum` when the batch axis is
+sharded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy_with_integer_labels(
+    logits: jax.Array, labels: jax.Array
+) -> jax.Array:
+    """Per-example CE from logits; accepts the reference's [N,1] int column
+    labels (mnist_keras:215-216). Delegates to optax for the numerics."""
+    labels = labels.reshape(labels.shape[: logits.ndim - 1])
+    return optax.losses.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels.astype(jnp.int32)
+    )
+
+
+def sparse_categorical_crossentropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    from_logits: bool = True,
+    global_batch_size: int | None = None,
+) -> jax.Array:
+    """Scalar loss = sum(per-example CE) / global_batch.
+
+    Matches Keras `sparse_categorical_crossentropy` (mnist_keras:114,
+    dwk:41) combined with the reference's 1/BATCH_SIZE scaling
+    (tf2_mnist:81-83). `from_logits=False` accepts probabilities (the
+    reference BN-CNN ends in softmax, mnist_keras:108); we clip like Keras.
+    """
+    if not from_logits:
+        probs = jnp.clip(logits.astype(jnp.float32), 1e-7, 1.0 - 1e-7)
+        logits = jnp.log(probs)
+    per_example = softmax_cross_entropy_with_integer_labels(logits, labels)
+    denom = global_batch_size if global_batch_size is not None else per_example.size
+    return jnp.sum(per_example) / denom

@@ -1,0 +1,19 @@
+"""Parallelism: sharding rules, strategies, and collectives.
+
+The TPU-native replacement for the reference's `tf.distribute` strategy layer
+(SURVEY.md §2c): every strategy is a set of PartitionSpecs over one device
+mesh, compiled by XLA into ICI/DCN collectives.
+"""
+
+from tfde_tpu.parallel.strategies import (  # noqa: F401
+    Strategy,
+    MirroredStrategy,
+    MultiWorkerMirroredStrategy,
+    ParameterServerStrategy,
+    FSDPStrategy,
+)
+from tfde_tpu.parallel.sharding import (  # noqa: F401
+    shard_pytree_spec,
+    batch_spec,
+    named_sharding,
+)

@@ -1,0 +1,84 @@
+"""Sharding rules: pytree -> PartitionSpec mapping.
+
+Where the reference relies on TF strategies to intercept variable creation and
+place replicas (distributed_with_keras.py:51-58) or shard variables onto ps
+jobs (tf2_mnist_distributed.py:189), the TPU-native design declares *where
+each array lives* as a PartitionSpec over mesh axes and lets the XLA
+partitioner insert the matching collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def batch_spec(mesh: Mesh, extra_dims: int = 0) -> P:
+    """PartitionSpec for a [global_batch, ...] array: batch dim split over all
+    data-like axes present in the mesh (data, then fsdp if present — FSDP
+    shards the batch over both so that weight all-gathers amortize)."""
+    data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1)
+    if not data_axes:
+        return P(*(None,) * (1 + extra_dims))
+    axes = data_axes[0] if len(data_axes) == 1 else data_axes
+    return P(axes, *(None,) * extra_dims)
+
+
+def _largest_divisible_dim(shape: Sequence[int], size: int, min_elems: int) -> Optional[int]:
+    """Pick the largest dim divisible by `size`, if the array is big enough."""
+    total = 1
+    for s in shape:
+        total *= s
+    if total < min_elems:
+        return None
+    best, best_size = None, 0
+    for i, s in enumerate(shape):
+        if s % size == 0 and s > best_size:
+            best, best_size = i, s
+    return best
+
+
+def shard_pytree_spec(
+    tree: Any,
+    mesh: Mesh,
+    axis: str,
+    min_elems: int = 2**14,
+    rule: Optional[Callable[[tuple, Any], Optional[P]]] = None,
+) -> Any:
+    """Generic weight-sharding rule: for each leaf, shard its largest
+    `axis_size`-divisible dimension over `axis`; small leaves stay replicated.
+
+    This is the ZeRO/FSDP workhorse: applied to params for FSDP, or to
+    optimizer state only for ZeRO-1 (the ParameterServerStrategy capability
+    analog — sharded variable hosting, SURVEY.md §2b row 2).
+
+    `rule(path, leaf) -> PartitionSpec | None` overrides per-leaf when given.
+    """
+    size = mesh.shape[axis]
+
+    def leaf_spec(path, leaf):
+        if rule is not None:
+            r = rule(path, leaf)
+            if r is not None:
+                return r
+        shape = getattr(leaf, "shape", ())
+        if size <= 1 or not shape:
+            return P()
+        dim = _largest_divisible_dim(shape, size, min_elems)
+        if dim is None:
+            return P()
+        spec = [None] * len(shape)
+        spec[dim] = axis
+        return P(*spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, tree)
+
+
+def replicated_spec(tree: Any) -> Any:
+    return jax.tree_util.tree_map(lambda _: P(), tree)

@@ -1,0 +1,168 @@
+"""Distribution strategies — the reference's `tf.distribute` surface, TPU-native.
+
+Reference strategy -> TPU-native mapping (SURVEY.md §2b/§2c):
+
+- `MirroredStrategy` (mnist_keras_distributed.py:243): sync DP over the local
+  chips. Mesh = {'data': n_local}; params replicated; batch split over 'data'.
+- `MultiWorkerMirroredStrategy` (distributed_with_keras.py:16): sync DP over
+  all chips of all hosts; identical shardings, the 'data' axis simply spans
+  hosts — XLA routes the gradient `psum` over ICI within a slice and DCN
+  across, replacing the RING/NCCL collective.
+- `ParameterServerStrategy` (tf2_mnist_distributed.py:189,
+  mnist_keras_distributed.py:241-243): async PS has no idiomatic TPU analog.
+  We provide the same *capability* — sharded variable/optimizer-state hosting,
+  role-aware bootstrap, restart tolerance — as **synchronous DP with ZeRO-1
+  optimizer-state sharding** over the data axis. This is a documented semantic
+  change (async -> sync); see SURVEY.md §7 "hard parts".
+- `FSDPStrategy`: scale-up config from BASELINE.json (ViT-B/16 pjit FSDP) —
+  params *and* optimizer state sharded over an 'fsdp' axis, all-gathered just
+  in time by the partitioner.
+
+A Strategy is deliberately thin: it owns (a) the mesh, (b) PartitionSpecs for
+params / optimizer state / batch. The train step itself (training/step.py) is
+strategy-agnostic — XLA's SPMD partitioner turns the same traced computation
+into the right collectives for each sharding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tfde_tpu.parallel import sharding as shd
+from tfde_tpu.runtime import mesh as mesh_lib
+
+
+class Strategy:
+    """Base: replicated params, batch split over data-like mesh axes."""
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        self._mesh = mesh
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self._mesh = self._default_mesh()
+        return self._mesh
+
+    def _default_mesh(self) -> Mesh:
+        return mesh_lib.data_parallel_mesh()
+
+    # -- PartitionSpecs ------------------------------------------------------
+    def params_spec(self, params: Any) -> Any:
+        return shd.replicated_spec(params)
+
+    def opt_state_spec(self, opt_state: Any, params: Any) -> Any:
+        """Optimizer state follows params: any sub-tree of the optimizer state
+        that is *structurally* a params tree (optax mu/nu/trace slots) gets the
+        params' specs; everything else (counts, scalars) replicates."""
+        pspec = self.params_spec(params)
+        ptreedef = jax.tree_util.tree_structure(params)
+
+        def walk(node):
+            if jax.tree_util.tree_structure(node) == ptreedef:
+                return pspec
+            if isinstance(node, tuple):  # includes namedtuples & optax chains
+                mapped = [walk(c) for c in node]
+                return type(node)(*mapped) if hasattr(node, "_fields") else tuple(mapped)
+            if isinstance(node, dict):
+                return {k: walk(v) for k, v in node.items()}
+            if isinstance(node, list):
+                return [walk(c) for c in node]
+            return jax.tree_util.tree_map(lambda _: P(), node)
+
+        return walk(opt_state)
+
+    def batch_spec(self) -> P:
+        return shd.batch_spec(self.mesh)
+
+    # -- Shardings -----------------------------------------------------------
+    def params_sharding(self, params: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            self.params_spec(params),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec())
+
+    @property
+    def num_replicas(self) -> int:
+        return self.mesh.devices.size
+
+    def describe(self) -> str:
+        return f"{type(self).__name__}(mesh={dict(self.mesh.shape)})"
+
+
+class MirroredStrategy(Strategy):
+    """Single-host sync DP over local devices (mnist_keras:243 analog)."""
+
+    def _default_mesh(self) -> Mesh:
+        return mesh_lib.local_mirrored_mesh()
+
+
+class MultiWorkerMirroredStrategy(Strategy):
+    """Sync DP over every chip in the cluster (distributed_with_keras.py:16).
+
+    Construct *after* `runtime.bootstrap()` so jax.devices() spans all hosts —
+    the analog of the reference's rule that the strategy be built before other
+    TF ops (distributed_with_keras.py:1-4,16), but without the ordering trap.
+    """
+
+
+@dataclasses.dataclass
+class _ZeroConfig:
+    min_elems: int = 2**14
+
+
+class ParameterServerStrategy(Strategy):
+    """PS capability, sync semantics: ZeRO-1 sharded optimizer state.
+
+    The reference hosts variables on ps tasks and lets workers fetch/update
+    them over gRPC (tf2_mnist:189; device filters mnist_keras:165-189). Here
+    the 'variable hosting' is the optimizer state sharded over the data axis:
+    each replica owns 1/N of mu/nu/etc., XLA reduce-scatters grads into the
+    owning shard and all-gathers fresh params — same memory-scaling benefit,
+    synchronous math. Params stay replicated (ZeRO-1).
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, min_shard_elems: int = 2**14):
+        super().__init__(mesh)
+        self._zero = _ZeroConfig(min_shard_elems)
+
+    def opt_state_spec(self, opt_state: Any, params: Any) -> Any:
+        return shd.shard_pytree_spec(
+            opt_state, self.mesh, "data", min_elems=self._zero.min_elems
+        )
+
+
+class FSDPStrategy(Strategy):
+    """Fully-sharded DP: params + opt state sharded over 'fsdp' axis.
+
+    BASELINE.json configs[3] ("ImageNet ViT-B/16 (pjit FSDP over ICI mesh)").
+    Batch is split over data×fsdp (see sharding.batch_spec) so the per-step
+    weight all-gather amortizes over a larger local batch.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        data: int = 1,
+        min_shard_elems: int = 2**10,
+    ):
+        self._data = data
+        self._min = min_shard_elems
+        super().__init__(mesh)
+
+    def _default_mesh(self) -> Mesh:
+        return mesh_lib.make_mesh({"data": self._data, "fsdp": -1})
+
+    def params_spec(self, params: Any) -> Any:
+        return shd.shard_pytree_spec(params, self.mesh, "fsdp", min_elems=self._min)
+
+    def opt_state_spec(self, opt_state: Any, params: Any) -> Any:
+        return shd.shard_pytree_spec(opt_state, self.mesh, "fsdp", min_elems=self._min)

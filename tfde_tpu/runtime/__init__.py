@@ -1,0 +1,4 @@
+"""Runtime core: process bootstrap and device-mesh construction."""
+
+from tfde_tpu.runtime.cluster import ClusterInfo, bootstrap  # noqa: F401
+from tfde_tpu.runtime.mesh import MeshSpec, make_mesh, data_parallel_mesh  # noqa: F401

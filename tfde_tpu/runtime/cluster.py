@@ -1,0 +1,194 @@
+"""Cluster bootstrap — the TPU-native analog of the reference's TF_CONFIG path.
+
+The reference synthesizes a ``TF_CONFIG`` env var from ``CLUSTER_SPEC`` /
+``TASK_INDEX`` / ``JOB_NAME`` (mnist_keras_distributed.py:221-233) and relies on
+TensorFlow's gRPC runtime to wire up ps/master/worker roles with per-role device
+filters (mnist_keras_distributed.py:165-189).
+
+On TPU there is no parameter-server data plane: every process is an equal SPMD
+participant and the runtime is `jax.distributed` over DCN, with XLA collectives
+over ICI inside a slice. This module therefore:
+
+- accepts the *same environment contract* as the reference
+  (``CLUSTER_SPEC``/``TASK_INDEX``/``JOB_NAME``, or a pre-built ``TF_CONFIG``),
+  plus the native ``TFDE_COORDINATOR``/``TFDE_NUM_PROCESSES``/``TFDE_PROCESS_ID``
+  variables and JAX's own defaults;
+- maps roles onto SPMD ranks: ``master``/``chief`` -> process 0, ``worker`` i ->
+  process i (+1 when a master exists), ``ps`` entries are *dropped* — their
+  capability (sharded variable hosting) is provided synchronously by ZeRO-style
+  optimizer-state sharding (see parallel/strategies.py, and SURVEY.md §7 "hard
+  parts" for the documented async->sync semantic change);
+- calls ``jax.distributed.initialize`` exactly once when a multi-process
+  cluster is configured.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterInfo:
+    """Resolved identity of this process within the training cluster."""
+
+    num_processes: int
+    process_id: int
+    coordinator_address: Optional[str]
+    job_type: str  # 'chief' | 'worker' | 'local'
+    task_index: int
+
+    @property
+    def is_chief(self) -> bool:
+        """Chief = process 0, the reference's `worker 0` / `master` role.
+
+        The reference gates TensorBoard launch and export on worker 0
+        (mnist_keras_distributed.py:277-280); we gate all host-side side
+        effects (checkpoint writes, event files, export) the same way.
+        """
+        return self.process_id == 0
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def _parse_tf_config() -> Optional[dict]:
+    """Parse TF_CONFIG if present — reference contract at mnist_keras:165-189."""
+    raw = os.environ.get("TF_CONFIG")
+    if not raw:
+        return None
+    try:
+        cfg = json.loads(raw)
+    except json.JSONDecodeError as e:
+        # Fail loudly: silently degrading would fan a configured N-host job
+        # out into N independent single-host jobs.
+        raise ValueError(f"TF_CONFIG is set but is not valid JSON: {e}") from e
+    if "cluster" not in cfg:
+        return None
+    return cfg
+
+
+def _synthesize_tf_config() -> Optional[dict]:
+    """CLUSTER_SPEC/TASK_INDEX/JOB_NAME -> TF_CONFIG dict.
+
+    Mirrors mnist_keras_distributed.py:221-233, including writing the
+    synthesized TF_CONFIG back into the environment, but fixes the reference's
+    ``NameError`` when CLUSTER_SPEC is unset with ``job_type`` used later
+    (mnist_keras:224-225 vs :278) by always returning a well-defined config.
+    """
+    raw = os.environ.get("CLUSTER_SPEC")
+    if not raw:
+        return None
+    try:
+        cluster_spec = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"CLUSTER_SPEC is set but is not valid JSON: {e}") from e
+    job_index = int(os.environ.get("TASK_INDEX", "0"))
+    job_type = os.environ.get("JOB_NAME", "worker")
+    cfg = {"cluster": cluster_spec, "task": {"type": job_type, "index": job_index}}
+    os.environ["TF_CONFIG"] = json.dumps(cfg)
+    log.info("Distribution enabled: %s", os.environ["TF_CONFIG"])
+    return cfg
+
+
+def _rank_from_tf_config(cfg: dict) -> tuple[int, int, str, int, Optional[str]]:
+    """Map a TF_CONFIG cluster onto SPMD ranks.
+
+    ps tasks are dropped (no PS data plane on TPU — see module docstring);
+    chief/master is rank 0; workers follow in index order.
+    Returns (num_processes, process_id, job_type, task_index, coordinator).
+    """
+    cluster = cfg["cluster"]
+    task = cfg.get("task", {"type": "worker", "index": 0})
+    job_type = task.get("type", "worker")
+    task_index = int(task.get("index", 0))
+
+    chief_hosts = cluster.get("chief", []) or cluster.get("master", [])
+    worker_hosts = cluster.get("worker", [])
+    ps_hosts = cluster.get("ps", [])
+    if ps_hosts:
+        log.info(
+            "Cluster spec lists %d ps tasks; TPU build provides their "
+            "capability via sharded optimizer state (sync DP), ps processes "
+            "are not ranked. See SURVEY.md §7.",
+            len(ps_hosts),
+        )
+
+    ranked_hosts = list(chief_hosts) + list(worker_hosts)
+    num_processes = max(len(ranked_hosts), 1)
+
+    if job_type in ("chief", "master"):
+        process_id = 0
+        norm_type = "chief"
+    elif job_type == "worker":
+        process_id = len(chief_hosts) + task_index
+        norm_type = "chief" if (not chief_hosts and task_index == 0) else "worker"
+    elif job_type == "ps":
+        raise RuntimeError(
+            "This process was launched with JOB_NAME=ps. The TPU-native build "
+            "has no parameter-server role: run only chief/worker tasks and the "
+            "optimizer state will be sharded across them (ZeRO-style). "
+            "See SURVEY.md §7."
+        )
+    else:
+        process_id = task_index
+        norm_type = job_type
+
+    # Coordinator = first ranked host, on a port derived from its service port
+    # (the jax.distributed service is a separate listener from any app port).
+    coordinator = ranked_hosts[0] if ranked_hosts else None
+    return num_processes, process_id, norm_type, task_index, coordinator
+
+
+def resolve_cluster() -> ClusterInfo:
+    """Resolve cluster identity from the environment without side effects."""
+    # Native contract takes precedence.
+    if os.environ.get("TFDE_NUM_PROCESSES"):
+        num = int(os.environ["TFDE_NUM_PROCESSES"])
+        pid = int(os.environ.get("TFDE_PROCESS_ID", "0"))
+        coord = os.environ.get("TFDE_COORDINATOR")
+        return ClusterInfo(num, pid, coord, "chief" if pid == 0 else "worker", pid)
+
+    cfg = _parse_tf_config() or _synthesize_tf_config()
+    if cfg is None:
+        log.info("Distribution is not enabled")  # mnist_keras:233
+        return ClusterInfo(1, 0, None, "local", 0)
+
+    num, pid, job_type, task_index, coord = _rank_from_tf_config(cfg)
+    return ClusterInfo(num, pid, coord, job_type, task_index)
+
+
+def bootstrap(coordinator_port: int = 8476) -> ClusterInfo:
+    """Resolve the cluster and initialize `jax.distributed` if multi-process.
+
+    The TPU-native analog of the reference's cluster bootstrap + gRPC session
+    construction (mnist_keras_distributed.py:221-233 + 165-189). Safe to call
+    multiple times; initialization happens once.
+    """
+    global _INITIALIZED
+    info = resolve_cluster()
+    if info.is_distributed and not _INITIALIZED:
+        import jax
+
+        coord = info.coordinator_address
+        if coord and ":" not in coord.rsplit("]")[-1]:
+            coord = f"{coord}:{coordinator_port}"
+        log.info(
+            "jax.distributed.initialize(coordinator=%s, num_processes=%d, process_id=%d)",
+            coord, info.num_processes, info.process_id,
+        )
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=info.num_processes,
+            process_id=info.process_id,
+        )
+        _INITIALIZED = True
+    return info

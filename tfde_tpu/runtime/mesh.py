@@ -1,0 +1,103 @@
+"""Device-mesh construction — the substrate every strategy shards over.
+
+The reference's distribution strategies (MirroredStrategy,
+MultiWorkerMirroredStrategy, ParameterServerStrategy — see SURVEY.md §2c) are
+all expressed here as *axes of one device mesh*: data parallelism is an axis
+named ``data``, ZeRO/FSDP weight sharding is ``fsdp``, tensor parallelism is
+``tensor``, sequence/context parallelism is ``seq``, expert parallelism is
+``expert``, pipeline is ``pipe``. XLA compiles collectives onto ICI links for
+axes inside a slice and onto DCN for axes that span hosts — the replacement for
+the reference's RING/NCCL all-reduce (distributed_with_keras.py:16) and gRPC
+parameter-server runtime (tf2_mnist_distributed.py:189).
+
+Axis ordering convention (outermost -> innermost): DCN-crossing axes first
+(``data`` spans hosts), ICI-local axes last (``tensor``/``seq`` want the
+fastest links). This matches jax.experimental.mesh_utils' hybrid mesh logic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names, outermost-first.
+AXIS_ORDER = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape: axis name -> size; -1 means 'fill remaining'.
+
+    Examples:
+        MeshSpec({"data": -1})                      # pure DP over all devices
+        MeshSpec({"data": -1, "fsdp": 4})           # DP x FSDP
+        MeshSpec({"data": 2, "seq": 2, "tensor": 2})  # DP x SP x TP
+    """
+
+    shape: Mapping[str, int]
+
+    def __post_init__(self):
+        unknown = set(self.shape) - set(AXIS_ORDER)
+        if unknown:
+            raise ValueError(f"Unknown mesh axes {unknown}; valid: {AXIS_ORDER}")
+        fills = [n for n, s in self.shape.items() if s == -1]
+        if len(fills) > 1:
+            raise ValueError(f"At most one axis may be -1, got {fills}")
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        """Concrete axis sizes for n_devices, in canonical order."""
+        sizes = dict(self.shape)
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"mesh shape {dict(sizes)} does not divide {n_devices} devices"
+            )
+        for name, s in sizes.items():
+            if s == -1:
+                sizes[name] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh shape {sizes} (product {math.prod(sizes.values())}) "
+                f"!= device count {n_devices}"
+            )
+        return {a: sizes[a] for a in AXIS_ORDER if a in sizes}
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        return make_mesh(self.shape, devices)
+
+
+def make_mesh(
+    shape: Mapping[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over all (or the given) devices.
+
+    Device order: `jax.devices()` enumerates all processes' devices in process
+    order, so placing host-spanning axes (``data``) outermost keeps each
+    host's local devices contiguous in the innermost (ICI-heavy) axes — the
+    layout that routes `psum` over the `data` axis through DCN-aware
+    hierarchical collectives and `tensor`/`seq` collectives over ICI.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = MeshSpec(shape).resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(tuple(sizes.values()))
+    return Mesh(dev_array, tuple(sizes.keys()))
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Pure data-parallel mesh — the MultiWorkerMirroredStrategy analog."""
+    return make_mesh({"data": -1}, devices)
+
+
+def local_mirrored_mesh() -> Mesh:
+    """Single-host DP mesh over this process's local devices only.
+
+    The MirroredStrategy analog (mnist_keras_distributed.py:243): replicas on
+    the local chips, no cross-host axis.
+    """
+    return make_mesh({"data": -1}, jax.local_devices())

@@ -1,0 +1,5 @@
+"""Training lifecycle: train state, compiled steps, and the Estimator-style
+train-and-evaluate driver."""
+
+from tfde_tpu.training.train_state import TrainState  # noqa: F401
+from tfde_tpu.training.step import make_train_step, make_eval_step, init_state  # noqa: F401

@@ -1,0 +1,149 @@
+"""Compiled train/eval steps — the hot loop (SURVEY.md §3, "HOT LOOP").
+
+One traced computation serves every strategy: the batch arrives sharded over
+the mesh's data axes, params/opt-state carry the strategy's shardings, and the
+XLA SPMD partitioner inserts the gradient `psum` (replacing the reference's
+CollectiveAllReduce, distributed_with_keras.py:16) or reduce-scatter/all-gather
+pairs (ZeRO/FSDP, the ParameterServerStrategy capability). No hand-written
+collectives, per the design rule in SURVEY.md §2b.
+
+Loss convention: mean over the *global* batch == sum x 1/global_batch
+(tf2_mnist_distributed.py:81-83); see ops/losses.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tfde_tpu.ops import losses, metrics as metrics_lib
+from tfde_tpu.parallel.strategies import Strategy
+from tfde_tpu.training.train_state import TrainState
+
+
+def _forward(state: TrainState, params, images, train: bool, dropout_rng=None):
+    variables = {"params": params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    kwargs = {}
+    if dropout_rng is not None:
+        kwargs["rngs"] = {"dropout": dropout_rng}
+    if train and state.batch_stats:
+        logits, mutated = state.apply_fn(
+            variables, images, train=True, mutable=["batch_stats"], **kwargs
+        )
+        return logits, mutated.get("batch_stats", {})
+    logits = state.apply_fn(variables, images, train=train, **kwargs)
+    return logits, state.batch_stats
+
+
+def train_step(
+    state: TrainState, batch: Tuple[jax.Array, jax.Array], rng: jax.Array
+) -> Tuple[TrainState, dict]:
+    """One SGD step. batch = (images, int labels); returns (state, metrics)."""
+    images, labels = batch
+    step_rng = jax.random.fold_in(rng, state.step)
+
+    def loss_fn(params):
+        logits, new_stats = _forward(state, params, images, train=True, dropout_rng=step_rng)
+        loss = losses.sparse_categorical_crossentropy(logits, labels)
+        return loss, (logits, new_stats)
+
+    (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params
+    )
+    new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
+    m = {
+        "loss": loss,
+        "accuracy": metrics_lib.accuracy(logits, labels),
+    }
+    return new_state, m
+
+
+def eval_step(state: TrainState, batch: Tuple[jax.Array, jax.Array]) -> dict:
+    images, labels = batch
+    logits, _ = _forward(state, state.params, images, train=False)
+    return {
+        "loss": losses.sparse_categorical_crossentropy(logits, labels),
+        "accuracy": metrics_lib.accuracy(logits, labels),
+    }
+
+
+def _state_shardings(strategy: Strategy, state: TrainState):
+    mesh = strategy.mesh
+
+    def ns(spec_tree):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), spec_tree,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    return TrainState(
+        step=NamedSharding(mesh, P()),
+        params=ns(strategy.params_spec(state.params)),
+        batch_stats=ns(
+            jax.tree_util.tree_map(lambda _: P(), state.batch_stats)
+        ),
+        opt_state=ns(strategy.opt_state_spec(state.opt_state, state.params)),
+        apply_fn=state.apply_fn,
+        tx=state.tx,
+    )
+
+
+def init_state(
+    model,
+    tx,
+    strategy: Strategy,
+    sample_input: jax.Array,
+    seed: int = 0,
+) -> Tuple[TrainState, Any]:
+    """Initialize a TrainState *directly sharded* per the strategy.
+
+    Init runs under `jit` with `out_shardings` so large FSDP params
+    materialize already-sharded (never a full replica per host). Returns
+    (state, state_shardings).
+    """
+    mesh = strategy.mesh
+
+    def init_fn(rng):
+        variables = model.init(rng, jnp.zeros_like(sample_input), train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+            apply_fn=model.apply,
+            tx=tx,
+        )
+
+    abstract = jax.eval_shape(init_fn, jax.random.key(seed))
+    shardings = _state_shardings(strategy, abstract)
+    state = jax.jit(init_fn, out_shardings=shardings)(jax.random.key(seed))
+    return state, shardings
+
+
+def make_train_step(strategy: Strategy, state: TrainState, donate: bool = True):
+    """Compile train_step with the strategy's shardings pinned."""
+    shardings = _state_shardings(strategy, state)
+    batch_sh = strategy.batch_sharding()
+    return jax.jit(
+        train_step,
+        in_shardings=(shardings, (batch_sh, batch_sh), None),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_eval_step(strategy: Strategy, state: TrainState):
+    shardings = _state_shardings(strategy, state)
+    batch_sh = strategy.batch_sharding()
+    return jax.jit(
+        eval_step,
+        in_shardings=(shardings, (batch_sh, batch_sh)),
+    )

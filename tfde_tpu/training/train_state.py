@@ -1,0 +1,34 @@
+"""Train state: the checkpointable unit {step, params, batch_stats, opt_state}.
+
+The analog of the reference's checkpoint contents (global step + variables +
+optimizer slots saved by SaveV2 every 500 steps, mnist_keras:245-248), as one
+pytree so Orbax can shard-save it and `jit` can donate it whole.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import optax
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: Any
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads, new_batch_stats=None):
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            batch_stats=(
+                new_batch_stats if new_batch_stats is not None else self.batch_stats
+            ),
+            opt_state=new_opt_state,
+        )
