@@ -1,0 +1,62 @@
+"""Serving-export tests: artifact roundtrip + signature (SURVEY.md §3.4)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfde_tpu.export.serving import FinalExporter, export_serving, load_serving
+from tfde_tpu.models.cnn import BatchNormCNN
+
+
+def _trained_vars():
+    m = BatchNormCNN()
+    variables = m.init(jax.random.key(0), jnp.zeros((1, 784)), train=False)
+    return m, variables
+
+
+def test_export_and_load_roundtrip(tmp_path):
+    m, variables = _trained_vars()
+
+    def apply_fn(v, x):
+        return m.apply(v, x, train=False)
+
+    out = export_serving(apply_fn, variables, (None, 784), str(tmp_path / "exp"))
+    assert os.path.exists(os.path.join(out, "model.stablehlo"))
+    assert os.path.exists(os.path.join(out, "params.npz"))
+
+    sig = json.load(open(os.path.join(out, "signature.json")))
+    assert sig["input"]["shape"] == [None, 784]
+    assert sig["output"]["shape"] == [None, 10]
+
+    served = load_serving(out)
+    x = np.random.default_rng(0).random((7, 784), np.float32)
+    probs = served.predict(x)
+    assert probs.shape == (7, 10)
+    np.testing.assert_allclose(probs.sum(-1), np.ones(7), rtol=1e-5)
+
+    # probabilities must match direct apply + softmax (reference signature:
+    # [None,784] float -> 10 probs, mnist_keras:108,159)
+    want = jax.nn.softmax(m.apply(variables, jnp.asarray(x), train=False), axis=-1)
+    np.testing.assert_allclose(probs, np.asarray(want), atol=1e-5)
+
+
+def test_export_serves_any_batch_size(tmp_path):
+    m, variables = _trained_vars()
+    out = export_serving(
+        lambda v, x: m.apply(v, x, train=False), variables, (None, 784), str(tmp_path / "e")
+    )
+    served = load_serving(out)
+    for n in (1, 3, 64):
+        assert served.predict(np.zeros((n, 784), np.float32)).shape == (n, 10)
+
+
+def test_load_resolves_latest_timestamp(tmp_path):
+    m, variables = _trained_vars()
+    exporter = FinalExporter("exporter", (None, 784))
+    base = str(tmp_path)
+    p1 = exporter.export(base, lambda v, x: m.apply(v, x, train=False), variables)
+    served = load_serving(os.path.join(base, "export", "exporter"))
+    assert served.predict(np.zeros((2, 784), np.float32)).shape == (2, 10)

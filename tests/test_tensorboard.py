@@ -1,0 +1,64 @@
+"""TensorBoard event-file writer tests: the wire format must be readable by
+standard TFRecord/proto parsers (we parse it back by hand here; TF, when
+present in the env, is the gold check)."""
+
+import glob
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from tfde_tpu.observability import tensorboard as tb
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vector: 32 bytes of zeros -> 0x8A9136AA
+    assert tb.crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert tb.crc32c(b"123456789") == 0xE3069283
+
+
+def _read_records(path):
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    while off < len(data):
+        (length,) = struct.unpack_from("<Q", data, off)
+        (len_crc,) = struct.unpack_from("<I", data, off + 8)
+        assert len_crc == tb._masked_crc(data[off : off + 8])
+        payload = data[off + 12 : off + 12 + length]
+        (crc,) = struct.unpack_from("<I", data, off + 12 + length)
+        assert crc == tb._masked_crc(payload)
+        out.append(payload)
+        off += 12 + length + 4
+    return out
+
+
+def test_event_file_structure(tmp_path):
+    w = tb.SummaryWriter(str(tmp_path))
+    w.scalars(10, {"loss": 0.5, "accuracy": 0.9})
+    w.scalar(20, "loss", 0.25)
+    w.close()
+
+    files = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    assert len(files) == 1
+    records = _read_records(files[0])
+    assert len(records) == 3  # file_version + 2 events
+    assert b"brain.Event:2" in records[0]
+    assert b"loss" in records[1] and b"accuracy" in records[1]
+    assert b"loss" in records[2]
+
+
+def test_events_parse_with_tensorflow_if_available(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    w = tb.SummaryWriter(str(tmp_path))
+    w.scalars(7, {"loss": 1.25})
+    w.close()
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    got = []
+    for rec in tf.compat.v1.io.tf_record_iterator(path):
+        ev = tf.compat.v1.Event.FromString(rec)
+        for v in ev.summary.value:
+            got.append((ev.step, v.tag, v.simple_value))
+    assert got == [(7, "loss", 1.25)]

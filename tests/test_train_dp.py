@@ -135,11 +135,42 @@ def test_fsdp_strategy_shards_params():
 
 
 def test_eval_step_runs_without_mutating_stats():
+    from tfde_tpu.training.step import pad_batch_for_mesh
+
     strat = MultiWorkerMirroredStrategy()
     batches = _mnist_batches(batch=64, steps=3, flatten=True)
     model = BatchNormCNN()
     state, _ = init_state(model, optax.sgd(0.05), strat, jnp.asarray(batches[0][0]))
     ev = make_eval_step(strat, state)
-    m = ev(state, next(iter(device_prefetch(batches[:1], strat.mesh))))
-    assert set(m) == {"loss", "accuracy"}
+    padded = pad_batch_for_mesh(batches[0], strat.batch_divisor)
+    m = ev(state, next(iter(device_prefetch([padded], strat.mesh))))
+    assert set(m) == {"loss", "accuracy", "weight"}
     assert np.isfinite(float(m["loss"]))
+
+
+def test_eval_masked_padding_exact_metrics():
+    """Padding a ragged batch must not change the metrics: a 50-example batch
+    padded to 56 (divisor 8) counts only the 50 real examples."""
+    from tfde_tpu.training.step import pad_batch_for_mesh
+
+    strat = MultiWorkerMirroredStrategy()
+    (tx, ty), _ = datasets.mnist(flatten=False, n_train=64, n_test=1)
+    model = PlainCNN()
+    state, _ = init_state(model, optax.sgd(0.1), strat, jnp.asarray(tx[:8]))
+    ev = make_eval_step(strat, state)
+
+    ragged = (tx[:50], ty[:50])
+    padded = pad_batch_for_mesh(ragged, strat.batch_divisor)
+    assert padded[0].shape[0] == 56 and float(padded[2].sum()) == 50
+    m = ev(state, next(iter(device_prefetch([padded], strat.mesh))))
+
+    # reference value: same 50 examples with no padding via divisor-1 path
+    single = MultiWorkerMirroredStrategy(
+        mesh=make_mesh({"data": 1}, devices=jax.devices()[:1])
+    )
+    state1, _ = init_state(model, optax.sgd(0.1), single, jnp.asarray(tx[:8]))
+    ev1 = make_eval_step(single, state1)
+    exact = pad_batch_for_mesh(ragged, 1)
+    m1 = ev1(state1, next(iter(device_prefetch([exact], single.mesh))))
+    np.testing.assert_allclose(float(m["loss"]), float(m1["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(float(m["accuracy"]), float(m1["accuracy"]), rtol=1e-6)

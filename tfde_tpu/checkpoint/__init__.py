@@ -1,0 +1,3 @@
+"""Checkpointing: sharded async save + transparent resume (SURVEY.md §5)."""
+
+from tfde_tpu.checkpoint.manager import CheckpointManager  # noqa: F401

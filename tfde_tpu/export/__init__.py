@@ -1,0 +1,3 @@
+"""Serving export — the SavedModel/FinalExporter capability (SURVEY.md §3.4)."""
+
+from tfde_tpu.export.serving import export_serving, load_serving, FinalExporter  # noqa: F401

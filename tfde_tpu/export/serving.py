@@ -1,0 +1,180 @@
+"""Serving export: freeze trained params behind a serving signature.
+
+The reference's export path (SURVEY.md §3.4): at end of training,
+`FinalExporter('exporter', serving_input_fn)` rebuilds an inference graph on a
+`[None, 784]` float placeholder and writes a SavedModel under
+`<working_dir>/export/exporter/<timestamp>/` (mnist_keras:151-162,264).
+
+TPU-native artifact (one directory per export):
+
+    <dir>/<timestamp>/
+      signature.json   input/output spec + framework version
+      params.npz       final params (+ batch_stats), host-gathered
+      model.stablehlo  jax.export serialization of the jitted apply fn,
+                       symbolic batch dim, lowered for cpu+tpu
+
+The StableHLO file is the SavedModel analog — a self-contained compiled
+artifact loadable with no model code. `params.npz` + `signature.json` make the
+artifact inspectable and let a loader with model code rebuild natively.
+
+The serving function applies softmax, preserving the reference's observable
+signature ([N,784] float32 -> [N,10] probabilities) even though our models
+return logits (see models/cnn.py docstring).
+"""
+
+from __future__ import annotations
+
+import datetime
+import io
+import json
+import logging
+import os
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+log = logging.getLogger(__name__)
+
+_FLAT_SEP = "/"
+
+
+def _flatten_params(tree) -> dict:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = _FLAT_SEP.join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        flat[key] = np.asarray(jax.device_get(leaf))
+    return flat
+
+
+def _unflatten_params(flat: dict) -> dict:
+    tree: dict = {}
+    for key, value in flat.items():
+        node = tree
+        parts = key.split(_FLAT_SEP)
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def export_serving(
+    apply_fn: Callable,
+    variables: dict,
+    input_shape: Sequence[Optional[int]],
+    directory: str,
+    input_dtype=jnp.float32,
+    apply_softmax: bool = True,
+    platforms: Tuple[str, ...] = ("cpu", "tpu"),
+) -> str:
+    """Write a serving artifact; returns the timestamped export dir.
+
+    `apply_fn(variables, x)` -> logits; `input_shape` uses None for the
+    symbolic batch dim, e.g. (None, 784) — the reference's serving
+    placeholder shape (mnist_keras:159).
+    """
+    stamp = datetime.datetime.now().strftime("%Y%m%d%H%M%S")
+    out_dir = os.path.join(directory, stamp)
+    os.makedirs(out_dir, exist_ok=True)
+
+    host_vars = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), variables)
+
+    def serve(x):
+        logits = apply_fn(host_vars, x)
+        return jax.nn.softmax(logits, axis=-1) if apply_softmax else logits
+
+    # symbolic batch dim so any batch size serves from one artifact
+    dims = []
+    sym = jax_export.symbolic_shape("b")[0]
+    for d in input_shape:
+        dims.append(sym if d is None else d)
+    arg = jax.ShapeDtypeStruct(tuple(dims), input_dtype)
+
+    exported = jax_export.export(jax.jit(serve), platforms=platforms)(arg)
+    with open(os.path.join(out_dir, "model.stablehlo"), "wb") as f:
+        f.write(exported.serialize())
+
+    buf = io.BytesIO()
+    np.savez(buf, **_flatten_params(host_vars))
+    with open(os.path.join(out_dir, "params.npz"), "wb") as f:
+        f.write(buf.getvalue())
+
+    out_shape = jax.eval_shape(serve, arg)
+    with open(os.path.join(out_dir, "signature.json"), "w") as f:
+        json.dump(
+            {
+                "input": {"shape": list(input_shape), "dtype": str(np.dtype(input_dtype))},
+                "output": {
+                    "shape": [int(d) if isinstance(d, int) else None for d in out_shape.shape],
+                    "dtype": str(out_shape.dtype),
+                },
+                "apply_softmax": apply_softmax,
+                "platforms": list(platforms),
+                "framework": "tfde_tpu",
+            },
+            f,
+            indent=2,
+        )
+    log.info("serving artifact exported -> %s", out_dir)
+    return out_dir
+
+
+class ServingModel:
+    """Loaded artifact; `predict(x)` mirrors the SavedModel signature."""
+
+    def __init__(self, exported, signature: dict, params: dict):
+        self._exported = exported
+        self.signature = signature
+        self.params = params
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._exported.call(np.asarray(x)))
+
+
+def load_serving(export_dir: str) -> ServingModel:
+    """Load a serving artifact from its timestamped directory (or the parent,
+    resolving the newest timestamp — FinalExporter keeps history)."""
+    entries = sorted(
+        d for d in os.listdir(export_dir)
+        if os.path.isdir(os.path.join(export_dir, d)) and d.isdigit()
+    )
+    if entries and not os.path.exists(os.path.join(export_dir, "signature.json")):
+        export_dir = os.path.join(export_dir, entries[-1])
+    with open(os.path.join(export_dir, "signature.json")) as f:
+        signature = json.load(f)
+    with open(os.path.join(export_dir, "model.stablehlo"), "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with np.load(os.path.join(export_dir, "params.npz")) as z:
+        params = _unflatten_params({k: z[k] for k in z.files})
+    return ServingModel(exported, signature, params)
+
+
+class FinalExporter:
+    """End-of-training exporter (mnist_keras:264 analog): writes under
+    `<model_dir>/export/<name>/<timestamp>/`."""
+
+    def __init__(
+        self,
+        name: str,
+        input_shape: Sequence[Optional[int]],
+        input_dtype=jnp.float32,
+        apply_softmax: bool = True,
+    ):
+        self.name = name
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = input_dtype
+        self.apply_softmax = apply_softmax
+
+    def export(self, model_dir: str, apply_fn: Callable, variables: dict) -> str:
+        return export_serving(
+            apply_fn,
+            variables,
+            self.input_shape,
+            os.path.join(model_dir, "export", self.name),
+            input_dtype=self.input_dtype,
+            apply_softmax=self.apply_softmax,
+        )

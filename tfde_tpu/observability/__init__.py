@@ -1,0 +1,4 @@
+"""Observability: TensorBoard event files, steps/sec logging, profiling."""
+
+from tfde_tpu.observability.tensorboard import SummaryWriter  # noqa: F401
+from tfde_tpu.observability.profiler import profile_trace  # noqa: F401

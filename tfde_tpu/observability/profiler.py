@@ -1,0 +1,48 @@
+"""Profiling — first-class restoration of the reference's commented-out
+ProfilerHook (mnist_keras_distributed.py:235-237,261; SURVEY.md §5).
+
+`jax.profiler` traces (XProf format) are viewable in TensorBoard's profile
+plugin or xprof; they capture XLA op timelines, HBM usage, and ICI collective
+time — the TPU-native superset of ProfilerHook's show_memory=True.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def profile_trace(
+    logdir: Optional[str],
+    enabled: Optional[bool] = None,
+) -> Iterator[None]:
+    """Trace the enclosed block when enabled (or $TFDE_PROFILE is set).
+
+    with profile_trace(run_config.model_dir):    # traces steps inside
+        for batch in feed: state, m = step(...)
+    """
+    if enabled is None:
+        enabled = bool(os.environ.get("TFDE_PROFILE"))
+    if not enabled or logdir is None:
+        yield
+        return
+    # start_trace itself appends plugins/profile/<timestamp> — pass the raw
+    # logdir so TensorBoard's profile plugin finds the run.
+    log.info("profiler trace -> %s/plugins/profile", logdir)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)

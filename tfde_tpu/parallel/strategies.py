@@ -94,6 +94,20 @@ class Strategy:
     def num_replicas(self) -> int:
         return self.mesh.devices.size
 
+    @property
+    def batch_divisor(self) -> int:
+        """Global batch sizes must divide by this (the product of mesh axes
+        the batch dim is split over)."""
+        spec = self.batch_spec()
+        first = spec[0] if len(spec) else None
+        if first is None:
+            return 1
+        axes = first if isinstance(first, tuple) else (first,)
+        size = 1
+        for a in axes:
+            size *= self.mesh.shape[a]
+        return size
+
     def describe(self) -> str:
         return f"{type(self).__name__}(mesh={dict(self.mesh.shape)})"
 

@@ -3,3 +3,10 @@ train-and-evaluate driver."""
 
 from tfde_tpu.training.train_state import TrainState  # noqa: F401
 from tfde_tpu.training.step import make_train_step, make_eval_step, init_state  # noqa: F401
+from tfde_tpu.training.lifecycle import (  # noqa: F401
+    Estimator,
+    RunConfig,
+    TrainSpec,
+    EvalSpec,
+    train_and_evaluate,
+)
