@@ -1,0 +1,92 @@
+"""Multi-worker synchronous data-parallel MNIST — the TPU-native equivalent of
+the reference's `distributed_with_keras.py`.
+
+Reference shape (cited per line):
+- module constants: per-worker BATCH_SIZE=64, GLOBAL_BATCH_SIZE=64*NUM_WORKERS
+  (distributed_with_keras.py:12-15) — here num_workers comes from the actual
+  cluster instead of a hardcoded 2;
+- `MultiWorkerMirroredStrategy()` built before training (dwk:16) — here the
+  strategy is just sharding rules over the mesh, so construction order cannot
+  deadlock; the collective all-reduce is XLA `psum` over ICI/DCN, not
+  RING-over-gRPC;
+- dataset scaled to [0,1], cached, shuffled with BUFFER_SIZE=10000
+  (dwk:18-30), batched at the *global* batch size with autoshard OFF
+  (dwk:54-57) — reproduced literally, including the OFF semantics (every host
+  iterates the identical stream and takes its slice of each global batch);
+- plain CNN compiled with SGD lr=0.001 (dwk:32-44);
+- fit(epochs=3, steps_per_epoch=5) demo schedule (dwk:63).
+
+Run single-host: python examples/mnist_multiworker.py
+Multi-host: set CLUSTER_SPEC/TASK_INDEX/JOB_NAME (or TFDE_* vars) per host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax
+import optax
+
+from tfde_tpu import bootstrap
+from tfde_tpu.data import Dataset, datasets
+from tfde_tpu.data.pipeline import AutoShardPolicy
+from tfde_tpu.models.cnn import PlainCNN
+from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
+from tfde_tpu.training import Estimator, RunConfig
+
+BUFFER_SIZE = 10000  # dwk:12
+BATCH_SIZE = 64      # per-worker, dwk:13
+
+
+def make_datasets_unbatched():
+    """tfds.load('mnist') -> scale -> cache -> shuffle (dwk:18-30)."""
+    (train_x, train_y), _ = datasets.mnist(flatten=False)
+
+    def scale(image, label):  # dwk:20-23 (data already in [0,1] when synthetic)
+        return image.astype("float32"), label
+
+    return (
+        Dataset.from_tensor_slices((train_x, train_y))
+        .map(scale)
+        .cache()
+        .shuffle(BUFFER_SIZE, seed=0)
+    )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)            # dwk:63
+    parser.add_argument("--steps-per-epoch", type=int, default=5)   # dwk:63
+    parser.add_argument("--learning-rate", type=float, default=0.001)  # dwk:42
+    parser.add_argument("--model-dir", type=str, default=None)
+    args, _ = parser.parse_known_args(argv)
+
+    info = bootstrap()
+    global_batch = BATCH_SIZE * max(info.num_processes, 1)  # dwk:15
+
+    strategy = MultiWorkerMirroredStrategy()
+    train_ds = make_datasets_unbatched().repeat().batch(
+        global_batch, drop_remainder=True
+    )
+
+    est = Estimator(
+        PlainCNN(),
+        optax.sgd(args.learning_rate),
+        strategy=strategy,
+        config=RunConfig(model_dir=args.model_dir),
+    )
+    state = est.train(
+        lambda: train_ds,
+        max_steps=args.epochs * args.steps_per_epoch,
+        shard_policy=AutoShardPolicy.OFF,  # dwk:55-57
+    )
+    est.close()
+    logging.info("done at step %d", int(jax.device_get(state.step)))
+    return state
+
+
+if __name__ == "__main__":
+    # force=True: jax/absl already installed a root handler at WARNING
+    logging.basicConfig(level=logging.INFO, force=True)
+    main()

@@ -1,0 +1,131 @@
+"""TF2-style Estimator MNIST — the TPU-native equivalent of the reference's
+`tf2_mnist_distributed.py` (SURVEY.md §3.3).
+
+Reference -> here:
+- constants BATCH_SIZE=128, BUFFER_SIZE=10000, LEARNING_RATE=1e-4
+  (tf2_mnist:33-35);
+- `ParameterServerStrategy()` + RunConfig(train_distribute=strategy)
+  (tf2_mnist:189,205) -> ZeRO-1 sync DP (SURVEY.md §7);
+- BN-CNN via create_model with default model_dir '/tmp/mode'
+  (tf2_mnist:208-211) — kept as the default but exposed as --model-dir,
+  fixing the hardcode quirk (SURVEY.md §2a);
+- TrainSpec/EvalSpec + FinalExporter + train_and_evaluate
+  (tf2_mnist:214-241).
+
+The reference also carries a dead hand-written `model_fn`
+(tf2_mnist:65-91) showing the custom-training-loop shape — per-example CE
+summed x 1/BATCH_SIZE into optimizer.minimize. That path is alive here as
+`custom_train_loop()` (--custom-loop): the same plain CNN trained by a raw
+jit-compiled step, which is exactly what Estimator.train compiles anyway.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tfde_tpu import bootstrap
+from tfde_tpu.data import Dataset, datasets, device_prefetch
+from tfde_tpu.export.serving import FinalExporter
+from tfde_tpu.models.cnn import BatchNormCNN, PlainCNN
+from tfde_tpu.parallel.strategies import ParameterServerStrategy
+from tfde_tpu.training import Estimator, EvalSpec, RunConfig, TrainSpec, train_and_evaluate
+from tfde_tpu.training.step import init_state, make_train_step
+
+BATCH_SIZE = 128       # tf2_mnist:33
+BUFFER_SIZE = 10000    # tf2_mnist:34
+LEARNING_RATE = 1e-4   # tf2_mnist:35
+
+
+def input_fn(features, labels, batch_size, mode):
+    """tf2_mnist_distributed.py:38-63 (same pipeline as mnist_keras)."""
+    ds = Dataset.from_tensor_slices((features, labels))
+    if mode == "train":
+        ds = ds.shuffle(len(features), seed=0).repeat().batch(
+            batch_size, drop_remainder=True
+        ).prefetch(4)
+    else:
+        ds = ds.batch(batch_size)
+    return ds
+
+
+def custom_train_loop(steps: int = 100):
+    """The reference's dead model_fn path (tf2_mnist:65-91), alive: raw
+    per-step loop with the canonical sum x 1/BATCH_SIZE loss scaling
+    (tf2_mnist:81-83) — which is what ops/losses.py implements."""
+    strategy = ParameterServerStrategy()
+    (tx, ty), _ = datasets.mnist(flatten=False)
+    ds = (
+        Dataset.from_tensor_slices((tx, ty))
+        .shuffle(len(tx), seed=0)
+        .repeat()
+        .batch(BATCH_SIZE, drop_remainder=True)
+    )
+    state, _ = init_state(
+        PlainCNN(), optax.sgd(LEARNING_RATE), strategy,
+        jnp.zeros((BATCH_SIZE, 28, 28, 1)),
+    )
+    step_fn = make_train_step(strategy, state)
+    rng = jax.random.key(0)
+    it = iter(ds)
+    feed = device_prefetch((next(it) for _ in range(steps)), strategy.mesh)
+    m = None
+    for batch in feed:
+        state, m = step_fn(state, batch, rng)
+    logging.info(
+        "custom loop done: step=%d loss=%.4f",
+        int(jax.device_get(state.step)), float(jax.device_get(m["loss"])),
+    )
+    return state
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model-dir", type=str, default="/tmp/mode")  # tf2_mnist:209
+    parser.add_argument("--max-steps", type=int, default=None)
+    parser.add_argument("--custom-loop", action="store_true")
+    args, _ = parser.parse_known_args(argv)
+
+    logging.getLogger().setLevel(logging.INFO)  # tf2_mnist:187
+    bootstrap()
+
+    if args.custom_loop:
+        return custom_train_loop(args.max_steps or 100)
+
+    strategy = ParameterServerStrategy()  # tf2_mnist:189
+    (train_images, train_labels), (test_images, test_labels) = datasets.mnist(
+        flatten=True
+    )  # tf2_mnist:191-200
+    train_steps = args.max_steps or len(train_images) // BATCH_SIZE  # tf2_mnist:203
+
+    est = Estimator(
+        BatchNormCNN(),
+        optax.sgd(LEARNING_RATE),
+        strategy=strategy,
+        config=RunConfig(model_dir=args.model_dir),  # tf2_mnist:205-211
+    )
+    state, metrics = train_and_evaluate(  # tf2_mnist:214-241
+        est,
+        TrainSpec(
+            lambda: input_fn(train_images, train_labels, BATCH_SIZE, "train"),
+            max_steps=train_steps,
+        ),
+        EvalSpec(
+            lambda: input_fn(test_images, test_labels, BATCH_SIZE, "eval"),
+            steps=None,
+            name="mnist-eval",
+            exporters=[FinalExporter("exporter", (None, 28 * 28))],
+            start_delay_secs=10,
+            throttle_secs=10,
+        ),
+    )
+    est.close()
+    return state, metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -144,8 +144,8 @@ def test_eval_step_runs_without_mutating_stats():
     ev = make_eval_step(strat, state)
     padded = pad_batch_for_mesh(batches[0], strat.batch_divisor)
     m = ev(state, next(iter(device_prefetch([padded], strat.mesh))))
-    assert set(m) == {"loss", "accuracy", "weight"}
-    assert np.isfinite(float(m["loss"]))
+    assert set(m) == {"loss_sum", "correct_sum", "weight"}
+    assert np.isfinite(float(m["loss_sum"]))
 
 
 def test_eval_masked_padding_exact_metrics():
@@ -163,6 +163,7 @@ def test_eval_masked_padding_exact_metrics():
     padded = pad_batch_for_mesh(ragged, strat.batch_divisor)
     assert padded[0].shape[0] == 56 and float(padded[2].sum()) == 50
     m = ev(state, next(iter(device_prefetch([padded], strat.mesh))))
+    assert float(m["weight"]) == 50.0
 
     # reference value: same 50 examples with no padding via divisor-1 path
     single = MultiWorkerMirroredStrategy(
@@ -172,5 +173,7 @@ def test_eval_masked_padding_exact_metrics():
     ev1 = make_eval_step(single, state1)
     exact = pad_batch_for_mesh(ragged, 1)
     m1 = ev1(state1, next(iter(device_prefetch([exact], single.mesh))))
-    np.testing.assert_allclose(float(m["loss"]), float(m1["loss"]), rtol=1e-5)
-    np.testing.assert_allclose(float(m["accuracy"]), float(m1["accuracy"]), rtol=1e-6)
+    np.testing.assert_allclose(float(m["loss_sum"]), float(m1["loss_sum"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(m["correct_sum"]), float(m1["correct_sum"]), rtol=1e-6
+    )

@@ -29,7 +29,7 @@ def profile_trace(
         for batch in feed: state, m = step(...)
     """
     if enabled is None:
-        enabled = bool(os.environ.get("TFDE_PROFILE"))
+        enabled = os.environ.get("TFDE_PROFILE", "") not in ("", "0", "false", "False")
     if not enabled or logdir is None:
         yield
         return

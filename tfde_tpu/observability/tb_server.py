@@ -1,0 +1,47 @@
+"""In-process TensorBoard launcher — start_tensorboard capability
+(mnist_keras_distributed.py:27-28,192-197,277-280).
+
+The reference launches TensorBoard in-process on worker 0, port from
+``$TB_PORT`` (default 6006), pointed at the working dir. Same here, gated on
+the chief process; if the tensorboard package is missing or broken the
+launcher degrades to logging the equivalent CLI command (the event files are
+standard — any TensorBoard can read them, see observability/tensorboard.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def start_tensorboard(logdir: str, port: Optional[int] = None) -> Optional[str]:
+    """Launch TensorBoard for logdir; returns its URL or None if unavailable.
+
+    Call on the chief only (the reference's worker-0 gate, mnist_keras:278 —
+    which it implements with a buggy `is 0` identity check; we compare
+    process_index properly)."""
+    import jax
+
+    if jax.process_index() != 0:
+        return None
+    port = int(os.getenv("TB_PORT", port or 6006))
+    try:
+        import tensorboard.program as tb_program
+
+        tb = tb_program.TensorBoard()
+        tb.configure(logdir=logdir, port=port)
+        url = tb.launch()
+        log.info("TensorBoard started at %s --logdir=%s", url, logdir)
+        return url
+    except Exception as e:  # missing/broken tensorboard install
+        log.info(
+            "in-process TensorBoard unavailable (%s); run externally: "
+            "tensorboard --logdir=%s --port=%d",
+            e,
+            logdir,
+            port,
+        )
+        return None

@@ -1,11 +1,9 @@
 """Metrics — the `metrics=['accuracy']` capability of the reference models
 (mnist_keras_distributed.py:115, distributed_with_keras.py:43,
-tf2_mnist_distributed.py:141), plus streaming accumulation for full-dataset
-eval (EvalSpec steps=None, mnist_keras:271)."""
+tf2_mnist_distributed.py:141). Full-dataset eval aggregates masked sums
+on-device (training/step.py eval_step) — there is no host-side accumulator."""
 
 from __future__ import annotations
-
-import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -15,18 +13,3 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Fraction of correct argmax predictions. labels: int, any trailing 1-dims."""
     labels = labels.reshape(labels.shape[: logits.ndim - 1])
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
-
-
-@dataclasses.dataclass
-class MeanAccumulator:
-    """Host-side streaming weighted mean, for multi-batch eval aggregation."""
-
-    total: float = 0.0
-    weight: float = 0.0
-
-    def update(self, value, weight: float = 1.0) -> None:
-        self.total += float(value) * weight
-        self.weight += weight
-
-    def result(self) -> float:
-        return self.total / self.weight if self.weight else float("nan")

@@ -34,7 +34,6 @@ from tfde_tpu.checkpoint.manager import CheckpointManager
 from tfde_tpu.data.device import device_prefetch
 from tfde_tpu.data.pipeline import AutoShardPolicy
 from tfde_tpu.observability.tensorboard import SummaryWriter
-from tfde_tpu.ops.metrics import MeanAccumulator
 from tfde_tpu.parallel.strategies import Strategy, MultiWorkerMirroredStrategy
 from tfde_tpu.training.step import (
     init_state,
@@ -99,6 +98,7 @@ class Estimator:
         self._ckpt: Optional[CheckpointManager] = None
         self._train_step = None
         self._eval_step = None
+        self._predict_fn = None
         self._writers: dict[str, SummaryWriter] = {}
 
     # -- internals -----------------------------------------------------------
@@ -197,6 +197,10 @@ class Estimator:
             if step >= max_steps:
                 break
             state, last_metrics = self._train_step(state, batch, rng)
+            # keep the live reference fresh: the previous state's buffers were
+            # donated to the step, so a stale self._state would reference
+            # deleted arrays if train() is interrupted mid-run
+            self._state = state
             step += 1
             if writer is not None and step % cfg.save_summary_steps == 0:
                 vals = {k: float(jax.device_get(v)) for k, v in last_metrics.items()}
@@ -233,7 +237,7 @@ class Estimator:
         state = self._state_for_inference(input_fn, "evaluate()")
         if self._eval_step is None:
             self._eval_step = make_eval_step(self.strategy, state)
-        accs = {"loss": MeanAccumulator(), "accuracy": MeanAccumulator()}
+        totals = None
         n = 0
         divisor = self.strategy.batch_divisor
         padded = (pad_batch_for_mesh(b, divisor) for b in input_fn())
@@ -242,11 +246,17 @@ class Estimator:
             if steps is not None and n >= steps:
                 break
             m = self._eval_step(state, batch)
-            weight = float(jax.device_get(m["weight"]))
-            for k in accs:
-                accs[k].update(jax.device_get(m[k]), weight)
+            # accumulate on device; a single host fetch happens after the loop
+            totals = m if totals is None else jax.tree_util.tree_map(jnp.add, totals, m)
             n += 1
-        results = {k: a.result() for k, a in accs.items()}
+        if totals is None:
+            return {"loss": float("nan"), "accuracy": float("nan")}
+        totals = jax.device_get(totals)
+        weight = max(float(totals["weight"]), 1.0)
+        results = {
+            "loss": float(totals["loss_sum"]) / weight,
+            "accuracy": float(totals["correct_sum"]) / weight,
+        }
         step = int(jax.device_get(state.step))
         w = self._writer(name)
         if w is not None:
@@ -264,13 +274,18 @@ class Estimator:
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
 
-        @jax.jit
-        def infer(x):
-            return jax.nn.softmax(state.apply_fn(variables, x, train=False), axis=-1)
+        if self._predict_fn is None:
+            apply_fn = state.apply_fn
+
+            @jax.jit
+            def infer(variables, x):
+                return jax.nn.softmax(apply_fn(variables, x, train=False), axis=-1)
+
+            self._predict_fn = infer  # compiled once; variables passed per call
 
         for batch in input_fn():
             x = batch[0] if isinstance(batch, tuple) else batch
-            yield np.asarray(jax.device_get(infer(jnp.asarray(x))))
+            yield np.asarray(jax.device_get(self._predict_fn(variables, jnp.asarray(x))))
 
     # -- export --------------------------------------------------------------
     def export_saved_model(self, exporter) -> Optional[str]:

@@ -73,13 +73,15 @@ def eval_step(
     be padded up to the mesh's batch divisor while keeping exact metrics."""
     images, labels, mask = batch
     logits, _ = _forward(state, state.params, images, train=False)
-    labels2d = labels.reshape(labels.shape[:1])
+    labels1d = labels.reshape(labels.shape[:1])
     per_ex = losses.softmax_cross_entropy_with_integer_labels(logits, labels)
-    denom = jnp.maximum(jnp.sum(mask), 1.0)
-    correct = (jnp.argmax(logits, axis=-1) == labels2d).astype(jnp.float32)
+    correct = (jnp.argmax(logits, axis=-1) == labels1d).astype(jnp.float32)
+    # Sums, not means: the caller accumulates *on device* and fetches once at
+    # the end of the pass — per-step host syncs would serialize eval on
+    # high-latency links (each device_get is a full round trip).
     return {
-        "loss": jnp.sum(per_ex * mask) / denom,
-        "accuracy": jnp.sum(correct * mask) / denom,
+        "loss_sum": jnp.sum(per_ex * mask),
+        "correct_sum": jnp.sum(correct * mask),
         "weight": jnp.sum(mask),
     }
 
