@@ -1,0 +1,76 @@
+"""Headline benchmark: prints ONE JSON line for the driver.
+
+Config benchmarked: the reference's richest training path — the BN-CNN of
+mnist_keras_distributed.py:67-120 at its train batch size 128
+(tf2_mnist_distributed.py:33), SGD, sparse-CE loss — as a fully jitted
+data-parallel train step over all available chips (one step == one global
+batch of 128 images, the observable unit of the reference's hot loop,
+SURVEY.md §3.1).
+
+Metric: images/sec/chip (BASELINE.json "metric"). The reference publishes no
+numbers (BASELINE.md: "published": {}), so `vs_baseline` is measured against
+REFERENCE_ESTIMATE below — a documented estimate of the reference TF stack's
+single-GPU throughput for this model/batch (TF1-era Keras MNIST CNN at bs=128
+on the K80/P100-class hardware the scripts target: ~10k images/s).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_ESTIMATE = 10_000.0  # images/sec, see module docstring
+GLOBAL_BATCH = 128             # tf2_mnist_distributed.py:33
+WARMUP_STEPS = 20
+TIMED_STEPS = 400
+
+
+def main() -> None:
+    import jax
+    import optax
+
+    from tfde_tpu.models.cnn import BatchNormCNN
+    from tfde_tpu.parallel.strategies import MirroredStrategy
+    from tfde_tpu.training.step import init_state, make_train_step
+
+    strategy = MirroredStrategy()
+    n_chips = strategy.num_replicas
+
+    model = BatchNormCNN()
+    tx = optax.sgd(0.01)
+    sample = np.zeros((GLOBAL_BATCH, 784), np.float32)
+    state, _ = init_state(model, tx, strategy, sample, seed=0)
+    step_fn = make_train_step(strategy, state, donate=True)
+
+    rng = np.random.default_rng(0)
+    images = rng.random((GLOBAL_BATCH, 784), np.float32)
+    labels = rng.integers(0, 10, (GLOBAL_BATCH, 1)).astype(np.int32)
+    batch_sh = strategy.batch_sharding()
+    images = jax.device_put(images, batch_sh)
+    labels = jax.device_put(labels, batch_sh)
+    key = jax.random.key(0)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step_fn(state, (images, labels), key)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, metrics = step_fn(state, (images, labels), key)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = TIMED_STEPS * GLOBAL_BATCH / dt
+    per_chip = images_per_sec / n_chips
+    print(json.dumps({
+        "metric": "mnist_bncnn_train_images_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_ESTIMATE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

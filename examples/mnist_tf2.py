@@ -94,13 +94,15 @@ def main(argv=None):
     bootstrap()
 
     if args.custom_loop:
-        return custom_train_loop(args.max_steps or 100)
+        return custom_train_loop(100 if args.max_steps is None else args.max_steps)
 
     strategy = ParameterServerStrategy()  # tf2_mnist:189
     (train_images, train_labels), (test_images, test_labels) = datasets.mnist(
         flatten=True
     )  # tf2_mnist:191-200
-    train_steps = args.max_steps or len(train_images) // BATCH_SIZE  # tf2_mnist:203
+    train_steps = (  # tf2_mnist:203
+        len(train_images) // BATCH_SIZE if args.max_steps is None else args.max_steps
+    )
 
     est = Estimator(
         BatchNormCNN(),

@@ -27,7 +27,11 @@ def start_tensorboard(logdir: str, port: Optional[int] = None) -> Optional[str]:
 
     if jax.process_index() != 0:
         return None
-    port = int(os.getenv("TB_PORT", port or 6006))
+    if port is None:  # explicit argument wins over the env var
+        try:
+            port = int(os.environ["TB_PORT"])
+        except (KeyError, ValueError):
+            port = 6006
     try:
         import tensorboard.program as tb_program
 

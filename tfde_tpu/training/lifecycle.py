@@ -213,7 +213,6 @@ class Estimator:
                 log.info("step %d: %.2f steps/sec", step, sps)
                 t_window = time.time()
             if mngr is not None and step % cfg.save_checkpoints_steps == 0:
-                self._state = state
                 mngr.save(state)
             if _eval_hook is not None:
                 _eval_hook(state, step)
@@ -337,7 +336,6 @@ def train_and_evaluate(
         if now - last_eval["t"] < eval_spec.throttle_secs:
             return
         last_eval["t"] = now
-        estimator._state = state
         estimator.evaluate(eval_spec.input_fn, eval_spec.steps, eval_spec.name)
 
     state = estimator.train(
