@@ -1,0 +1,107 @@
+"""CIFAR-10 ResNet-50, multi-worker sync data-parallel — the scale-up of the
+reference's `distributed_with_keras.py` recipe (BASELINE.json configs[2]:
+"CIFAR-10 ResNet-50 (distributed_with_keras.py scaled to v4-32)").
+
+Same shape as examples/mnist_multiworker.py — strategy over the full mesh,
+global batch = per-worker batch x processes (distributed_with_keras.py:13-15),
+autoshard OFF semantics (dwk:54-57) — with the scale-config training recipe:
+SGD momentum 0.9, cosine LR decay with linear warmup, standard random-crop +
+horizontal-flip augmentation done on host.
+
+Run single-host: python examples/cifar10_resnet.py --max-steps 200
+CPU smoke:       python examples/cifar10_resnet.py --fake-devices 8 --max-steps 2 --batch-size 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax
+import numpy as np
+import optax
+
+from tfde_tpu import bootstrap
+from tfde_tpu.data import Dataset, datasets
+from tfde_tpu.data.pipeline import AutoShardPolicy
+from tfde_tpu.models.resnet import resnet50_cifar
+from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
+from tfde_tpu.training import Estimator, RunConfig
+
+
+def augment(rng: np.random.Generator, images: np.ndarray) -> np.ndarray:
+    """Pad-4 random crop + horizontal flip (host-side, vectorized per batch)."""
+    n, h, w, _ = images.shape
+    padded = np.pad(images, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+    ys = rng.integers(0, 9, n)[:, None, None]
+    xs = rng.integers(0, 9, n)[:, None, None]
+    rows = ys + np.arange(h)[None, :, None]
+    cols = xs + np.arange(w)[None, None, :]
+    out = padded[np.arange(n)[:, None, None], rows, cols]
+    flip = rng.random(n) < 0.5
+    return np.where(flip[:, None, None, None], out[:, :, ::-1], out)
+
+
+def make_train_dataset(global_batch: int, seed: int = 0) -> Dataset:
+    (train_x, train_y), _ = datasets.cifar10()
+    rng = np.random.default_rng(seed)
+
+    def aug(images, labels):
+        return augment(rng, images), labels
+
+    return (
+        Dataset.from_tensor_slices((train_x, train_y))
+        .shuffle(len(train_x), seed=seed)
+        .repeat()
+        .batch(global_batch, drop_remainder=True)
+        .map(aug)
+    )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=128, help="per worker")
+    parser.add_argument("--max-steps", type=int, default=1000)
+    parser.add_argument("--learning-rate", type=float, default=0.4,
+                        help="peak LR at global batch 1024; scaled linearly")
+    parser.add_argument("--warmup-steps", type=int, default=100)
+    parser.add_argument("--model-dir", type=str, default=None)
+    parser.add_argument("--fake-devices", type=int, default=None)
+    args, _ = parser.parse_known_args(argv)
+
+    if args.fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+
+    info = bootstrap()
+    global_batch = args.batch_size * max(info.num_processes, 1)
+
+    peak_lr = args.learning_rate * global_batch / 1024.0
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=peak_lr,
+        warmup_steps=min(args.warmup_steps, max(args.max_steps - 1, 1)),
+        decay_steps=args.max_steps,
+    )
+    tx = optax.sgd(schedule, momentum=0.9, nesterov=True)
+
+    strategy = MultiWorkerMirroredStrategy()
+    est = Estimator(
+        resnet50_cifar(),
+        tx,
+        strategy=strategy,
+        config=RunConfig(model_dir=args.model_dir),
+    )
+    state = est.train(
+        lambda: make_train_dataset(global_batch),
+        max_steps=args.max_steps,
+        shard_policy=AutoShardPolicy.OFF,
+    )
+    est.close()
+    logging.info("done at step %d", int(jax.device_get(state.step)))
+    return state
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO, force=True)
+    main()

@@ -56,3 +56,12 @@ def test_tf2_example_estimator_path(tmp_path):
     )
     assert int(jax.device_get(state.step)) == 4
     assert np.isfinite(metrics["loss"])
+
+
+def test_cifar_resnet_example_smoke():
+    from examples import cifar10_resnet
+
+    state = cifar10_resnet.main(
+        ["--max-steps", "2", "--batch-size", "8"]  # 8 fake devices -> divisible
+    )
+    assert int(jax.device_get(state.step)) == 2

@@ -2,3 +2,10 @@
 (ResNet-50, ViT-B/16, BERT-base) are added per SURVEY.md §7 layer 7."""
 
 from tfde_tpu.models.cnn import PlainCNN, BatchNormCNN  # noqa: F401
+from tfde_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet50,
+    ResNet101,
+    resnet50_cifar,
+)
