@@ -1,0 +1,135 @@
+"""Transformer-core tests: attention numerics, masking, Megatron-compatible
+weight shapes, remat equivalence, activation constraints (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tfde_tpu.models.transformer import Encoder, MultiHeadAttention
+from tfde_tpu.ops.attention import attention, padding_mask, reference_attention
+from tfde_tpu.parallel import axes as axes_lib
+from tfde_tpu.runtime.mesh import make_mesh
+
+
+def _qkv(rng, b=2, s=6, h=2, d=4):
+    return (
+        rng.random((b, s, h, d), np.float32),
+        rng.random((b, s, h, d), np.float32),
+        rng.random((b, s, h, d), np.float32),
+    )
+
+
+def test_reference_attention_matches_manual(rng):
+    q, k, v = _qkv(rng)
+    out = reference_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    # manual per-head softmax
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    expect = np.einsum("bhqk,bkhd->bqhd", w, v)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_causal_masking_blocks_future(rng):
+    q, k, v = _qkv(rng)
+    out = reference_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True
+    )
+    # perturbing future keys/values must not change earlier outputs
+    k2, v2 = k.copy(), v.copy()
+    k2[:, -1] += 100.0
+    v2[:, -1] += 100.0
+    out2 = reference_attention(
+        jnp.asarray(q), jnp.asarray(k2), jnp.asarray(v2), causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out)[:, :-1], np.asarray(out2)[:, :-1], rtol=1e-5, atol=1e-5
+    )
+
+
+def test_padding_mask_excludes_padded_keys(rng):
+    q, k, v = _qkv(rng)
+    valid = np.ones((2, 6), np.float32)
+    valid[:, 4:] = 0.0
+    out = reference_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        mask=padding_mask(jnp.asarray(valid)),
+    )
+    k2, v2 = k.copy(), v.copy()
+    k2[:, 4:] += 50.0
+    v2[:, 4:] += 50.0
+    out2 = reference_attention(
+        jnp.asarray(q), jnp.asarray(k2), jnp.asarray(v2),
+        mask=padding_mask(jnp.asarray(valid)),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-5, atol=1e-5)
+
+
+def test_mha_megatron_weight_shapes(rng):
+    m = MultiHeadAttention(num_heads=4, head_dim=8, dtype=jnp.float32)
+    x = jnp.asarray(rng.random((2, 5, 32), np.float32))
+    v = m.init(jax.random.key(0), x)
+    # qkv kernels: [embed, heads, head_dim] — heads trailing => column-shard
+    assert v["params"]["query"]["kernel"].shape == (32, 4, 8)
+    # out kernel: [heads, head_dim, embed] — sharded dims leading => row-shard
+    assert v["params"]["out"]["kernel"].shape == (4, 8, 32)
+    y = m.apply(v, x)
+    assert y.shape == x.shape
+
+
+def test_encoder_remat_matches_plain(rng):
+    x = jnp.asarray(rng.random((2, 5, 16), np.float32))
+    kw = dict(depth=2, num_heads=2, head_dim=8, mlp_dim=32, dtype=jnp.float32)
+    plain = Encoder(**kw, remat=False)
+    v = plain.init(jax.random.key(0), x)
+    y0 = plain.apply(v, x)
+    y1 = Encoder(**kw, remat=True).apply(v, x)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), rtol=1e-6, atol=1e-6)
+
+    # gradients agree too (remat only changes the schedule, not the math)
+    def loss(mod, v):
+        return jnp.sum(mod.apply(v, x) ** 2)
+
+    g0 = jax.grad(lambda v: loss(plain, v))(v)
+    g1 = jax.grad(lambda v: loss(Encoder(**kw, remat=True), v))(v)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5), g0, g1
+    )
+
+
+def test_constrain_is_identity_without_mesh(rng):
+    x = jnp.asarray(rng.random((4, 6), np.float32))
+    assert axes_lib.constrain(x, "data", "tensor") is x
+
+
+def test_constrain_applies_sharding_in_jit(rng):
+    mesh = make_mesh({"data": 2, "tensor": 4})
+    x = jnp.asarray(rng.random((4, 8), np.float32))
+
+    @jax.jit
+    def f(x):
+        with axes_lib.use_axes(mesh):
+            return axes_lib.constrain(x, "data", "tensor") * 2.0
+
+    y = f(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 2.0, rtol=1e-6)
+    assert y.sharding.is_equivalent_to(
+        jax.sharding.NamedSharding(mesh, P("data", "tensor")), y.ndim
+    )
+
+
+def test_constrain_drops_absent_axes(rng):
+    mesh = make_mesh({"data": 8})
+    with axes_lib.use_axes(mesh):
+        spec = axes_lib._filter_spec(mesh, ("data", "seq", ("data", "tensor")))
+    assert spec == P("data", None, "data")
+
+
+def test_attention_dispatcher_reference_path(rng):
+    q, k, v = (jnp.asarray(t) for t in _qkv(rng))
+    out = attention(q, k, v, impl="auto")  # CPU, no seq mesh -> reference
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(reference_attention(q, k, v)), rtol=1e-6
+    )

@@ -1,0 +1,79 @@
+"""ViT scale-config tests: canonical parameter parity, forward shapes, FSDP
+sharded training, example smoke (SURVEY.md §4; BASELINE.json configs[3])."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tfde_tpu.models.vit import ViT_B16, vit_tiny_test
+from tfde_tpu.parallel.strategies import FSDPStrategy
+from tfde_tpu.training.step import init_state, make_train_step
+
+
+def test_vit_b16_param_count():
+    # Canonical ViT-B/16 with 1000-class head: 86,567,656 params
+    # (86.6M, Dosovitskiy et al. Table 1).
+    m = ViT_B16(num_classes=1000)
+    v = jax.eval_shape(m.init, jax.random.key(0), jnp.zeros((1, 224, 224, 3)))
+    n = sum(p.size for p in jax.tree_util.tree_leaves(v["params"]))
+    assert n == 86_567_656
+
+
+def test_vit_tiny_forward(rng):
+    m = vit_tiny_test()
+    x = jnp.asarray(rng.random((3, 32, 32, 3), np.float32))
+    v = m.init(jax.random.key(0), x, train=False)
+    logits = m.apply(v, x, train=False)
+    assert logits.shape == (3, 10)
+    assert logits.dtype == jnp.float32
+    assert "batch_stats" not in v  # no BN anywhere in the transformer path
+
+
+def test_vit_gap_pool_matches_seq_len(rng):
+    m = vit_tiny_test(pool="gap")
+    x = jnp.asarray(rng.random((2, 32, 32, 3), np.float32))
+    v = m.init(jax.random.key(0), x, train=False)
+    # gap variant has no cls token parameter
+    assert "cls_token" not in v["params"]
+    assert v["params"]["pos_embed"].shape == (1, 64, 32)  # (32/4)^2 patches
+
+
+def test_vit_fsdp_train_loss_decreases(rng):
+    strategy = FSDPStrategy(data=2, min_shard_elems=1)
+    m = vit_tiny_test()
+    sample = np.zeros((16, 32, 32, 3), np.float32)
+    state, _ = init_state(m, optax.adamw(1e-3), strategy, sample)
+    step = make_train_step(strategy, state, donate=False)
+    images = rng.random((16, 32, 32, 3), np.float32)
+    labels = rng.integers(0, 10, (16, 1)).astype(np.int32)
+    key = jax.random.key(0)
+    first = None
+    for _ in range(5):
+        state, metrics = step(state, (images, labels), key)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+
+
+def test_vit_fsdp_params_actually_sharded():
+    strategy = FSDPStrategy(data=1, min_shard_elems=1)
+    m = vit_tiny_test()
+    state, _ = init_state(m, optax.sgd(0.1), strategy, np.zeros((8, 32, 32, 3), np.float32))
+    fc1 = state.params["encoder"]["block_0"]["mlp"]["fc1"]["kernel"]
+    specs = {s for s in fc1.sharding.spec}
+    assert "fsdp" in specs, f"fc1 kernel should shard over fsdp, got {fc1.sharding.spec}"
+
+
+def test_imagenet_vit_example_smoke():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from examples import imagenet_vit
+
+    state = imagenet_vit.main(
+        ["--tiny", "--image-size", "32", "--max-steps", "2",
+         "--batch-size", "16", "--data", "2", "--train-examples", "64"]
+    )
+    assert int(jax.device_get(state.step)) == 2

@@ -111,6 +111,21 @@ def mnist(flatten: bool = True, n_train: int = 60000, n_test: int = 10000) -> Ar
     return train, test
 
 
+def _load_npz(path, n_train: int, n_test: int) -> Arrays:
+    """Standard npz layout (x_train/y_train/x_test/y_test, uint8 images) ->
+    float [0,1] images, int64 [N,1] labels."""
+    with np.load(path) as d:
+        tr = (
+            (d["x_train"] / 255.0).astype(np.float32)[:n_train],
+            np.asarray(d["y_train"]).astype(np.int64).reshape(-1, 1)[:n_train],
+        )
+        te = (
+            (d["x_test"] / 255.0).astype(np.float32)[:n_test],
+            np.asarray(d["y_test"]).astype(np.int64).reshape(-1, 1)[:n_test],
+        )
+    return tr, te
+
+
 def cifar10(n_train: int = 50000, n_test: int = 10000) -> Arrays:
     """CIFAR-10 class data: [N,32,32,3] float [0,1], labels [N,1].
 
@@ -120,20 +135,34 @@ def cifar10(n_train: int = 50000, n_test: int = 10000) -> Arrays:
     """
     path = _find("cifar10.npz")
     if path is not None:
-        with np.load(path) as d:
-            tr = (
-                (d["x_train"] / 255.0).astype(np.float32)[:n_train],
-                d["y_train"].astype(np.int64).reshape(-1, 1)[:n_train],
-            )
-            te = (
-                (d["x_test"] / 255.0).astype(np.float32)[:n_test],
-                d["y_test"].astype(np.int64).reshape(-1, 1)[:n_test],
-            )
-        return tr, te
+        return _load_npz(path, n_train, n_test)
     train, test = _synthetic_images(n_train, n_test, 32, 10, seed=1)
     tr = np.repeat(train[0][..., None], 3, axis=-1), train[1]
     te = np.repeat(test[0][..., None], 3, axis=-1), test[1]
     return tr, te
+
+
+def imagenet(
+    n_train: int = 10000,
+    n_test: int = 1000,
+    side: int = 224,
+    num_classes: int = 1000,
+) -> Arrays:
+    """ImageNet-shaped data for the ViT-B/16 FSDP config (BASELINE.json
+    configs[3]): [N,side,side,3] float [0,1], labels [N,1].
+
+    Resolves a local ``imagenet.npz`` (x_train/y_train/x_test/y_test uint8)
+    first; otherwise the deterministic synthetic generator — same
+    class-conditional structure as the MNIST/CIFAR stand-ins so training
+    measurably learns (SURVEY.md §4).
+    """
+    path = _find("imagenet.npz")
+    if path is not None:
+        return _load_npz(path, n_train, n_test)
+    train, test = _synthetic_images(
+        n_train, n_test, side, num_classes, seed=3, channels=3
+    )
+    return train, test
 
 
 def synthetic_tokens(

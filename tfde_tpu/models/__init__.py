@@ -9,3 +9,9 @@ from tfde_tpu.models.resnet import (  # noqa: F401
     ResNet101,
     resnet50_cifar,
 )
+from tfde_tpu.models.transformer import (  # noqa: F401
+    Encoder,
+    MultiHeadAttention,
+    TransformerBlock,
+)
+from tfde_tpu.models.vit import ViT, ViT_B16, ViT_L16, ViT_S16, vit_tiny_test  # noqa: F401

@@ -1,0 +1,187 @@
+"""Transformer encoder core shared by ViT (models/vit.py) and BERT
+(models/bert.py) — the driver's scale-up configs (BASELINE.json configs[3-4]).
+
+TPU-first choices:
+- bf16 activations / fp32 params + LayerNorm (`dtype` vs `param_dtype`): MXU
+  native precision on the matmuls, fp32 where numerics are touchy.
+- Megatron-compatible weight shapes: qkv projections produce [embed, heads,
+  head_dim] kernels (heads contiguous in one trailing block) and the output /
+  fc2 projections consume their sharded dim first — so a tensor-parallel
+  strategy can column/row-shard them over the 'tensor' axis with exactly two
+  psums per block, both of which XLA overlaps with the following matmul.
+- Activation constraints via parallel/axes.constrain: batch over data-like
+  axes, sequence over 'seq', heads/hidden over 'tensor'. No-ops when the
+  active mesh lacks those axes, so one definition serves every strategy.
+- `remat` wraps each block in jax.checkpoint — HBM for FLOPs, the standard
+  long-sequence trade.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tfde_tpu.ops import attention as attn_lib
+from tfde_tpu.parallel.axes import batch_axes, constrain
+
+
+class MultiHeadAttention(nn.Module):
+    """Self-attention with dispatchable kernel (ops/attention.attention)."""
+
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    attn_impl: str = "auto"
+    causal: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        mask: Optional[jax.Array] = None,
+        train: bool = False,
+    ) -> jax.Array:
+        b = batch_axes()
+        proj = functools.partial(
+            nn.DenseGeneral,
+            features=(self.num_heads, self.head_dim),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        q = proj(name="query")(x)
+        k = proj(name="key")(x)
+        v = proj(name="value")(x)
+        # [B, S, H, D]: heads carry the tensor-parallel shard.
+        q, k, v = (constrain(t, b, "seq", "tensor") for t in (q, k, v))
+        y = attn_lib.attention(
+            q, k, v, mask=mask, causal=self.causal, impl=self.attn_impl
+        )
+        y = constrain(y, b, "seq", "tensor")
+        y = nn.DenseGeneral(
+            features=x.shape[-1],
+            axis=(-2, -1),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="out",
+        )(y)
+        y = constrain(y, b, "seq")
+        if self.dropout_rate > 0.0:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return y
+
+
+class Mlp(nn.Module):
+    """fc1 -> gelu -> fc2; hidden dim carries the tensor-parallel shard."""
+
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        b = batch_axes()
+        h = nn.Dense(
+            self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32, name="fc1"
+        )(x)
+        h = nn.gelu(h)
+        h = constrain(h, b, "seq", "tensor")
+        h = nn.Dense(
+            x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32, name="fc2"
+        )(h)
+        h = constrain(h, b, "seq")
+        if self.dropout_rate > 0.0:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return h
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    attn_impl: str = "auto"
+    causal: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        mask: Optional[jax.Array] = None,
+        train: bool = False,
+    ) -> jax.Array:
+        ln = functools.partial(
+            nn.LayerNorm, dtype=jnp.float32, param_dtype=jnp.float32
+        )
+        y = ln(name="ln_attn")(x).astype(self.dtype)
+        y = MultiHeadAttention(
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            dtype=self.dtype,
+            dropout_rate=self.dropout_rate,
+            attn_impl=self.attn_impl,
+            causal=self.causal,
+            name="attn",
+        )(y, mask=mask, train=train)
+        x = x + y
+        y = ln(name="ln_mlp")(x).astype(self.dtype)
+        y = Mlp(
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            dropout_rate=self.dropout_rate,
+            name="mlp",
+        )(y, train=train)
+        return x + y
+
+
+class Encoder(nn.Module):
+    """Stack of TransformerBlocks with optional per-block rematerialization."""
+
+    depth: int
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    attn_impl: str = "auto"
+    causal: bool = False
+    remat: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        mask: Optional[jax.Array] = None,
+        train: bool = False,
+    ) -> jax.Array:
+        def body(mdl: TransformerBlock, h: jax.Array) -> jax.Array:
+            # mask/train close over: constants to jax.checkpoint (no grads
+            # flow to them — mask is boolean, train is a Python bool).
+            return mdl(h, mask, train)
+
+        if self.remat:
+            body = nn.remat(
+                body, policy=jax.checkpoint_policies.nothing_saveable
+            )
+        for i in range(self.depth):
+            block = TransformerBlock(
+                num_heads=self.num_heads,
+                head_dim=self.head_dim,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                dropout_rate=self.dropout_rate,
+                attn_impl=self.attn_impl,
+                causal=self.causal,
+                name=f"block_{i}",
+            )
+            x = body(block, x)
+        return nn.LayerNorm(
+            dtype=jnp.float32, param_dtype=jnp.float32, name="ln_final"
+        )(x)

@@ -1,0 +1,113 @@
+"""Vision Transformer — the FSDP scale-up config.
+
+BASELINE.json configs[3]: "ImageNet ViT-B/16 (pjit FSDP over ICI mesh)". The
+reference has no transformer (SURVEY.md §5); this is the driver-mandated
+scale config, built on the shared encoder (models/transformer.py) so the
+tensor/sequence-parallel machinery applies to it unchanged.
+
+TPU-first choices:
+- Patch embedding as a strided Conv — XLA lowers it to one big MXU matmul
+  over [patches, 3*16*16].
+- bf16 compute / fp32 params, fp32 pooling+head (see models/transformer.py).
+- CLS-token head by default (parity with the canonical ViT-B/16 recipe and
+  its 86.6M param count); `pool='gap'` gives the token-free mean-pool
+  variant.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tfde_tpu.models.transformer import Encoder
+from tfde_tpu.parallel.axes import batch_axes, constrain
+
+
+class ViT(nn.Module):
+    """Vision Transformer classifier over [B, H, W, C] images."""
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    pool: str = "cls"  # 'cls' | 'gap'
+    attn_impl: str = "auto"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        b = batch_axes()
+        p = self.patch_size
+        x = nn.Conv(
+            self.embed_dim,
+            kernel_size=(p, p),
+            strides=(p, p),
+            padding="VALID",
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="patch_embed",
+        )(x.astype(self.dtype))
+        bsz, gh, gw, c = x.shape
+        x = x.reshape(bsz, gh * gw, c)
+        if self.pool == "cls":
+            cls = self.param(
+                "cls_token", nn.initializers.zeros, (1, 1, self.embed_dim),
+                jnp.float32,
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (bsz, 1, c)).astype(self.dtype), x], axis=1
+            )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.embed_dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        x = constrain(x, b, "seq")
+        if self.dropout_rate > 0.0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = Encoder(
+            depth=self.depth,
+            num_heads=self.num_heads,
+            head_dim=self.embed_dim // self.num_heads,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            dropout_rate=self.dropout_rate,
+            attn_impl=self.attn_impl,
+            remat=self.remat,
+            name="encoder",
+        )(x, train=train)
+        if self.pool == "cls":
+            x = x[:, 0]
+        else:
+            x = jnp.mean(x, axis=1)
+        # Head in fp32: the logits path is precision-sensitive.
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+ViT_B16 = functools.partial(
+    ViT, patch_size=16, embed_dim=768, depth=12, num_heads=12, mlp_dim=3072
+)
+ViT_L16 = functools.partial(
+    ViT, patch_size=16, embed_dim=1024, depth=24, num_heads=16, mlp_dim=4096
+)
+ViT_S16 = functools.partial(
+    ViT, patch_size=16, embed_dim=384, depth=12, num_heads=6, mlp_dim=1536
+)
+
+
+def vit_tiny_test(num_classes: int = 10, **kw) -> ViT:
+    """Small config for CI on the 8-device CPU mesh (SURVEY.md §4)."""
+    return ViT(
+        num_classes=num_classes, patch_size=4, embed_dim=32, depth=2,
+        num_heads=4, mlp_dim=64, dtype=jnp.float32, **kw,
+    )

@@ -1,0 +1,123 @@
+"""Attention ops — the hot kernel of the transformer scale-up configs.
+
+The reference has no attention anywhere (its models are MNIST CNNs, SURVEY.md
+§5 "long-context: entirely absent"); this exists for the driver's scale
+configs (BASELINE.json: ViT-B/16 FSDP, BERT-base MLM) and the long-context
+story (ring attention over a 'seq' mesh axis).
+
+Three implementations behind one dispatcher:
+
+- ``reference``: einsum + fp32 softmax. The numerics oracle; also what XLA
+  fuses perfectly well at short sequence lengths.
+- ``flash``: Pallas TPU kernel (ops/flash_attention.py) — blockwise online
+  softmax, O(S) memory, MXU-shaped tiles. Used on TPU for long sequences.
+- ``ring``: sequence-parallel blockwise attention over the mesh's 'seq' axis
+  (ops/ring_attention.py) — KV blocks rotate around the ring via ppermute
+  while compute overlaps, so sequence length scales with the number of chips.
+
+Shapes follow the Flax convention: q/k/v are [batch, length, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tfde_tpu.parallel import axes as axes_lib
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Plain softmax(QK^T/sqrt(d))V with fp32 accumulation.
+
+    mask: broadcastable to [B, H, Sq, Sk]; True/1 = attend. Additive -inf
+    masking in fp32 keeps bf16 inputs numerically safe.
+    """
+    *_, sq, _, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    # [B,Sq,H,D] x [B,Sk,H,D] -> [B,H,Sq,Sk]; accumulate in fp32.
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        mask = cm if mask is None else jnp.logical_and(mask, cm)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def _seq_parallel_active() -> bool:
+    mesh = axes_lib.current_mesh()
+    return mesh is not None and "seq" in mesh.axis_names and mesh.shape["seq"] > 1
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _have(module: str) -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec(f"tfde_tpu.ops.{module}") is not None
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatching attention: [B,S,H,D] -> [B,S,H,D].
+
+    impl: 'auto' | 'reference' | 'flash' | 'ring'. 'auto' picks ring when the
+    active mesh shards 'seq', flash on TPU for sequences long enough that the
+    O(S^2) score tensor stops fitting comfortably in VMEM-adjacent fusion
+    (S >= 1024), else the reference einsum (XLA already fuses it optimally at
+    short S).
+    """
+    if impl == "auto":
+        if _seq_parallel_active() and _have("ring_attention"):
+            impl = "ring"
+        elif _on_tpu() and q.shape[1] >= 1024 and mask is None and _have(
+            "flash_attention"
+        ):
+            impl = "flash"
+        else:
+            impl = "reference"
+    if impl == "reference":
+        return reference_attention(q, k, v, mask=mask, causal=causal)
+    if impl == "flash":
+        from tfde_tpu.ops import flash_attention
+
+        return flash_attention.flash_attention(q, k, v, causal=causal)
+    if impl == "ring":
+        from tfde_tpu.ops import ring_attention
+
+        return ring_attention.ring_attention(
+            q, k, v, mask=mask, causal=causal, mesh=axes_lib.current_mesh()
+        )
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def padding_mask(valid: jax.Array) -> jax.Array:
+    """[B, S] 1/True-for-real-token -> [B, 1, 1, S] attention mask."""
+    return valid.astype(jnp.bool_)[:, None, None, :]

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tfde_tpu.ops import losses, metrics as metrics_lib
+from tfde_tpu.parallel import axes as axes_lib
 from tfde_tpu.parallel.strategies import Strategy
 from tfde_tpu.training.train_state import TrainState
 
@@ -141,12 +142,26 @@ def init_state(
     return state, shardings
 
 
+def _with_mesh(fn, mesh):
+    """Trace `fn` under axes.use_axes(mesh) so the models' activation
+    `constrain` annotations (parallel/axes.py) bind to the strategy's mesh.
+    with_sharding_constraint is a trace-time op, so entering the context
+    inside the traced body is exactly what pins it."""
+
+    @functools.wraps(fn)
+    def wrapped(*args):
+        with axes_lib.use_axes(mesh):
+            return fn(*args)
+
+    return wrapped
+
+
 def make_train_step(strategy: Strategy, state: TrainState, donate: bool = True):
     """Compile train_step with the strategy's shardings pinned."""
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
     return jax.jit(
-        train_step,
+        _with_mesh(train_step, strategy.mesh),
         in_shardings=(shardings, (batch_sh, batch_sh), None),
         out_shardings=(shardings, None),
         donate_argnums=(0,) if donate else (),
@@ -157,7 +172,7 @@ def make_eval_step(strategy: Strategy, state: TrainState):
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
     return jax.jit(
-        eval_step,
+        _with_mesh(eval_step, strategy.mesh),
         in_shardings=(shardings, (batch_sh, batch_sh, batch_sh)),
     )
 
