@@ -1,0 +1,138 @@
+"""BERT MLM config tests: architecture parity, masking recipe statistics,
+masked-loss correctness, custom-train-step integration, example smoke
+(SURVEY.md §4; BASELINE.json configs[4])."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tfde_tpu.data.mlm import IGNORE_ID, MlmConfig, mask_tokens
+from tfde_tpu.models.bert import BertBase, bert_tiny_test
+from tfde_tpu.ops.losses import masked_lm_loss
+from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
+from tfde_tpu.training.step import init_state, make_custom_train_step
+
+
+def test_bert_base_param_count():
+    m = BertBase()
+    v = jax.eval_shape(m.init, jax.random.key(0), jnp.zeros((1, 16), jnp.int32))
+    n = sum(p.size for p in jax.tree_util.tree_leaves(v["params"]))
+    # Analytic count, computed independently of the model code:
+    V, H, P, T, L, F = 30522, 768, 512, 2, 12, 3072
+    emb = V * H + P * H + T * H + 2 * H
+    per_layer = (
+        3 * (H * H + H)        # q,k,v
+        + H * H + H            # out proj
+        + 2 * (2 * H)          # two LayerNorms
+        + H * F + F            # fc1
+        + F * H + H            # fc2
+    )
+    head = H * H + H + 2 * H + V  # mlm dense + LN + tied-decoder bias
+    assert n == emb + L * per_layer + head
+
+
+def test_bert_tiny_forward_shapes(rng):
+    m = bert_tiny_test()
+    ids = jnp.asarray(rng.integers(0, 97, (2, 16)), jnp.int32)
+    v = m.init(jax.random.key(0), ids, train=False)
+    logits = m.apply(v, ids, train=False)
+    assert logits.shape == (2, 16, 97)
+    assert logits.dtype == jnp.float32
+
+
+def test_bert_attention_mask_blocks_padding(rng):
+    m = bert_tiny_test()
+    ids = jnp.asarray(rng.integers(0, 97, (2, 16)), jnp.int32)
+    v = m.init(jax.random.key(0), ids, train=False)
+    am = np.ones((2, 16), np.float32)
+    am[:, 12:] = 0.0
+    out = m.apply(v, ids, attention_mask=jnp.asarray(am), train=False)
+    ids2 = np.asarray(ids).copy()
+    ids2[:, 12:] = 3  # change padded tokens
+    out2 = m.apply(v, jnp.asarray(ids2), attention_mask=jnp.asarray(am), train=False)
+    np.testing.assert_allclose(
+        np.asarray(out)[:, :12], np.asarray(out2)[:, :12], rtol=1e-4, atol=1e-4
+    )
+
+
+def test_mask_tokens_statistics():
+    rng = np.random.default_rng(0)
+    cfg = MlmConfig(vocab_size=1000, mask_id=999, num_special=5)
+    tokens = rng.integers(5, 999, (200, 128)).astype(np.int32)
+    input_ids, labels = mask_tokens(tokens, cfg, rng)
+    selected = labels != IGNORE_ID
+    rate = selected.mean()
+    assert 0.13 < rate < 0.17  # ~15%
+    # at selected positions labels hold the original token
+    np.testing.assert_array_equal(labels[selected], tokens[selected])
+    # unselected positions pass through unchanged
+    np.testing.assert_array_equal(input_ids[~selected], tokens[~selected])
+    # of selected: ~80% mask, ~10% random, ~10% keep
+    masked = (input_ids == cfg.mask_id) & selected
+    kept = (input_ids == tokens) & selected
+    assert 0.75 < masked.sum() / selected.sum() < 0.85
+    assert 0.05 < kept.sum() / selected.sum() < 0.15
+    # every example has at least one target
+    assert selected.any(axis=1).all()
+
+
+def test_masked_lm_loss_ignores_non_targets(rng):
+    logits = jnp.asarray(rng.standard_normal((2, 8, 11)), jnp.float32)
+    labels = np.full((2, 8), IGNORE_ID, np.int32)
+    labels[0, 2] = 4
+    labels[1, 5] = 7
+    loss, acc = masked_lm_loss(logits, jnp.asarray(labels))
+    expect = np.mean(
+        [
+            -jax.nn.log_softmax(logits[0, 2])[4],
+            -jax.nn.log_softmax(logits[1, 5])[7],
+        ]
+    )
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+    # perturbing a non-target position must not move the loss
+    logits2 = np.asarray(logits).copy()
+    logits2[0, 0] += 100.0
+    loss2, _ = masked_lm_loss(jnp.asarray(logits2), jnp.asarray(labels))
+    np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-6)
+
+
+def test_bert_custom_train_step_loss_decreases(rng):
+    strategy = MultiWorkerMirroredStrategy()
+    m = bert_tiny_test()
+    from examples.bert_mlm import mlm_loss_fn
+
+    state, _ = init_state(
+        m, optax.adamw(3e-3), strategy, np.zeros((16, 16), np.int32)
+    )
+    step = make_custom_train_step(strategy, state, mlm_loss_fn, donate=False)
+    cfg = MlmConfig(vocab_size=96, mask_id=96)
+    from tfde_tpu.data.datasets import synthetic_tokens
+
+    tokens = synthetic_tokens(256, 16, vocab=96)
+    nrng = np.random.default_rng(0)
+    key = jax.random.key(0)
+    first = None
+    for i in range(8):
+        idx = nrng.integers(0, len(tokens), 16)
+        batch = mask_tokens(tokens[idx], cfg, nrng)
+        state, metrics = step(state, batch, key)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+    assert "mlm_accuracy" in metrics
+
+
+def test_bert_example_smoke():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from examples import bert_mlm
+
+    state, metrics = bert_mlm.main(
+        ["--tiny", "--seq-len", "16", "--max-steps", "2", "--batch-size", "16",
+         "--train-examples", "64"]
+    )
+    assert int(jax.device_get(state.step)) == 2
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))

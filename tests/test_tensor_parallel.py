@@ -1,0 +1,77 @@
+"""Tensor-parallelism tests: sharding rules hit the right dims, TP training
+numerics match pure DP exactly, memory actually shards (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from tfde_tpu.models.bert import bert_tiny_test
+from tfde_tpu.models.vit import vit_tiny_test
+from tfde_tpu.parallel.strategies import (
+    MultiWorkerMirroredStrategy,
+    TensorParallelStrategy,
+)
+from tfde_tpu.training.step import init_state, make_train_step
+
+
+def test_tp_spec_rules():
+    m = vit_tiny_test()  # heads=4, mlp=64 — divisible by tensor=4
+    v = jax.eval_shape(
+        m.init, jax.random.key(0), jnp.zeros((1, 32, 32, 3))
+    )
+    s = TensorParallelStrategy(data=2)
+    specs = s.params_spec(v["params"])
+    blk = specs["encoder"]["block_0"]
+    assert blk["attn"]["query"]["kernel"] == P(None, "tensor", None)
+    assert blk["attn"]["query"]["bias"] == P("tensor", None)
+    assert blk["attn"]["out"]["kernel"] == P("tensor", None, None)
+    assert blk["attn"]["out"]["bias"] == P()
+    assert blk["mlp"]["fc1"]["kernel"] == P(None, "tensor")
+    assert blk["mlp"]["fc1"]["bias"] == P("tensor")
+    assert blk["mlp"]["fc2"]["kernel"] == P("tensor", None)
+    assert blk["ln_attn"]["scale"] == P()
+    assert specs["patch_embed"]["kernel"] == P()  # conv stem replicated
+
+
+def _train_params(strategy, steps=3):
+    m = vit_tiny_test()
+    sample = np.zeros((16, 32, 32, 3), np.float32)
+    state, _ = init_state(m, optax.sgd(0.05), strategy, sample, seed=0)
+    step = make_train_step(strategy, state, donate=False)
+    rng = np.random.default_rng(0)
+    images = rng.random((16, 32, 32, 3), np.float32)
+    labels = rng.integers(0, 10, (16, 1)).astype(np.int32)
+    key = jax.random.key(0)
+    for _ in range(steps):
+        state, metrics = step(state, (images, labels), key)
+    return jax.device_get(state.params), float(metrics["loss"])
+
+
+def test_tp_matches_dp_numerics():
+    """dp=2 x tp=4 must produce the same params as pure dp=8 — TP is a
+    layout change, not a math change."""
+    p_dp, loss_dp = _train_params(MultiWorkerMirroredStrategy())
+    p_tp, loss_tp = _train_params(TensorParallelStrategy(data=2))
+    np.testing.assert_allclose(loss_dp, loss_tp, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+        p_dp, p_tp,
+    )
+
+
+def test_tp_weights_actually_sharded():
+    s = TensorParallelStrategy(data=1)  # tensor=8
+    m = bert_tiny_test()  # heads=4 not divisible by 8 -> qkv replicated,
+    # but fc1 (64) and fc2 shard; graceful per-leaf degradation
+    state, _ = init_state(
+        m, optax.sgd(0.1), s, np.zeros((8, 16), np.int32)
+    )
+    blk = state.params["encoder"]["block_0"]
+    fc1 = blk["mlp"]["fc1"]["kernel"]
+    assert fc1.sharding.spec == P(None, "tensor")
+    # per-device shard is 1/8 of the logical array
+    assert fc1.addressable_shards[0].data.shape[1] == fc1.shape[1] // 8
+    qkv = blk["attn"]["query"]["kernel"]
+    assert qkv.sharding.spec in (P(), P(None, None, None))  # 4 heads % 8 != 0

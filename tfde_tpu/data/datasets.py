@@ -158,7 +158,16 @@ def imagenet(
     """
     path = _find("imagenet.npz")
     if path is not None:
-        return _load_npz(path, n_train, n_test)
+        tr, te = _load_npz(path, n_train, n_test)
+        if tr[0].shape[1] == side and tr[1].max() < num_classes:
+            return tr, te
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s is %dpx with labels up to %d but %dpx/%d classes were "
+            "requested; using the synthetic generator instead",
+            path, tr[0].shape[1], int(tr[1].max()), side, num_classes,
+        )
     train, test = _synthetic_images(
         n_train, n_test, side, num_classes, seed=3, channels=3
     )

@@ -1,0 +1,161 @@
+"""BERT encoder + masked-LM head — the sequence scale-up config.
+
+BASELINE.json configs[4]: "BERT-base MLM (sequence batch data-parallel on
+v4-32)". The reference has no sequence models (SURVEY.md §5 "long-context:
+entirely absent"); this is the driver-mandated config, sharing the encoder
+core (models/transformer.py, norm_style='post' — original BERT arrangement)
+so TP/SP/ring-attention apply to it unchanged.
+
+TPU-first choices:
+- bf16 activations / fp32 params + LayerNorms (models/transformer.py).
+- Tied MLM decoder: logits = h @ E^T via `nn.Embed.attend` — one [hidden,
+  vocab] matmul on the MXU, no separate 23M-param decoder matrix.
+- Vocab size 30522 rounds to 30720 (multiple of 128) when `pad_vocab=True`
+  so the embedding/decoder matmuls tile the MXU cleanly; padded ids are
+  never produced by the masking pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tfde_tpu.models.transformer import Encoder
+from tfde_tpu.ops.attention import padding_mask
+from tfde_tpu.parallel.axes import batch_axes, constrain
+
+
+class BertEmbeddings(nn.Module):
+    vocab_size: int
+    hidden_size: int
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self):
+        self.word = nn.Embed(
+            self.vocab_size, self.hidden_size, dtype=self.dtype,
+            param_dtype=jnp.float32, name="word",
+        )
+        self.position = nn.Embed(
+            self.max_position, self.hidden_size, dtype=self.dtype,
+            param_dtype=jnp.float32, name="position",
+        )
+        self.token_type = nn.Embed(
+            self.type_vocab_size, self.hidden_size, dtype=self.dtype,
+            param_dtype=jnp.float32, name="token_type",
+        )
+        self.ln = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32)
+        self.dropout = nn.Dropout(self.dropout_rate)
+
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        token_type_ids: Optional[jax.Array] = None,
+        train: bool = False,
+    ) -> jax.Array:
+        seq = input_ids.shape[1]
+        x = self.word(input_ids)
+        x = x + self.position(jnp.arange(seq, dtype=jnp.int32)[None, :])
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + self.token_type(token_type_ids)
+        x = self.ln(x).astype(self.dtype)
+        return self.dropout(x, deterministic=not train)
+
+
+class Bert(nn.Module):
+    """BERT encoder with tied masked-LM head over [B, S] int token ids."""
+
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "auto"
+    remat: bool = False
+    pad_vocab: bool = False
+
+    @property
+    def padded_vocab(self) -> int:
+        if not self.pad_vocab:
+            return self.vocab_size
+        return -(-self.vocab_size // 128) * 128  # round up to MXU lane width
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        token_type_ids: Optional[jax.Array] = None,
+        train: bool = False,
+    ) -> jax.Array:
+        """Returns MLM logits [B, S, vocab] (fp32)."""
+        b = batch_axes()
+        emb = BertEmbeddings(
+            vocab_size=self.padded_vocab,
+            hidden_size=self.hidden_size,
+            max_position=self.max_position,
+            type_vocab_size=self.type_vocab_size,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="embeddings",
+        )
+        x = emb(input_ids, token_type_ids, train=train)
+        x = constrain(x, b, "seq")
+        mask = None
+        if attention_mask is not None:
+            mask = padding_mask(attention_mask)
+        x = Encoder(
+            depth=self.depth,
+            num_heads=self.num_heads,
+            head_dim=self.hidden_size // self.num_heads,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            dropout_rate=self.dropout_rate,
+            attn_impl=self.attn_impl,
+            norm_style="post",
+            remat=self.remat,
+            name="encoder",
+        )(x, mask=mask, train=train)
+
+        # MLM transform head (dense + gelu + LN), then tied decoder.
+        h = nn.Dense(
+            self.hidden_size, dtype=self.dtype, param_dtype=jnp.float32,
+            name="mlm_dense",
+        )(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(
+            dtype=jnp.float32, param_dtype=jnp.float32, name="mlm_ln"
+        )(h)
+        logits = emb.word.attend(h.astype(self.dtype))
+        bias = self.param(
+            "mlm_bias", nn.initializers.zeros, (self.padded_vocab,), jnp.float32
+        )
+        logits = logits.astype(jnp.float32) + bias
+        return constrain(logits, b, "seq", "tensor")
+
+
+BertBase = functools.partial(
+    Bert, hidden_size=768, depth=12, num_heads=12, mlp_dim=3072
+)
+BertLarge = functools.partial(
+    Bert, hidden_size=1024, depth=24, num_heads=16, mlp_dim=4096
+)
+
+
+def bert_tiny_test(**kw) -> Bert:
+    """CI config for the 8-device CPU mesh (SURVEY.md §4)."""
+    return Bert(
+        vocab_size=97, hidden_size=32, depth=2, num_heads=4, mlp_dim=64,
+        max_position=64, dtype=jnp.float32, dropout_rate=0.0, **kw,
+    )

@@ -100,7 +100,10 @@ class Mlp(nn.Module):
 
 
 class TransformerBlock(nn.Module):
-    """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x))."""
+    """Pre-LN (default): x + MHA(LN(x)); x + MLP(LN(x)) — the stable-training
+    variant ViT/GPT use. `norm_style='post'`: LN(x + MHA(x)); LN(x + MLP(x))
+    — the original BERT arrangement (models/bert.py needs it for exact
+    architecture parity)."""
 
     num_heads: int
     head_dim: int
@@ -109,6 +112,7 @@ class TransformerBlock(nn.Module):
     dropout_rate: float = 0.0
     attn_impl: str = "auto"
     causal: bool = False
+    norm_style: str = "pre"  # 'pre' | 'post'
 
     @nn.compact
     def __call__(
@@ -120,8 +124,7 @@ class TransformerBlock(nn.Module):
         ln = functools.partial(
             nn.LayerNorm, dtype=jnp.float32, param_dtype=jnp.float32
         )
-        y = ln(name="ln_attn")(x).astype(self.dtype)
-        y = MultiHeadAttention(
+        attn = MultiHeadAttention(
             num_heads=self.num_heads,
             head_dim=self.head_dim,
             dtype=self.dtype,
@@ -129,16 +132,24 @@ class TransformerBlock(nn.Module):
             attn_impl=self.attn_impl,
             causal=self.causal,
             name="attn",
-        )(y, mask=mask, train=train)
-        x = x + y
-        y = ln(name="ln_mlp")(x).astype(self.dtype)
-        y = Mlp(
+        )
+        mlp = Mlp(
             mlp_dim=self.mlp_dim,
             dtype=self.dtype,
             dropout_rate=self.dropout_rate,
             name="mlp",
-        )(y, train=train)
-        return x + y
+        )
+        if self.norm_style == "pre":
+            y = ln(name="ln_attn")(x).astype(self.dtype)
+            x = x + attn(y, mask=mask, train=train)
+            y = ln(name="ln_mlp")(x).astype(self.dtype)
+            return x + mlp(y, train=train)
+        if self.norm_style == "post":
+            x = ln(name="ln_attn")(x + attn(x, mask=mask, train=train))
+            x = x.astype(self.dtype)
+            x = ln(name="ln_mlp")(x + mlp(x, train=train))
+            return x.astype(self.dtype)
+        raise ValueError(f"norm_style must be 'pre' or 'post', got {self.norm_style!r}")
 
 
 class Encoder(nn.Module):
@@ -152,6 +163,7 @@ class Encoder(nn.Module):
     dropout_rate: float = 0.0
     attn_impl: str = "auto"
     causal: bool = False
+    norm_style: str = "pre"
     remat: bool = False
 
     @nn.compact
@@ -179,9 +191,12 @@ class Encoder(nn.Module):
                 dropout_rate=self.dropout_rate,
                 attn_impl=self.attn_impl,
                 causal=self.causal,
+                norm_style=self.norm_style,
                 name=f"block_{i}",
             )
             x = body(block, x)
+        if self.norm_style == "post":
+            return x  # post-LN blocks already end normalized
         return nn.LayerNorm(
             dtype=jnp.float32, param_dtype=jnp.float32, name="ln_final"
         )(x)

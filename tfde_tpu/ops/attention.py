@@ -106,6 +106,12 @@ def attention(
     if impl == "reference":
         return reference_attention(q, k, v, mask=mask, causal=causal)
     if impl == "flash":
+        if mask is not None:
+            raise NotImplementedError(
+                "flash attention does not take an explicit mask; use "
+                "impl='reference' (or 'auto', which refuses flash when a "
+                "mask is present)"
+            )
         from tfde_tpu.ops import flash_attention
 
         return flash_attention.flash_attention(q, k, v, causal=causal)

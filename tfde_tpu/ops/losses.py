@@ -49,3 +49,26 @@ def sparse_categorical_crossentropy(
     per_example = softmax_cross_entropy_with_integer_labels(logits, labels)
     denom = global_batch_size if global_batch_size is not None else per_example.size
     return jnp.sum(per_example) / denom
+
+
+def masked_lm_loss(
+    logits: jax.Array, labels: jax.Array, ignore_id: int = -100
+) -> tuple[jax.Array, jax.Array]:
+    """(mean CE over target positions, target-position accuracy).
+
+    logits [B,S,V], labels [B,S] with `ignore_id` marking non-targets
+    (data/mlm.mask_tokens). The mean normalizes by the *global* target count
+    — under a sharded batch both sums psum across devices, so the gradient
+    matches the single-device run exactly (same convention as the
+    reference's sum x 1/BATCH_SIZE, tf2_mnist_distributed.py:81-83).
+    """
+    weights = (labels != ignore_id).astype(jnp.float32)
+    safe = jnp.where(labels == ignore_id, 0, labels)
+    per_tok = optax.losses.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), safe
+    )
+    denom = jnp.maximum(jnp.sum(weights), 1.0)
+    loss = jnp.sum(per_tok * weights) / denom
+    correct = (jnp.argmax(logits, axis=-1) == safe).astype(jnp.float32)
+    acc = jnp.sum(correct * weights) / denom
+    return loss, acc

@@ -154,6 +154,85 @@ class ParameterServerStrategy(Strategy):
         )
 
 
+def _path_names(path) -> tuple:
+    """jax key-path -> tuple of string names."""
+    names = []
+    for k in path:
+        if hasattr(k, "key"):
+            names.append(str(k.key))
+        elif hasattr(k, "name"):
+            names.append(str(k.name))
+        elif hasattr(k, "idx"):
+            names.append(str(k.idx))
+        else:
+            names.append(str(k))
+    return tuple(names)
+
+
+class TensorParallelStrategy(Strategy):
+    """Megatron-style tensor parallelism over the 'tensor' mesh axis.
+
+    Scale-up scope beyond the reference's DP-only surface (SURVEY.md §2c:
+    "TP: absent"), built for the transformer configs. The sharding rules
+    match the weight shapes models/transformer.py commits to:
+
+    - q/k/v kernels [embed, heads, head_dim]: column-parallel — heads split
+      over 'tensor'; biases [heads, head_dim] follow.
+    - attention out kernel [heads, head_dim, embed]: row-parallel — the
+      contraction dims split, XLA inserts one psum after the projection.
+    - mlp fc1 [embed, ffn]: column-parallel; bias follows. fc2 [ffn, embed]:
+      row-parallel -> second psum.
+    - everything else (LayerNorms, embeddings, heads, conv stems) replicates.
+
+    Combined with the activation constraints the models already carry
+    (parallel/axes.constrain over 'tensor'), each transformer block runs at
+    1/T the weight memory and exactly two reduction collectives — both over
+    the innermost (ICI-fastest) mesh axis, per runtime/mesh.AXIS_ORDER.
+
+    `extra_rules`: optional [(predicate(names)->bool, spec_fn(shape)->P)]
+    applied before the built-ins, for model-specific overrides.
+    """
+
+    _COLUMN = ("query", "key", "value", "fc1")   # shard output dim(s)
+    _ROW = ("out", "fc2")                        # shard input dim(s)
+
+    def __init__(self, mesh: Optional[Mesh] = None, data: int = 1, extra_rules=()):
+        self._data = data
+        self._extra = tuple(extra_rules)
+        super().__init__(mesh)
+
+    def _default_mesh(self) -> Mesh:
+        return mesh_lib.make_mesh({"data": self._data, "tensor": -1})
+
+    def params_spec(self, params: Any) -> Any:
+        tsize = self.mesh.shape["tensor"]
+
+        def leaf_spec(path, leaf):
+            names = _path_names(path)
+            shape = getattr(leaf, "shape", ())
+            for pred, spec_fn in self._extra:
+                if pred(names):
+                    return spec_fn(shape)
+            if tsize <= 1 or not shape:
+                return P()
+            module = names[-2] if len(names) >= 2 else ""
+            kind = names[-1]
+            if module in self._COLUMN:
+                if kind == "kernel" and len(shape) >= 2 and shape[1] % tsize == 0:
+                    # qkv [embed, heads, hd] / fc1 [embed, ffn]: split dim 1
+                    return P(None, "tensor", *(None,) * (len(shape) - 2))
+                if kind == "bias" and shape[0] % tsize == 0:
+                    # qkv bias [heads, hd] / fc1 bias [ffn]: split dim 0
+                    return P("tensor", *(None,) * (len(shape) - 1))
+                return P()
+            if module in self._ROW and kind == "kernel" and shape[0] % tsize == 0:
+                # out [heads, hd, embed] / fc2 [ffn, embed]: split dim 0
+                return P("tensor", *(None,) * (len(shape) - 1))
+            return P()
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
 class FSDPStrategy(Strategy):
     """Fully-sharded DP: params + opt state sharded over 'fsdp' axis.
 

@@ -168,6 +168,59 @@ def make_train_step(strategy: Strategy, state: TrainState, donate: bool = True):
     )
 
 
+def make_custom_train_step(
+    strategy: Strategy,
+    state: TrainState,
+    loss_fn: Callable[[TrainState, Any, Any, jax.Array], Tuple[jax.Array, dict]],
+    donate: bool = True,
+):
+    """Compile a train step with a user loss over an arbitrary batch pytree.
+
+    The generalization of `make_train_step` for objectives beyond
+    (images, labels) classification — MLM, seq2seq, contrastive — the analog
+    of the reference's hand-written `model_fn` path
+    (tf2_mnist_distributed.py:65-91), where the user owns the loss and the
+    framework owns differentiation, sharding, and the optimizer update.
+
+    `loss_fn(state, params, batch, rng) -> (scalar_loss, metrics_dict)`.
+    Models with BatchNorm return updated stats under the reserved metrics key
+    ``"batch_stats"``. Every batch leaf must be [global_batch, ...]; each is
+    sharded over the mesh's data axes.
+    """
+    shardings = _state_shardings(strategy, state)
+    batch_sh = strategy.batch_sharding()
+
+    def step(state: TrainState, batch, rng):
+        step_rng = jax.random.fold_in(rng, state.step)
+
+        def wrapped(params):
+            return loss_fn(state, params, batch, step_rng)
+
+        (loss, metrics), grads = jax.value_and_grad(wrapped, has_aux=True)(
+            state.params
+        )
+        metrics = dict(metrics)
+        new_stats = metrics.pop("batch_stats", state.batch_stats)
+        new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
+        return new_state, {"loss": loss, **metrics}
+
+    def batch_shardings(batch):
+        return jax.tree_util.tree_map(lambda _: batch_sh, batch)
+
+    jitted = jax.jit(
+        _with_mesh(step, strategy.mesh),
+        in_shardings=(shardings, None, None),  # batch shardings via device_put
+        out_shardings=(shardings, None),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def run(state: TrainState, batch, rng):
+        batch = jax.device_put(batch, batch_shardings(batch))
+        return jitted(state, batch, rng)
+
+    return run
+
+
 def make_eval_step(strategy: Strategy, state: TrainState):
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
