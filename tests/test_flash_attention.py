@@ -1,0 +1,71 @@
+"""Flash-attention kernel tests — interpret mode on CPU (the fake-backend
+methodology of SURVEY.md §4 applied to Pallas kernels); numerics + grads
+against the reference einsum implementation."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.ops.attention import reference_attention
+from tfde_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(rng, b=2, s=256, h=2, d=8, dtype=jnp.float32):
+    return tuple(
+        jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+        for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(rng, causal):
+    q, k, v = _qkv(rng)
+    expect = reference_attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal, 128, 64, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_single_block(rng):
+    q, k, v = _qkv(rng, s=64)
+    got = flash_attention(q, k, v, False, 128, 128, True)  # blocks clamp to 64
+    expect = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_reference(rng, causal):
+    q, k, v = _qkv(rng, s=128, d=4)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, 64, 32, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_rejects_indivisible_seq(rng):
+    q, k, v = _qkv(rng, s=100)
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, v, False, 64, 64, True)
+
+
+def test_flash_bf16_inputs(rng):
+    q, k, v = _qkv(rng, s=128, dtype=jnp.bfloat16)
+    got = flash_attention(q, k, v, False, 64, 64, True)
+    assert got.dtype == jnp.bfloat16
+    expect = reference_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(expect, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )

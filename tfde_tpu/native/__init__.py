@@ -1,0 +1,181 @@
+"""ctypes bindings for the native host data loader (loader.cc).
+
+Build model: `g++ -O3 -shared -fPIC` on first use, cached next to the source
+(keyed by source hash, so edits rebuild). No pybind11 in this environment —
+the C ABI + ctypes keeps the binding dependency-free. `available()` gates
+call sites; the pure-Python pipeline (data/pipeline.py) is the documented
+fallback so the framework degrades gracefully where no toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = Path(__file__).parent / "loader.cc"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    cache_dir = Path(
+        os.environ.get("TFDE_NATIVE_CACHE", Path.home() / ".cache" / "tfde_tpu")
+    )
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    so = cache_dir / f"loader_{tag}.so"
+    if not so.exists():
+        tmp = so.with_suffix(".so.tmp")
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            str(_SRC), "-o", str(tmp),
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
+    return ctypes.CDLL(str(so))
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            lib = _build()
+            lib.tfde_loader_create.restype = ctypes.c_void_p
+            lib.tfde_loader_create.argtypes = [
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                ctypes.c_uint64, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.tfde_loader_next.restype = ctypes.c_int64
+            lib.tfde_loader_next.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)
+            ]
+            lib.tfde_loader_release.argtypes = [ctypes.c_void_p]
+            lib.tfde_loader_destroy.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception as e:  # no toolchain / build error -> python fallback
+            log.warning("native loader unavailable (%s); using python pipeline", e)
+            _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+class NativeBatchLoader:
+    """Threaded shuffle+gather+prefetch over in-memory arrays.
+
+    The hot-loop host path: per-epoch permutation, memcpy row gather, and a
+    `depth`-deep prefetch ring all run in GIL-free C++ threads. Semantics
+    match data/pipeline.py's `shuffle(n).repeat(r).batch(b)` chain (tf.data
+    repeat().batch(): batches cross epoch boundaries; final short batch
+    unless drop_remainder).
+
+    When it pays: at MNIST-sized rows the numpy fancy-index fast path is
+    already memory-bound-optimal (measured parity, ~0.8-1.0x); at
+    scale-config batch sizes the multi-worker gather pulls ahead decisively
+    (measured 3.7x at 13 MB/batch — 5.8 vs 1.6 GB/s on this host). Use it
+    for the ResNet/ViT input paths; MNIST examples keep the python
+    pipeline.
+
+    Yields tuples of numpy arrays. Yielded views alias the slot buffer and
+    are only valid until the next iteration — consume (e.g. device_put) or
+    copy before advancing; pass `copy=True` to get owned arrays.
+    """
+
+    def __init__(
+        self,
+        arrays: Sequence[np.ndarray],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        repeat: Optional[int] = None,  # None = infinite
+        drop_remainder: bool = False,
+        num_threads: int = 2,
+        depth: int = 4,
+        copy: bool = False,
+    ):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native loader unavailable; use data.pipeline.Dataset instead"
+            )
+        self._lib = lib
+        self._arrays = [np.ascontiguousarray(a) for a in arrays]
+        n = self._arrays[0].shape[0]
+        if any(a.shape[0] != n for a in self._arrays):
+            raise ValueError("all arrays must share the leading dimension")
+        self._batch = int(batch_size)
+        self._copy = copy
+        self._row_shapes = [a.shape[1:] for a in self._arrays]
+        self._dtypes = [a.dtype for a in self._arrays]
+        row_bytes = [int(a.strides[0]) if a.ndim > 1 else a.itemsize
+                     for a in self._arrays]
+
+        n_arr = len(self._arrays)
+        ptrs = (ctypes.c_void_p * n_arr)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in self._arrays]
+        )
+        rb = (ctypes.c_int64 * n_arr)(*row_bytes)
+        self._handle = lib.tfde_loader_create(
+            n_arr, ptrs, rb, n, self._batch,
+            int(drop_remainder), int(shuffle), seed,
+            -1 if repeat is None else int(repeat),
+            num_threads, depth,
+        )
+        if not self._handle:
+            raise RuntimeError("tfde_loader_create failed")
+        self._out = (ctypes.c_void_p * n_arr)()
+        self._pending_release = False
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, ...]:
+        if self._handle is None:
+            raise StopIteration
+        if self._pending_release:
+            self._lib.tfde_loader_release(self._handle)
+            self._pending_release = False
+        rows = self._lib.tfde_loader_next(self._handle, self._out)
+        if rows == 0:
+            self.close()
+            raise StopIteration
+        out = []
+        for i, (shape, dtype) in enumerate(zip(self._row_shapes, self._dtypes)):
+            nbytes = int(rows) * int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            buf = (ctypes.c_char * nbytes).from_address(self._out[i])
+            arr = np.frombuffer(buf, dtype=dtype).reshape((int(rows),) + shape)
+            out.append(arr.copy() if self._copy else arr)
+        self._pending_release = True
+        return tuple(out)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.tfde_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -10,7 +10,8 @@ Three implementations behind one dispatcher:
 - ``reference``: einsum + fp32 softmax. The numerics oracle; also what XLA
   fuses perfectly well at short sequence lengths.
 - ``flash``: Pallas TPU kernel (ops/flash_attention.py) — blockwise online
-  softmax, O(S) memory, MXU-shaped tiles. Used on TPU for long sequences.
+  softmax, O(S) memory, MXU-shaped tiles. Opt-in on TPU for long sequences
+  (``TFDE_FLASH`` env var, or ``impl='flash'``) until hardware-qualified.
 - ``ring``: sequence-parallel blockwise attention over the mesh's 'seq' axis
   (ops/ring_attention.py) — KV blocks rotate around the ring via ppermute
   while compute overlaps, so sequence length scales with the number of chips.
@@ -89,17 +90,28 @@ def attention(
     """Dispatching attention: [B,S,H,D] -> [B,S,H,D].
 
     impl: 'auto' | 'reference' | 'flash' | 'ring'. 'auto' picks ring when the
-    active mesh shards 'seq', flash on TPU for sequences long enough that the
-    O(S^2) score tensor stops fitting comfortably in VMEM-adjacent fusion
-    (S >= 1024), else the reference einsum (XLA already fuses it optimally at
-    short S).
+    active mesh shards 'seq'; on TPU with ``TFDE_FLASH`` set it picks flash
+    for sequences long enough that the O(S^2) score tensor hurts (S >= 1024,
+    no mask); otherwise the reference einsum (XLA already fuses it optimally
+    at short S). Flash stays opt-in until hardware-qualified — long-sequence
+    users should set TFDE_FLASH=1 or pass impl='flash' explicitly.
     """
     if impl == "auto":
+        import os
+
         if _seq_parallel_active() and _have("ring_attention"):
             impl = "ring"
-        elif _on_tpu() and q.shape[1] >= 1024 and mask is None and _have(
-            "flash_attention"
+        elif (
+            _on_tpu()
+            and q.shape[1] >= 1024
+            and mask is None
+            and _have("flash_attention")
+            and os.environ.get("TFDE_FLASH", "0") not in ("", "0", "false", "False")
         ):
+            # opt-in until hardware-qualified: the kernel passes interpret-
+            # mode numerics/grad tests, but auto-selecting an unproven Mosaic
+            # compile in every long-sequence model is not worth the risk;
+            # set TFDE_FLASH=1 (or impl='flash') to enable.
             impl = "flash"
         else:
             impl = "reference"

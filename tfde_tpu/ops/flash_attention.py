@@ -1,0 +1,195 @@
+"""Flash attention — Pallas TPU kernel for the long-sequence regime.
+
+Hot-op kernel scope (the reference delegates all kernels to TF's C++ library,
+SURVEY.md §2b "Dense/conv/BN kernel library"; here the transformer configs'
+attention gets a hand kernel where XLA's default fusion stops helping).
+
+Forward is a Pallas kernel (per /opt/skills/guides/pallas_guide.md):
+- grid (batch, heads, Sq/block_q); the Q tile stays VMEM-resident while an
+  inner fori_loop walks K/V tiles with the online-softmax recurrence — the
+  [Sq, Sk] score matrix never materializes (O(S) memory instead of O(S^2)).
+- score matmuls hit the MXU with fp32 accumulation (preferred_element_type),
+  tiles default 128x128 — the MXU's native shape.
+- causal masking skips whole future K-blocks (the loop bound shrinks per
+  Q-block), halving the work for causal models rather than masking it.
+
+Backward is blockwise JAX (custom_vjp): recompute P per K-tile from the
+saved logsumexp under lax.scan — also O(S) memory, XLA-fused matmuls. A
+Pallas backward is a later optimization; the contract (numerics + memory
+scaling) is already met.
+
+Ring attention (ops/ring_attention.py) composes with this by construction:
+its per-device block computation is the same recurrence, so the flash kernel
+can serve as its local step on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
+    # BHSD layout: q_ref [1, 1, bq, D]; k_ref/v_ref [1, 1, S, D];
+    # o_ref [1, 1, bq, D]; lse_ref [1, 1, bq, 1] — the trailing singleton
+    # keeps the block's last-two dims TPU-tileable (bq % 8 == 0, 1 == dim).
+    qi = pl.program_id(2)
+    bq = q_ref.shape[2]
+    sk = k_ref.shape[2]
+    d = q_ref.shape[-1]
+    q = q_ref[0, 0]  # [bq, D]
+
+    acc = jnp.zeros((bq, d), jnp.float32)
+    m = jnp.full((bq,), _NEG, jnp.float32)
+    l = jnp.zeros((bq,), jnp.float32)
+
+    if causal:
+        # K-blocks strictly past this Q-tile's last row contribute nothing
+        num_kb = pl.cdiv((qi + 1) * bq, block_k)
+    else:
+        num_kb = sk // block_k
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]  # [bk, D]
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0
+            )
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc, m, l))
+    l = jnp.maximum(l, 1e-20)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, None]
+
+
+def _flash_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool, block_q: int, block_k: int, interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(
+            f"sequence length {s} must be divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    scale = 1.0 / (d ** 0.5)
+    grid = (b, h, s // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, causal=causal, scale=scale
+    )
+    # BSHD -> BHSD so the S/D dims are the TPU-tiled trailing pair
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2), lse[..., 0]
+
+
+def _bwd_blockwise(res, g, *, causal: bool, block_k: int):
+    """Blockwise JAX backward: recompute P tile-by-tile from the saved
+    logsumexp (standard flash-attention backward), O(S) memory."""
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    block_k = min(block_k, s)
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    # delta[b,h,i] = rowsum(dO * O)
+    delta = jnp.einsum("bshd,bshd->bhs", gf, out.astype(jnp.float32))
+    q_pos = jnp.arange(s)
+
+    def step(carry, kb):
+        dq = carry
+        sl = jax.lax.dynamic_slice_in_dim(kf, kb * block_k, block_k, axis=1)
+        vl = jax.lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, axis=1)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, sl,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            cols = kb * block_k + jnp.arange(block_k)
+            logits = jnp.where(q_pos[:, None] >= cols[None, :], logits, _NEG)
+        p = jnp.exp(logits - lse[..., None])  # [b,h,Sq,bk]
+        dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vl)
+        ds = p * (dp - delta[..., None])  # [b,h,Sq,bk]
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, sl) * scale
+        dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        return dq, (dk, dv)
+
+    n_kb = s // block_k
+    dq0 = jnp.zeros_like(qf)
+    dq, (dks, dvs) = jax.lax.scan(step, dq0, jnp.arange(n_kb))
+    dk = dks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
+    dv = dvs.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """softmax(QK^T/sqrt(d))V over [B, S, H, D], O(S) memory."""
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    return _bwd_blockwise(res, g, causal=causal, block_k=block_k)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,162 @@
+"""Ring attention — sequence/context parallelism over the 'seq' mesh axis.
+
+Long-context capability (absent from the reference, SURVEY.md §5; mandated
+by the framework goals): the sequence dimension is sharded across chips, so
+max context scales linearly with the ring size. Each device keeps its Q
+shard resident and computes blockwise attention against the KV shard it
+currently holds, while `jax.lax.ppermute` rotates the KV shards one hop
+around the ring per step — compute overlaps the ICI transfer (XLA schedules
+the collective-permute concurrently with the matmuls; on TPU the permute
+rides neighbor ICI links, the topology ring attention was designed for).
+
+Math: the standard online-softmax accumulation (same recurrence the flash
+kernel uses) in fp32 —
+
+    m' = max(m, rowmax(S));  o' = o*e^(m-m') + e^(S-m') V;  l' = l*e^(m-m') + rowsum(e^(S-m'))
+
+which yields exactly softmax(QK^T)V after the last ring step, so numerics
+match ops/attention.reference_attention to float tolerance regardless of
+ring size (tests/test_ring_attention.py asserts this).
+
+Masks: `causal` and key-padding masks ([B,1,1,S], ops/attention.padding_mask)
+are supported — the padding row rotates with its KV shard; arbitrary dense
+[B,H,Sq,Sk] masks are not (they would have to be sharded along two axes at
+once).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30  # finite -inf stand-in: keeps exp() NaN-free on fully-masked blocks
+
+
+def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal):
+    """One online-softmax accumulation step against the current KV block."""
+    o, m, l = carry
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if kv_valid is not None:
+        s = jnp.where(kv_valid[:, None, None, :], s, _NEG)
+    if causal:
+        allowed = q_pos[:, None] >= k_pos[None, :]  # [sq, sk] global positions
+        s = jnp.where(allowed[None, None], s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))            # [b,h,sq]
+    p = jnp.exp(s - m_new[..., None])                      # [b,h,sq,sk]
+    corr = jnp.exp(m - m_new)                              # [b,h,sq]
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv    # [b,sq,h,d]
+    return o_new, m_new, l_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    mesh: Optional[Mesh] = None,
+    axis: str = "seq",
+) -> jax.Array:
+    """[B, S, H, D] attention with S sharded over `axis` of `mesh`.
+
+    Global arrays in, global arrays out — call it like any attention; the
+    shard_map inside binds the mesh axes. Degrades to a single local block
+    (i.e. plain blockwise attention) when the mesh has no 'seq' axis.
+    """
+    if mesh is None or axis not in mesh.axis_names:
+        raise ValueError(
+            f"ring_attention needs a mesh with a {axis!r} axis; use "
+            "ops.attention.attention(impl='reference') otherwise"
+        )
+    kv_valid = None
+    if mask is not None:
+        if mask.ndim != 4 or mask.shape[1] != 1 or mask.shape[2] != 1:
+            raise NotImplementedError(
+                "ring attention supports key-padding masks [B,1,1,S] only"
+            )
+        kv_valid = mask[:, 0, 0, :].astype(jnp.bool_)
+
+    batch = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    batch = batch if batch else None
+    heads = "tensor" if "tensor" in mesh.axis_names else None
+    qkv_spec = P(batch, axis, heads, None)
+    valid_spec = P(batch, axis)
+
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(q, k, v, kv_valid):
+        idx = jax.lax.axis_index(axis)
+        sq = q.shape[1]
+        out_dtype = q.dtype
+        q_pos = idx * sq + jnp.arange(sq)
+        b, _, h, d = q.shape
+        # mark the accumulators device-varying over the ring axis up front,
+        # or the fori_loop carry type check rejects the first iteration
+        o, m, l = jax.lax.pcast(
+            (
+                jnp.zeros((b, sq, h, d), jnp.float32),
+                jnp.full((b, h, sq), _NEG, jnp.float32),
+                jnp.zeros((b, h, sq), jnp.float32),
+            ),
+            tuple(mesh.axis_names),  # q/k/v vary over every mesh axis
+            to="varying",
+        )
+
+        def body(t, carry):
+            o_m_l, k, v, kv_valid = carry
+            src = (idx - t) % n  # whose KV shard we hold at step t
+            k_pos = src * sq + jnp.arange(sq)
+            o_m_l = _block_attention(
+                o_m_l, q, k, v, kv_valid, q_pos, k_pos, causal
+            )
+            # rotate KV one hop; skipped after the last accumulation
+            def rotate(args):
+                k, v, kv_valid = args
+                k = jax.lax.ppermute(k, axis, perm)
+                v = jax.lax.ppermute(v, axis, perm)
+                if kv_valid is not None:
+                    kv_valid = jax.lax.ppermute(kv_valid, axis, perm)
+                return k, v, kv_valid
+
+            k, v, kv_valid = jax.lax.cond(
+                t < n - 1, rotate, lambda args: args, (k, v, kv_valid)
+            )
+            return o_m_l, k, v, kv_valid
+
+        (o, m, l), _, _, _ = jax.lax.fori_loop(
+            0, n, body, ((o, m, l), k, v, kv_valid)
+        )
+        l = jnp.maximum(l, 1e-20)  # fully-masked rows (padding) stay finite
+        out = o / l.transpose(0, 2, 1)[..., None]
+        return out.astype(out_dtype)
+
+    if kv_valid is None:
+        # thread a dummy validity plane so the shard_map signature is static
+        def local2(q, k, v):
+            return local(q, k, v, None)
+
+        fn = jax.shard_map(
+            local2, mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+        )
+        return fn(q, k, v)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, valid_spec),
+        out_specs=qkv_spec,
+    )
+    return fn(q, k, v, kv_valid)

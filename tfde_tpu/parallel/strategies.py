@@ -233,6 +233,26 @@ class TensorParallelStrategy(Strategy):
         return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
 
+class SequenceParallelStrategy(Strategy):
+    """Sequence/context parallelism: activations shard over 'seq'.
+
+    Long-context scope beyond the reference (SURVEY.md §5 "long-context:
+    entirely absent"). Params replicate (inherited); what changes is the
+    activation layout — the models' `constrain(x, batch, 'seq')` annotations
+    split the sequence dim across the ring, and ops/attention auto-dispatches
+    to ring attention (ops/ring_attention.py), whose KV rotation rides
+    neighbor ICI links. Max context length scales linearly with the 'seq'
+    axis size, which must divide the sequence length evenly.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, data: int = 1):
+        self._data = data
+        super().__init__(mesh)
+
+    def _default_mesh(self) -> Mesh:
+        return mesh_lib.make_mesh({"data": self._data, "seq": -1})
+
+
 class FSDPStrategy(Strategy):
     """Fully-sharded DP: params + opt state sharded over 'fsdp' axis.
 
