@@ -100,3 +100,15 @@ def test_matches_python_pipeline_multiset():
     nat = native.NativeBatchLoader([x, y], batch_size=8, seed=5, repeat=1)
     nat_rows = sorted(r for b in nat for r in b[1][:, 0].tolist())
     assert py_rows == nat_rows
+
+
+def test_batch_larger_than_dataset_spans_many_epochs():
+    """batch > n_rows: each batch spans 3+ epochs; per-epoch permutation
+    coverage must still hold exactly (regression: two-epoch assumption)."""
+    y = np.arange(10, dtype=np.int64).reshape(10, 1)
+    loader = native.NativeBatchLoader(
+        [y], batch_size=32, seed=3, repeat=4, copy=True
+    )
+    rows = np.concatenate([b[0][:, 0] for b in loader])
+    assert len(rows) == 40
+    np.testing.assert_array_equal(np.bincount(rows, minlength=10), 4)

@@ -37,7 +37,7 @@ def _build() -> Optional[ctypes.CDLL]:
     cache_dir.mkdir(parents=True, exist_ok=True)
     so = cache_dir / f"loader_{tag}.so"
     if not so.exists():
-        tmp = so.with_suffix(".so.tmp")
+        tmp = so.with_suffix(f".so.{os.getpid()}.tmp")  # concurrent builders
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
             str(_SRC), "-o", str(tmp),

@@ -109,27 +109,31 @@ struct Loader {
     int64_t limit = (repeat < 0) ? INT64_MAX : repeat * n_rows;
     int64_t end = std::min(start + batch, limit);
     int64_t rows = end - start;
-    // a batch spans at most two epochs; resolve both permutations up front
-    // (one lock acquisition each, none in the per-row loop)
-    int64_t first_epoch = start / n_rows;
-    std::shared_ptr<const std::vector<int64_t>> perm_a, perm_b;
+    // resolve source rows once (a batch may span many epochs when
+    // batch > n_rows); the permutation fetch locks only on epoch change
+    std::vector<int64_t> src_rows((size_t)rows);
     if (shuffle) {
-      perm_a = permutation_for(first_epoch);
-      if ((end - 1) / n_rows != first_epoch)
-        perm_b = permutation_for(first_epoch + 1);
+      int64_t cur_epoch = -1;
+      std::shared_ptr<const std::vector<int64_t>> perm;
+      for (int64_t r = 0; r < rows; ++r) {
+        int64_t g = start + r;
+        int64_t epoch = g / n_rows;
+        if (epoch != cur_epoch) {
+          perm = permutation_for(epoch);
+          cur_epoch = epoch;
+        }
+        src_rows[(size_t)r] = (*perm)[g % n_rows];
+      }
+    } else {
+      for (int64_t r = 0; r < rows; ++r)
+        src_rows[(size_t)r] = (start + r) % n_rows;
     }
     for (size_t a = 0; a < data.size(); ++a) {
       uint8_t* dst = slot.buffers[a].data();
       size_t rb = row_bytes[a];
       for (int64_t r = 0; r < rows; ++r) {
-        int64_t g = start + r;
-        int64_t offset = g % n_rows;
-        int64_t src = offset;
-        if (shuffle) {
-          const auto& p = (g / n_rows == first_epoch) ? *perm_a : *perm_b;
-          src = p[offset];
-        }
-        std::memcpy(dst + (size_t)r * rb, data[a] + (size_t)src * rb, rb);
+        std::memcpy(dst + (size_t)r * rb,
+                    data[a] + (size_t)src_rows[(size_t)r] * rb, rb);
       }
     }
     slot.rows = rows;

@@ -104,6 +104,7 @@ def attention(
         elif (
             _on_tpu()
             and q.shape[1] >= 1024
+            and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
             and mask is None
             and _have("flash_attention")
             and os.environ.get("TFDE_FLASH", "0") not in ("", "0", "false", "False")

@@ -5,13 +5,15 @@ SURVEY.md §2b "Dense/conv/BN kernel library"; here the transformer configs'
 attention gets a hand kernel where XLA's default fusion stops helping).
 
 Forward is a Pallas kernel (per /opt/skills/guides/pallas_guide.md):
-- grid (batch, heads, Sq/block_q); the Q tile stays VMEM-resident while an
-  inner fori_loop walks K/V tiles with the online-softmax recurrence — the
-  [Sq, Sk] score matrix never materializes (O(S) memory instead of O(S^2)).
+- grid (batch, heads, Sq/block_q, Sk/block_k) with K minor: one Q tile and
+  one K/V tile are VMEM-resident per step (VMEM stays O(block) at any S);
+  the online-softmax state persists in VMEM scratch across the K-tile steps
+  that revisit the same output block — the [Sq, Sk] score matrix never
+  materializes (O(S) memory instead of O(S^2)).
 - score matmuls hit the MXU with fp32 accumulation (preferred_element_type),
   tiles default 128x128 — the MXU's native shape.
-- causal masking skips whole future K-blocks (the loop bound shrinks per
-  Q-block), halving the work for causal models rather than masking it.
+- causal masking predicates whole future K-tiles off (pl.when), halving the
+  work for causal models rather than masking it.
 
 Backward is blockwise JAX (custom_vjp): recompute P per K-tile from the
 saved logsumexp under lax.scan — also O(S) memory, XLA-fused matmuls. A
@@ -35,56 +37,64 @@ from jax.experimental import pallas as pl
 _NEG = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
-    # BHSD layout: q_ref [1, 1, bq, D]; k_ref/v_ref [1, 1, S, D];
-    # o_ref [1, 1, bq, D]; lse_ref [1, 1, bq, 1] — the trailing singleton
-    # keeps the block's last-two dims TPU-tileable (bq % 8 == 0, 1 == dim).
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, causal, scale,
+):
+    # BHSD layout, grid (B, H, Sq/bq, Sk/bk) with the K dimension minor:
+    # q_ref [1, 1, bq, D]; k_ref/v_ref [1, 1, bk, D] — only one K/V tile is
+    # VMEM-resident at a time, so VMEM stays O(block) at any S. The online-
+    # softmax state (acc/m/l) lives in VMEM scratch, which persists across
+    # the kb grid steps that revisit the same (b, h, qi) output block.
     qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    num_kb = pl.num_programs(3)
     bq = q_ref.shape[2]
-    sk = k_ref.shape[2]
-    d = q_ref.shape[-1]
-    q = q_ref[0, 0]  # [bq, D]
+    bk = k_ref.shape[2]
 
-    acc = jnp.zeros((bq, d), jnp.float32)
-    m = jnp.full((bq,), _NEG, jnp.float32)
-    l = jnp.zeros((bq,), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
 
-    if causal:
-        # K-blocks strictly past this Q-tile's last row contribute nothing
-        num_kb = pl.cdiv((qi + 1) * bq, block_k)
-    else:
-        num_kb = sk // block_k
-
-    def body(kb, carry):
-        acc, m, l = carry
-        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]  # [bk, D]
-        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+    def _step():
+        q = q_ref[0, 0]  # [bq, D]
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
         if causal:
-            rows = qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0
-            )
-            cols = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1
-            )
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        m_prev = m_ref[:, 0:1]  # [bq, 1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_prev * corr + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc_new, m_new, l_new
 
-    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc, m, l))
-    l = jnp.maximum(l, 1e-20)
-    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, None]
+    if causal:
+        # K-tiles strictly past this Q-tile's last row contribute nothing
+        pl.when(kb * bk <= (qi + 1) * bq - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-20)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, 0:1] + jnp.log(l)
 
 
 def _flash_forward(
@@ -100,27 +110,37 @@ def _flash_forward(
             f"({block_q}, {block_k})"
         )
     scale = 1.0 / (d ** 0.5)
-    grid = (b, h, s // block_q)
-    kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, causal=causal, scale=scale
-    )
+    grid = (b, h, s // block_q, s // block_k)
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale)
     # BSHD -> BHSD so the S/D dims are the TPU-tiled trailing pair
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    from jax.experimental.pallas import tpu as pltpu
+
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, kb: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, kb: (bi, hi, kb, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, kb: (bi, hi, kb, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, kb: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, kb: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),    # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (col 0 used)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l (col 0 used)
         ],
         interpret=interpret,
     )(qt, kt, vt)
