@@ -1,0 +1,129 @@
+"""Mixture-of-Experts MLP with expert parallelism over the 'expert' mesh axis.
+
+Scale-up scope beyond the reference (SURVEY.md §2c: "Expert parallel: absent")
+— the framework-level capability that rounds out the parallelism families the
+mesh already names (runtime/mesh.AXIS_ORDER).
+
+TPU-first design — the GShard/Switch einsum formulation, not a gather/scatter
+one: dispatch and combine are one-hot einsums, so the whole layer is four MXU
+matmuls over static shapes (no dynamic gathers, nothing data-dependent in the
+traced graph). Expert weights are [E, ...] arrays sharded over 'expert'
+(ExpertParallelStrategy, parallel/strategies.py); the dispatch einsum crosses
+the token (data-sharded) and expert (expert-sharded) dims, and the XLA SPMD
+partitioner lowers that boundary to the all-to-all-style collectives over ICI.
+
+Capacity: each expert processes at most C = ceil(k * tokens / E * cf) tokens;
+overflow tokens are dropped by the dispatch mask (their gate mass is simply
+missing from the combine) — the residual connection around the MLP carries
+them through, the standard Switch behavior.
+
+Load-balance auxiliary loss (Switch eq. 4): E * sum_e f_e * P_e, sown into
+the 'losses' collection; training/step.py adds every sown loss to the
+objective automatically when the model mutates that collection.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tfde_tpu.parallel.axes import batch_axes, constrain
+
+
+class MoEMlp(nn.Module):
+    """Top-k routed expert MLP: fc1 -> gelu -> fc2 per expert."""
+
+    num_experts: int
+    mlp_dim: int
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        import math
+
+        b_axes = batch_axes()
+        bsz, seq, d = x.shape
+        e, k = self.num_experts, self.experts_per_token
+        n = bsz * seq
+        capacity = max(1, math.ceil(k * n / e * self.capacity_factor))
+
+        tokens = x.reshape(n, d)
+        # router in fp32 — routing decisions are precision-sensitive
+        logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="router",
+        )(tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # [n, e]
+
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [n, k]
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+        )
+
+        # position of each (token, choice) within its expert's capacity:
+        # cumsum over the flattened (choice-major) token stream
+        choice_mask = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)  # [n,k,e]
+        flat_mask = choice_mask.transpose(1, 0, 2).reshape(k * n, e)
+        pos = jnp.cumsum(flat_mask, axis=0) * flat_mask - flat_mask  # 0-based
+        within = pos < capacity
+        flat_mask = flat_mask * within
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity) * flat_mask[..., None]
+        # dispatch/combine [n, e, c]
+        pos_oh = pos_oh.reshape(k, n, e, capacity)
+        gates = gate_vals.transpose(1, 0)[..., None, None]  # [k, n, 1, 1]
+        dispatch = jnp.sum(pos_oh, axis=0)
+        combine = jnp.sum(pos_oh * gates, axis=0)
+
+        # Switch load-balance aux loss: fraction routed x mean prob, top-1
+        top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+        f = jnp.mean(top1, axis=0)
+        p = jnp.mean(probs, axis=0)
+        aux = self.aux_loss_weight * e * jnp.sum(f * p)
+        self.sow("losses", "moe_aux", aux)  # default tuple-append reduce
+
+        w1 = self.param(
+            "experts_fc1",
+            nn.initializers.lecun_normal(batch_axis=0),
+            (e, d, self.mlp_dim), jnp.float32,
+        )
+        b1 = self.param("experts_b1", nn.initializers.zeros,
+                        (e, 1, self.mlp_dim), jnp.float32)
+        w2 = self.param(
+            "experts_fc2",
+            nn.initializers.lecun_normal(batch_axis=0),
+            (e, self.mlp_dim, d), jnp.float32,
+        )
+        b2 = self.param("experts_b2", nn.initializers.zeros,
+                        (e, 1, d), jnp.float32)
+
+        xin = jnp.einsum(
+            "nec,nd->ecd", dispatch.astype(self.dtype), tokens.astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(self.dtype)
+        xin = constrain(xin, "expert")
+        h = jnp.einsum(
+            "ecd,edf->ecf", xin, w1.astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        ) + b1.astype(jnp.float32)
+        h = nn.gelu(h.astype(self.dtype))
+        h = constrain(h, "expert")
+        out_e = jnp.einsum(
+            "ecf,efd->ecd", h, w2.astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        ) + b2.astype(jnp.float32)
+        out_e = constrain(out_e.astype(self.dtype), "expert")
+        y = jnp.einsum(
+            "nec,ecd->nd", combine.astype(self.dtype), out_e,
+            preferred_element_type=jnp.float32,
+        )
+        y = y.astype(x.dtype).reshape(bsz, seq, d)
+        if self.dropout_rate > 0.0:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return constrain(y, b_axes, "seq")

@@ -113,6 +113,8 @@ class TransformerBlock(nn.Module):
     attn_impl: str = "auto"
     causal: bool = False
     norm_style: str = "pre"  # 'pre' | 'post'
+    num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
+    experts_per_token: int = 2
 
     @nn.compact
     def __call__(
@@ -133,12 +135,24 @@ class TransformerBlock(nn.Module):
             causal=self.causal,
             name="attn",
         )
-        mlp = Mlp(
-            mlp_dim=self.mlp_dim,
-            dtype=self.dtype,
-            dropout_rate=self.dropout_rate,
-            name="mlp",
-        )
+        if self.num_experts > 0:
+            from tfde_tpu.models.moe import MoEMlp
+
+            mlp = MoEMlp(
+                num_experts=self.num_experts,
+                mlp_dim=self.mlp_dim,
+                experts_per_token=self.experts_per_token,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name="moe",
+            )
+        else:
+            mlp = Mlp(
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                dropout_rate=self.dropout_rate,
+                name="mlp",
+            )
         if self.norm_style == "pre":
             y = ln(name="ln_attn")(x).astype(self.dtype)
             x = x + attn(y, mask=mask, train=train)
@@ -165,6 +179,9 @@ class Encoder(nn.Module):
     causal: bool = False
     norm_style: str = "pre"
     remat: bool = False
+    num_experts: int = 0   # > 0: MoE MLP in every `moe_every`-th block
+    experts_per_token: int = 2
+    moe_every: int = 2     # GShard convention: alternate dense / MoE
 
     @nn.compact
     def __call__(
@@ -183,6 +200,9 @@ class Encoder(nn.Module):
                 body, policy=jax.checkpoint_policies.nothing_saveable
             )
         for i in range(self.depth):
+            is_moe = (
+                self.num_experts > 0 and i % self.moe_every == self.moe_every - 1
+            )
             block = TransformerBlock(
                 num_heads=self.num_heads,
                 head_dim=self.head_dim,
@@ -192,6 +212,8 @@ class Encoder(nn.Module):
                 attn_impl=self.attn_impl,
                 causal=self.causal,
                 norm_style=self.norm_style,
+                num_experts=self.num_experts if is_moe else 0,
+                experts_per_token=self.experts_per_token,
                 name=f"block_{i}",
             )
             x = body(block, x)

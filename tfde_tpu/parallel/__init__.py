@@ -11,9 +11,16 @@ from tfde_tpu.parallel.strategies import (  # noqa: F401
     MultiWorkerMirroredStrategy,
     ParameterServerStrategy,
     FSDPStrategy,
+    TensorParallelStrategy,
+    SequenceParallelStrategy,
+    ExpertParallelStrategy,
 )
 from tfde_tpu.parallel.sharding import (  # noqa: F401
     shard_pytree_spec,
     batch_spec,
     named_sharding,
+)
+from tfde_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    stack_stage_params,
 )

@@ -233,6 +233,44 @@ class TensorParallelStrategy(Strategy):
         return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
 
+class ExpertParallelStrategy(Strategy):
+    """Expert parallelism: MoE expert weights shard over the 'expert' axis.
+
+    Scale-up scope beyond the reference (SURVEY.md §2c: "EP: absent").
+    Expert-stacked params ([num_experts, ...] leaves named ``experts_*`` by
+    models/moe.MoEMlp) split their leading dim across the axis; everything
+    else (attention, norms, router, dense blocks) replicates, and the batch
+    still splits over 'data'. The dispatch/combine einsums in the MoE layer
+    cross the token/expert sharding boundary, which XLA lowers to the
+    all-to-all-style exchange over ICI.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, data: int = 1):
+        self._data = data
+        super().__init__(mesh)
+
+    def _default_mesh(self) -> Mesh:
+        return mesh_lib.make_mesh({"data": self._data, "expert": -1})
+
+    def params_spec(self, params: Any) -> Any:
+        esize = self.mesh.shape["expert"]
+
+        def leaf_spec(path, leaf):
+            names = _path_names(path)
+            shape = getattr(leaf, "shape", ())
+            if (
+                esize > 1
+                and names
+                and names[-1].startswith("experts_")
+                and shape
+                and shape[0] % esize == 0
+            ):
+                return P("expert", *(None,) * (len(shape) - 1))
+            return P()
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
 class SequenceParallelStrategy(Strategy):
     """Sequence/context parallelism: activations shard over 'seq'.
 

@@ -27,19 +27,28 @@ from tfde_tpu.training.train_state import TrainState
 
 
 def _forward(state: TrainState, params, images, train: bool, dropout_rng=None):
+    """Returns (logits, new_batch_stats, aux_loss). aux_loss collects every
+    value the model sows into the 'losses' collection (e.g. the MoE
+    load-balance loss, models/moe.py) so routed models train correctly under
+    the default classification step too."""
     variables = {"params": params}
     if state.batch_stats:
         variables["batch_stats"] = state.batch_stats
     kwargs = {}
     if dropout_rng is not None:
         kwargs["rngs"] = {"dropout": dropout_rng}
-    if train and state.batch_stats:
+    if train:
         logits, mutated = state.apply_fn(
-            variables, images, train=True, mutable=["batch_stats"], **kwargs
+            variables, images, train=True,
+            mutable=["batch_stats", "losses"], **kwargs
         )
-        return logits, mutated.get("batch_stats", {})
+        aux = sum(
+            jnp.sum(v)
+            for v in jax.tree_util.tree_leaves(mutated.get("losses", {}))
+        )
+        return logits, mutated.get("batch_stats", state.batch_stats), aux
     logits = state.apply_fn(variables, images, train=train, **kwargs)
-    return logits, state.batch_stats
+    return logits, state.batch_stats, jnp.zeros((), jnp.float32)
 
 
 def train_step(
@@ -50,8 +59,10 @@ def train_step(
     step_rng = jax.random.fold_in(rng, state.step)
 
     def loss_fn(params):
-        logits, new_stats = _forward(state, params, images, train=True, dropout_rng=step_rng)
-        loss = losses.sparse_categorical_crossentropy(logits, labels)
+        logits, new_stats, aux = _forward(
+            state, params, images, train=True, dropout_rng=step_rng
+        )
+        loss = losses.sparse_categorical_crossentropy(logits, labels) + aux
         return loss, (logits, new_stats)
 
     (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -73,7 +84,7 @@ def eval_step(
     batches the eval set without dropping the remainder (mnist_keras:147) —
     be padded up to the mesh's batch divisor while keeping exact metrics."""
     images, labels, mask = batch
-    logits, _ = _forward(state, state.params, images, train=False)
+    logits, _, _ = _forward(state, state.params, images, train=False)
     labels1d = labels.reshape(labels.shape[:1])
     per_ex = losses.softmax_cross_entropy_with_integer_labels(logits, labels)
     correct = (jnp.argmax(logits, axis=-1) == labels1d).astype(jnp.float32)
