@@ -16,3 +16,5 @@ from tfde_tpu.models.transformer import (  # noqa: F401
 )
 from tfde_tpu.models.vit import ViT, ViT_B16, ViT_L16, ViT_S16, vit_tiny_test  # noqa: F401
 from tfde_tpu.models.bert import Bert, BertBase, BertLarge, bert_tiny_test  # noqa: F401
+from tfde_tpu.models.gpt import GPT, GPT2Small, GPT2Medium, gpt_tiny_test  # noqa: F401
+from tfde_tpu.models.moe import MoEMlp  # noqa: F401

@@ -1,0 +1,101 @@
+"""GPT-style causal language model — decoder-only transformer.
+
+Rounds out the model families (reference: CNNs only, SURVEY.md §2a; driver
+configs add ViT + BERT): the causal decoder exercises the attention paths
+the other configs don't — causal masking in the reference kernel, causal
+block-skipping in the Pallas flash kernel (ops/flash_attention.py), and
+causal ring attention for long-context (ops/ring_attention.py) — all through
+the same Encoder (models/transformer.py, pre-LN, the GPT-2 arrangement).
+
+Weight tying (GPT-2 convention): LM head = embedding transpose via
+`nn.Embed.attend`, same as models/bert.py's MLM decoder.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tfde_tpu.models.transformer import Encoder
+from tfde_tpu.parallel.axes import batch_axes, constrain
+
+
+class GPT(nn.Module):
+    """Decoder-only LM over [B, S] int token ids -> [B, S, vocab] logits."""
+
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_position: int = 1024
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "auto"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array, train: bool = False) -> jax.Array:
+        b = batch_axes()
+        seq = input_ids.shape[1]
+        wte = nn.Embed(
+            self.vocab_size, self.hidden_size, dtype=self.dtype,
+            param_dtype=jnp.float32, name="wte",
+        )
+        wpe = nn.Embed(
+            self.max_position, self.hidden_size, dtype=self.dtype,
+            param_dtype=jnp.float32, name="wpe",
+        )
+        x = wte(input_ids) + wpe(jnp.arange(seq, dtype=jnp.int32)[None, :])
+        x = constrain(x, b, "seq")
+        if self.dropout_rate > 0.0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = Encoder(
+            depth=self.depth,
+            num_heads=self.num_heads,
+            head_dim=self.hidden_size // self.num_heads,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            dropout_rate=self.dropout_rate,
+            attn_impl=self.attn_impl,
+            causal=True,
+            remat=self.remat,
+            name="decoder",
+        )(x, train=train)
+        logits = wte.attend(x.astype(self.dtype)).astype(jnp.float32)
+        return constrain(logits, b, "seq", "tensor")
+
+
+GPT2Small = functools.partial(
+    GPT, hidden_size=768, depth=12, num_heads=12, mlp_dim=3072
+)
+GPT2Medium = functools.partial(
+    GPT, hidden_size=1024, depth=24, num_heads=16, mlp_dim=4096,
+)
+
+
+def gpt_tiny_test(**kw) -> GPT:
+    """CI config for the 8-device CPU mesh (SURVEY.md §4)."""
+    return GPT(
+        vocab_size=97, hidden_size=32, depth=2, num_heads=4, mlp_dim=64,
+        max_position=64, dtype=jnp.float32, **kw,
+    )
+
+
+def next_token_loss(state, params, batch, rng):
+    """(loss, metrics) for make_custom_train_step: shifted CE over all
+    positions (predict token t+1 from prefix <= t)."""
+    from tfde_tpu.ops.losses import masked_lm_loss
+
+    (tokens,) = batch if isinstance(batch, tuple) else (batch,)
+    logits = state.apply_fn(
+        {"params": params}, tokens, train=True, rngs={"dropout": rng}
+    )
+    # align: logits[:, :-1] predict tokens[:, 1:]
+    labels = tokens[:, 1:].astype(jnp.int32)
+    loss, acc = masked_lm_loss(logits[:, :-1], labels)
+    return loss, {"next_token_accuracy": acc}
