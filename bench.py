@@ -1,42 +1,93 @@
-"""Headline benchmark: prints ONE JSON line for the driver.
+"""Headline benchmark: prints ONE JSON line for the driver — always.
 
-Config benchmarked: the reference's richest training path — the BN-CNN of
-mnist_keras_distributed.py:67-120 at its train batch size 128
-(tf2_mnist_distributed.py:33), SGD, sparse-CE loss — as a fully jitted
-data-parallel train step over all available chips (one step == one global
-batch of 128 images, the observable unit of the reference's hot loop,
-SURVEY.md §3.1).
+Two-process design (round-2 hardening per VERDICT.md "What's weak" #1):
 
-Metric: images/sec/chip (BASELINE.json "metric"). The reference publishes no
-numbers (BASELINE.md: "published": {}), so `vs_baseline` is measured against
-REFERENCE_ESTIMATE below — a documented estimate of the reference TF stack's
-single-GPU throughput for this model/batch (TF1-era Keras MNIST CNN at bs=128
-on the K80/P100-class hardware the scripts target: ~10k images/s).
+- **Driver mode** (`python bench.py`, no jax import): runs the measurement as
+  a subprocess (`python bench.py --run`) and retries with exponential backoff
+  when the TPU backend comes up `UNAVAILABLE` (the round-1 failure:
+  `BENCH_r01.json` rc=1 at the first `jax.local_devices()` call). A failed
+  backend init poisons the in-process jax backend cache, so each attempt gets
+  a fresh interpreter. On final failure the driver STILL prints one parseable
+  JSON line with an `"error"` field and the last attempt's stderr tail.
+- **Run mode** (`--run`): brings up jax, refuses a silent CPU fallback
+  (platform is recorded and cpu is an error unless TFDE_BENCH_ALLOW_CPU=1),
+  and measures two configs:
+
+  1. The reference's richest training path — the BN-CNN of
+     mnist_keras_distributed.py:67-120 at its train batch 128
+     (tf2_mnist_distributed.py:33), SGD, sparse-CE — as a jitted DP train
+     step. Metric: images/sec/chip. `vs_baseline` divides by
+     REFERENCE_ESTIMATE (the reference publishes nothing, BASELINE.md).
+  2. A compute-bound config: BERT-base MLM fwd+bwd at bf16, seq 512 —
+     reported as **MFU = achieved matmul FLOPs / chip peak** (`bert_mfu`
+     field) plus tokens/sec/chip. FLOPs are computed analytically from the
+     model dims (training = 3x forward — the "6N" params convention —
+     attention matmuls included); chip peak comes from the device_kind table
+     below.
+
+Env knobs: TFDE_BENCH_BUDGET_S (total retry budget, default 900),
+TFDE_BENCH_ATTEMPT_TIMEOUT_S (per attempt, default 600),
+TFDE_BENCH_ALLOW_CPU=1 (let the measurement run on cpu and say so).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
-
-REFERENCE_ESTIMATE = 10_000.0  # images/sec, see module docstring
+REFERENCE_ESTIMATE = 10_000.0  # images/sec; see module docstring
 GLOBAL_BATCH = 128             # tf2_mnist_distributed.py:33
-WARMUP_STEPS = 20
-TIMED_STEPS = 400
+
+# Peak bf16 matmul FLOP/s per chip, keyed by substrings of
+# jax.Device.device_kind (public figures; first match wins).
+PEAK_FLOPS = [
+    ("v6", 918e12),  # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+DEFAULT_PEAK = 275e12
 
 
-def main() -> None:
+def chip_peak_flops(device_kind: str) -> tuple[float, bool]:
+    kind = device_kind.lower()
+    for sub, peak in PEAK_FLOPS:
+        if sub in kind:
+            return peak, True
+    return DEFAULT_PEAK, False
+
+
+def bert_train_flops_per_token(hidden: int, mlp: int, depth: int,
+                               seq: int, vocab: int) -> float:
+    """Analytic matmul FLOPs per token for one fwd+bwd MLM step.
+
+    fwd per layer per token: qkvo 2*4H^2, mlp 2*2HF, attention matmuls
+    (scores + values) 2*2SH. Plus the MLM transform dense 2H^2 and the tied
+    decoder 2HV. Training = 3x forward (backward is 2x).
+    """
+    per_layer = 8 * hidden * hidden + 4 * hidden * mlp + 4 * seq * hidden
+    fwd = depth * per_layer + 2 * hidden * hidden + 2 * hidden * vocab
+    return 3.0 * fwd
+
+
+# --------------------------------------------------------------------------
+# Run mode: the actual measurement (fresh interpreter per attempt).
+# --------------------------------------------------------------------------
+
+def _bench_mnist(strategy, n_chips: int, smoke: bool = False) -> dict:
     import jax
+    import numpy as np
     import optax
 
     from tfde_tpu.models.cnn import BatchNormCNN
-    from tfde_tpu.parallel.strategies import MirroredStrategy
     from tfde_tpu.training.step import init_state, make_train_step
-
-    strategy = MirroredStrategy()
-    n_chips = strategy.num_replicas
 
     model = BatchNormCNN()
     tx = optax.sgd(0.01)
@@ -52,25 +103,272 @@ def main() -> None:
     labels = jax.device_put(labels, batch_sh)
     key = jax.random.key(0)
 
-    for _ in range(WARMUP_STEPS):
-        state, metrics = step_fn(state, (images, labels), key)
+    warmup, timed = (3, 20) if smoke else (20, 400)
+    for _ in range(warmup):
+        state, _ = step_fn(state, (images, labels), key)
     jax.block_until_ready(state.params)
 
     t0 = time.perf_counter()
-    for _ in range(TIMED_STEPS):
-        state, metrics = step_fn(state, (images, labels), key)
+    for _ in range(timed):
+        state, _ = step_fn(state, (images, labels), key)
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 
-    images_per_sec = TIMED_STEPS * GLOBAL_BATCH / dt
-    per_chip = images_per_sec / n_chips
-    print(json.dumps({
+    per_chip = timed * GLOBAL_BATCH / dt / n_chips
+    return {
+        "mnist_images_per_sec_per_chip": round(per_chip, 1),
+        "mnist_step_ms": round(dt / timed * 1e3, 3),
+    }
+
+
+def _bench_bert_mfu(strategy, n_chips: int, device_kind: str,
+                    smoke: bool = False) -> dict:
+    import jax
+    import numpy as np
+    import optax
+
+    from tfde_tpu.models.bert import Bert, BertBase
+    from tfde_tpu.ops import losses
+    from tfde_tpu.training.step import init_state, make_custom_train_step
+
+    if smoke:  # CPU-sized config: validates the path, not a real number
+        seq, per_chip_batch = 128, 2
+        model = Bert(vocab_size=1024, hidden_size=128, depth=2, num_heads=4,
+                     mlp_dim=256, dropout_rate=0.0, pad_vocab=True)
+        warmup, timed = 1, 3
+    else:
+        seq, per_chip_batch = 512, 16
+        model = BertBase(dropout_rate=0.0, pad_vocab=True)
+        warmup, timed = 3, 20
+    dims = (model.hidden_size, model.mlp_dim, model.depth)
+    global_batch = per_chip_batch * n_chips
+    vocab = model.padded_vocab
+
+    def loss_fn(state, params, batch, rng):
+        input_ids, labels = batch
+        logits = state.apply_fn({"params": params}, input_ids, train=True,
+                                rngs={"dropout": rng})
+        loss, acc = losses.masked_lm_loss(logits, labels)
+        return loss, {"mlm_accuracy": acc}
+
+    tx = optax.adamw(1e-4)
+    sample = np.zeros((global_batch, seq), np.int32)
+    state, _ = init_state(model, tx, strategy, sample, seed=0)
+    step_fn = make_custom_train_step(strategy, state, loss_fn)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size, (global_batch, seq)).astype(np.int32)
+    labels = np.full((global_batch, seq), -100, np.int32)
+    labels[:, ::7] = ids[:, ::7]  # ~15% positions predicted
+    key = jax.random.key(0)
+
+    for _ in range(warmup):
+        state, _ = step_fn(state, (ids, labels), key)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        state, _ = step_fn(state, (ids, labels), key)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    step_s = dt / timed
+    tokens_per_step = global_batch * seq
+    hidden, mlp, depth = dims
+    flops_per_token = bert_train_flops_per_token(hidden, mlp, depth, seq, vocab)
+    achieved = tokens_per_step * flops_per_token / step_s / n_chips
+    peak, known = chip_peak_flops(device_kind)
+    return {
+        "bert_mfu": round(achieved / peak, 4),
+        "bert_tokens_per_sec_per_chip": round(tokens_per_step / step_s / n_chips, 1),
+        "bert_step_ms": round(step_s * 1e3, 2),
+        "bert_achieved_tflops_per_chip": round(achieved / 1e12, 2),
+        "chip_peak_tflops": round(peak / 1e12, 1),
+        "chip_peak_known": known,
+    }
+
+
+def run_mode() -> None:
+    import jax
+
+    if os.environ.get("TFDE_BENCH_FORCE_CPU") == "1":
+        # jax.config (not the env var): the axon site shim intercepts
+        # backend bring-up when JAX_PLATFORMS is consulted and can hang on a
+        # dead tunnel; the lazy-config route sidesteps it (same trick as
+        # tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+        os.environ["TFDE_BENCH_ALLOW_CPU"] = "1"
+
+    devices = jax.local_devices()
+    platform = devices[0].platform
+    device_kind = str(devices[0].device_kind)
+    if platform == "cpu" and os.environ.get("TFDE_BENCH_ALLOW_CPU") != "1":
+        print(json.dumps({"error": "backend came up as cpu; refusing a "
+                          "silent-fallback number (set TFDE_BENCH_ALLOW_CPU=1 "
+                          "to override)", "platform": platform}))
+        sys.exit(3)
+
+    from tfde_tpu.parallel.strategies import MirroredStrategy
+
+    strategy = MirroredStrategy()
+    n_chips = strategy.num_replicas
+    print(f"platform={platform} kind={device_kind} chips={n_chips}",
+          file=sys.stderr)
+
+    smoke = os.environ.get("TFDE_BENCH_SMOKE") == "1"
+    result = {"platform": platform, "device_kind": device_kind,
+              "n_chips": n_chips}
+    if smoke:
+        result["smoke"] = True
+    result.update(_bench_mnist(strategy, n_chips, smoke))
+    print(f"mnist done: {result}", file=sys.stderr)
+    try:
+        result.update(_bench_bert_mfu(strategy, n_chips, device_kind, smoke))
+    except Exception as e:  # OOM on small chips etc. — keep the mnist number
+        result["bert_error"] = f"{type(e).__name__}: {e}"[:400]
+    print(f"bert done: {result}", file=sys.stderr)
+
+    per_chip = result["mnist_images_per_sec_per_chip"]
+    line = {
         "metric": "mnist_bncnn_train_images_per_sec_per_chip",
-        "value": round(per_chip, 1),
+        "value": per_chip,
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / REFERENCE_ESTIMATE, 3),
+        **result,
+    }
+    print(json.dumps(line))
+
+
+# --------------------------------------------------------------------------
+# Driver mode: retry loop, no jax in this process.
+# --------------------------------------------------------------------------
+
+def probe_mode() -> None:
+    """Fast backend check: bring up jax, print one JSON line, exit."""
+    import jax
+
+    devices = jax.local_devices()
+    print(json.dumps({"ok": True, "platform": devices[0].platform,
+                      "n": len(devices)}))
+
+
+def _last_json(stdout: str) -> dict | None:
+    """Last stdout line that parses as a JSON object, or None."""
+    for ln in reversed((stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return None
+
+
+def _backend_probe(timeout_s: float) -> tuple[str, str]:
+    """('up'|'cpu_only'|'down', detail) for a fresh-interpreter backend check.
+
+    The round-1 failure raised UNAVAILABLE at the first device query; the
+    failure observed while building round 2 *hangs* there instead (tunnel
+    never answers). Probing in a 2-minute subprocess keeps either mode from
+    eating the whole benchmark budget before we know the backend is up.
+    'cpu_only' is permanent (no TPU plugin on this host) — don't retry it.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return "down", "probe hang: backend init did not answer"
+    parsed = _last_json(proc.stdout)
+    if parsed and parsed.get("ok"):
+        if parsed.get("platform") == "cpu" and \
+                os.environ.get("TFDE_BENCH_ALLOW_CPU") != "1":
+            return "cpu_only", "backend came up as cpu only"
+        return "up", parsed.get("platform", "?")
+    return "down", (proc.stderr or "")[-800:]
+
+
+def driver_mode() -> None:
+    budget = float(os.environ.get("TFDE_BENCH_BUDGET_S", "900"))
+    attempt_timeout = float(os.environ.get("TFDE_BENCH_ATTEMPT_TIMEOUT_S", "600"))
+    probe_timeout = float(os.environ.get("TFDE_BENCH_PROBE_TIMEOUT_S", "120"))
+    skip_probe = os.environ.get("TFDE_BENCH_FORCE_CPU") == "1"
+    deadline = time.monotonic() + budget
+    backoff = 15.0
+    attempt = 0
+    last_tail = ""
+    last_rc: object = None
+
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 30:
+            break
+        attempt += 1
+        print(f"[bench driver] attempt {attempt} "
+              f"(remaining budget {remaining:.0f}s)", file=sys.stderr)
+        if not skip_probe:
+            status, detail = _backend_probe(min(probe_timeout, remaining))
+            if status == "cpu_only":
+                last_rc, last_tail = "cpu_only", detail
+                break  # permanent on this host; don't burn the budget
+            if status == "down":
+                last_rc, last_tail = "probe_failed", detail
+                sleep = min(backoff, max(deadline - time.monotonic() - 60, 0))
+                print(f"[bench driver] backend probe failed ({detail[:200]}); "
+                      f"retrying in {sleep:.0f}s", file=sys.stderr)
+                if sleep > 0:
+                    time.sleep(sleep)
+                backoff = min(backoff * 2, 120)
+                continue
+            print(f"[bench driver] backend up: {detail}", file=sys.stderr)
+            remaining = deadline - time.monotonic()  # probe time is spent
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run"],
+                capture_output=True, text=True,
+                timeout=max(min(attempt_timeout, remaining), 30),
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            last_rc = proc.returncode
+            sys.stderr.write(proc.stderr[-4000:])
+            last_tail = (proc.stderr or "")[-1500:]
+            parsed = _last_json(proc.stdout)
+            if parsed and "metric" in parsed:
+                print(json.dumps(parsed))
+                return
+            if parsed and "error" in parsed:
+                last_tail = parsed["error"]
+        except subprocess.TimeoutExpired as e:
+            last_rc = "timeout"
+            last_tail = ((e.stderr or b"")[-1500:].decode("utf-8", "replace")
+                         if isinstance(e.stderr, bytes) else str(e.stderr)[-1500:])
+            print(f"[bench driver] attempt timed out", file=sys.stderr)
+
+        sleep = min(backoff, max(deadline - time.monotonic() - 60, 0))
+        if sleep > 0:
+            print(f"[bench driver] backend not ready (rc={last_rc}); "
+                  f"retrying in {sleep:.0f}s", file=sys.stderr)
+            time.sleep(sleep)
+        backoff = min(backoff * 2, 120)
+
+    print(json.dumps({
+        "metric": "mnist_bncnn_train_images_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": f"TPU backend unavailable after {attempt} attempts "
+                 f"within {budget:.0f}s budget",
+        "last_rc": last_rc,
+        "last_stderr_tail": last_tail,
     }))
+    sys.exit(0)  # the JSON line IS the deliverable; don't hand back a traceback rc
 
 
 if __name__ == "__main__":
-    main()
+    if "--run" in sys.argv:
+        run_mode()
+    elif "--probe" in sys.argv:
+        probe_mode()
+    else:
+        driver_mode()
