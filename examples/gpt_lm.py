@@ -1,14 +1,22 @@
 """GPT-style causal-LM pretraining — decoder-only, data-parallel with
-optional sequence parallelism for long context.
+optional sequence parallelism for long context and optional pipeline
+parallelism for deep stacks.
 
 The long-context entrypoint: `--seq-parallel N` shards the sequence over an
 N-way 'seq' mesh axis and attention auto-dispatches to ring attention
 (ops/ring_attention.py) — max context scales linearly with N. On a single
 chip, long sequences use the Pallas flash kernel when TFDE_FLASH=1.
 
+`--pipeline S` switches to the stage-stacked PipelinedLM
+(models/pipelined.py) on a {'data': D, 'pipe': S} mesh: each pipe rank holds
+depth/S transformer blocks and microbatches (--microbatches) flow through
+the GPipe schedule via ppermute (parallel/pipeline.py).
+
 Run single-host: python examples/gpt_lm.py --max-steps 200
 CPU smoke:       python examples/gpt_lm.py --fake-devices 8 --tiny \
                      --seq-len 32 --max-steps 2 --batch-size 16 --seq-parallel 2
+Pipeline smoke:  python examples/gpt_lm.py --fake-devices 8 --tiny \
+                     --seq-len 32 --max-steps 2 --batch-size 16 --pipeline 2
 """
 
 from __future__ import annotations
@@ -43,6 +51,10 @@ def main(argv=None):
     parser.add_argument("--train-examples", type=int, default=8192)
     parser.add_argument("--seq-parallel", type=int, default=0,
                         help="size of the 'seq' mesh axis (ring attention)")
+    parser.add_argument("--pipeline", type=int, default=0,
+                        help="size of the 'pipe' mesh axis (GPipe stages)")
+    parser.add_argument("--microbatches", type=int, default=4,
+                        help="GPipe microbatches (with --pipeline)")
     parser.add_argument("--tiny", action="store_true")
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--fake-devices", type=int, default=None)
@@ -55,9 +67,30 @@ def main(argv=None):
     info = bootstrap()
     global_batch = args.batch_size * max(info.num_processes, 1)
 
-    model = gpt_tiny_test(remat=args.remat) if args.tiny else GPT2Small(
-        remat=args.remat
-    )
+    if args.pipeline > 1 and args.seq_parallel > 1:
+        raise ValueError("--pipeline and --seq-parallel don't compose yet")
+    if args.pipeline > 1:
+        from tfde_tpu.models.pipelined import PipelinedLM, pipelined_tiny_test
+
+        if args.tiny:
+            model = pipelined_tiny_test(
+                num_stages=args.pipeline, microbatches=args.microbatches,
+                remat=args.remat,
+            )
+        else:
+            # GPT-2 small dims, depth 12 split across the stages
+            if 12 % args.pipeline:
+                raise ValueError("--pipeline must divide depth 12")
+            model = PipelinedLM(
+                num_stages=args.pipeline,
+                layers_per_stage=12 // args.pipeline,
+                microbatches=args.microbatches,
+                remat=args.remat,
+            )
+    else:
+        model = gpt_tiny_test(remat=args.remat) if args.tiny else GPT2Small(
+            remat=args.remat
+        )
     if args.seq_len % max(args.seq_parallel, 1) != 0:
         raise ValueError("--seq-len must divide evenly by --seq-parallel")
 
@@ -72,8 +105,24 @@ def main(argv=None):
     )
     tx = optax.adamw(schedule, weight_decay=0.1)
 
-    if args.seq_parallel > 1:
+    if args.pipeline > 1:
+        from tfde_tpu.parallel.strategies import PipelineParallelStrategy
+
         n = jax.device_count()
+        if n % args.pipeline:
+            raise ValueError(
+                f"--pipeline {args.pipeline} must divide the device count {n}"
+            )
+        strategy = PipelineParallelStrategy(
+            data=n // args.pipeline, pipe=args.pipeline
+        )
+    elif args.seq_parallel > 1:
+        n = jax.device_count()
+        if n % args.seq_parallel:
+            raise ValueError(
+                f"--seq-parallel {args.seq_parallel} must divide the device "
+                f"count {n}"
+            )
         strategy = SequenceParallelStrategy(data=n // args.seq_parallel)
     else:
         strategy = MultiWorkerMirroredStrategy()

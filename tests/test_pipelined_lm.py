@@ -1,0 +1,116 @@
+"""Pipeline-parallel LM tests: the pipelined execution path must be
+numerically identical to (a) the sequential scan fallback and (b) plain DP
+training — the TPU-native analog of the reference's requirement that a
+distribution strategy not change the math (SURVEY.md §2c; VERDICT round-1
+item 3: "test training a small GPT at pipe=2 to DP-identical numerics")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tfde_tpu.models.gpt import next_token_loss
+from tfde_tpu.models.pipelined import PipelinedLM, pipelined_tiny_test
+from tfde_tpu.parallel.strategies import (
+    MultiWorkerMirroredStrategy,
+    PipelineParallelStrategy,
+)
+from tfde_tpu.runtime.mesh import make_mesh
+from tfde_tpu.training.step import init_state, make_custom_train_step
+
+
+@pytest.fixture(scope="module")
+def model():
+    return pipelined_tiny_test()
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.default_rng(0)
+    return rng.integers(0, 97, (16, 32)).astype(np.int32)
+
+
+def test_pipelined_forward_matches_sequential(model, tokens):
+    """Same params, same tokens: pipe=2 logits == no-mesh sequential logits."""
+    from tfde_tpu.parallel import axes as axes_lib
+
+    variables = model.init(jax.random.key(0), tokens)
+    seq_logits = jax.jit(
+        lambda v, t: model.apply(v, t)
+    )(variables, tokens)
+
+    mesh = make_mesh({"data": 2, "pipe": 2}, jax.devices()[:4])
+
+    def pipe_forward(v, t):
+        with axes_lib.use_axes(mesh):
+            return model.apply(v, t)
+
+    pipe_logits = jax.jit(pipe_forward)(variables, tokens)
+    np.testing.assert_allclose(
+        np.asarray(pipe_logits), np.asarray(seq_logits), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_pipelined_train_matches_dp(model, tokens):
+    """5 AdamW steps at pipe=2 x data=2 == 5 steps at data=4 (exact math,
+    fp32 tolerance)."""
+    strat_p = PipelineParallelStrategy(data=2, pipe=2)
+    state_p, _ = init_state(model, optax.adam(1e-3), strat_p, tokens)
+    step_p = make_custom_train_step(strat_p, state_p, next_token_loss,
+                                    donate=False)
+
+    strat_d = MultiWorkerMirroredStrategy(
+        make_mesh({"data": 4}, jax.devices()[:4])
+    )
+    state_d, _ = init_state(model, optax.adam(1e-3), strat_d, tokens)
+    step_d = make_custom_train_step(strat_d, state_d, next_token_loss,
+                                    donate=False)
+
+    rng = jax.random.key(0)
+    for _ in range(5):
+        state_p, m_p = step_p(state_p, (tokens,), rng)
+        state_d, m_d = step_d(state_d, (tokens,), rng)
+    np.testing.assert_allclose(
+        float(m_p["loss"]), float(m_d["loss"]), rtol=2e-5
+    )
+    assert float(m_p["loss"]) < 4.6  # loss actually moved off init (~ln 97)
+
+
+def test_stage_params_sharded_over_pipe(model, tokens):
+    """Each pipe rank must hold only its stage's weights — the memory point
+    of pipelining (round-1 VERDICT: replicated microbatches/stages defeat
+    it)."""
+    strat = PipelineParallelStrategy(data=2, pipe=2)
+    state, _ = init_state(model, optax.adam(1e-3), strat, tokens)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+        state.params["stages"]
+    ):
+        spec = leaf.sharding.spec
+        assert spec and spec[0] == "pipe", (
+            f"stage leaf {jax.tree_util.keystr(path)} not sharded over "
+            f"'pipe': {spec}"
+        )
+    # embedding + head stay replicated
+    assert state.params["wte"].sharding.spec == ()
+    # optimizer state follows params: stage moments sharded too
+    mu = state.opt_state[0].mu["stages"]
+    leaf = jax.tree_util.tree_leaves(mu)[0]
+    assert leaf.sharding.spec[0] == "pipe"
+
+
+def test_microbatch_divisibility_error(model):
+    strat = PipelineParallelStrategy(data=1, pipe=2)
+    bad = np.zeros((6, 32), np.int32)  # 6 % microbatches(4) != 0
+    state, _ = init_state(model, optax.adam(1e-3), strat,
+                          np.zeros((8, 32), np.int32))
+    step = make_custom_train_step(strat, state, next_token_loss, donate=False)
+    with pytest.raises(ValueError, match="microbatches"):
+        step(state, (bad,), jax.random.key(0))
+
+
+def test_pipelined_respects_max_position(model):
+    too_long = np.zeros((8, 128), np.int32)
+    variables = model.init(jax.random.key(0), np.zeros((8, 32), np.int32))
+    with pytest.raises(ValueError, match="max_position"):
+        model.apply(variables, too_long)

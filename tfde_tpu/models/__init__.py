@@ -18,3 +18,4 @@ from tfde_tpu.models.vit import ViT, ViT_B16, ViT_L16, ViT_S16, vit_tiny_test  #
 from tfde_tpu.models.bert import Bert, BertBase, BertLarge, bert_tiny_test  # noqa: F401
 from tfde_tpu.models.gpt import GPT, GPT2Small, GPT2Medium, gpt_tiny_test  # noqa: F401
 from tfde_tpu.models.moe import MoEMlp  # noqa: F401
+from tfde_tpu.models.pipelined import PipelinedLM, pipelined_tiny_test  # noqa: F401

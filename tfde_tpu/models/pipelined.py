@@ -1,0 +1,200 @@
+"""Pipeline-parallel causal LM — a transformer stack executed through the
+collective pipeline (parallel/pipeline.py) over the 'pipe' mesh axis.
+
+Scale-up scope beyond the reference (SURVEY.md §2c: "Pipeline parallel:
+absent"). Where GPU frameworks place different *programs* on different
+devices and hand-schedule send/recv, the TPU-native formulation keeps one
+SPMD program: stage weights live stacked along a leading [num_stages, ...]
+axis sharded over 'pipe', and activations hop ranks via `lax.ppermute`
+(neighbor ICI traffic). See parallel/pipeline.py for the schedule.
+
+Architecture = GPT arrangement (models/gpt.py): tied embedding/LM head,
+learned positions, pre-LN TransformerBlocks, causal attention. The model is
+deliberately *mesh-agnostic*: `apply` runs the stage stack through
+`pipeline_apply` when the active mesh (parallel/axes.use_axes, set by the
+step factories) has a 'pipe' axis of size > 1, and as a plain sequential
+scan otherwise — so the same params train on a DP mesh or a pipe mesh, which
+is exactly what the pipe-vs-DP numerics test asserts
+(tests/test_pipelined_lm.py).
+
+Not an `nn.Module`: the stacked-stage param layout ([S, L, ...] leaves) is
+the load-bearing design, and flax's module system fights external param
+stacking. Instead the class duck-types `model.init(rng, sample, train=...)`
+/ `model.apply(variables, batch, train=..., rngs=...)`, which is all
+training/step.py's `init_state` + `make_custom_train_step` consume.
+
+Dropout is fixed at 0 in the pipelined stack (rngs accepted and unused):
+threading per-tick dropout keys through the shard_map schedule buys nothing
+for the LM pretraining configs this serves (GPT-2 uses dropout 0.0 at scale).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tfde_tpu.models.transformer import TransformerBlock
+from tfde_tpu.parallel import axes as axes_lib
+from tfde_tpu.parallel.pipeline import pipeline_apply
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelinedLM:
+    """Decoder-only LM over [B, S] int ids -> [B, S, vocab] fp32 logits."""
+
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_position: int = 1024
+    num_stages: int = 2
+    layers_per_stage: int = 6
+    microbatches: int = 4
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "auto"
+    remat: bool = False  # jax.checkpoint each block: HBM for FLOPs
+
+    @property
+    def depth(self) -> int:
+        return self.num_stages * self.layers_per_stage
+
+    def _block(self) -> TransformerBlock:
+        return TransformerBlock(
+            num_heads=self.num_heads,
+            head_dim=self.hidden_size // self.num_heads,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            dropout_rate=0.0,
+            attn_impl=self.attn_impl,
+            causal=True,
+            norm_style="pre",
+        )
+
+    # -- init ----------------------------------------------------------------
+    def init(self, rng, sample_tokens: jax.Array, train: bool = False) -> dict:
+        """Returns {'params': {wte, wpe, stages, ln_final}} where every leaf
+        under 'stages' is stacked [num_stages, layers_per_stage, ...]."""
+        del train
+        if self.hidden_size % self.num_heads:
+            raise ValueError("hidden_size must divide by num_heads")
+        seq = sample_tokens.shape[1]
+        if seq > self.max_position:
+            raise ValueError(f"seq {seq} > max_position {self.max_position}")
+        k_wte, k_wpe, k_blocks = jax.random.split(rng, 3)
+
+        block = self._block()
+        dummy = jnp.zeros((1, seq, self.hidden_size), self.dtype)
+        n = self.num_stages * self.layers_per_stage
+        block_keys = jax.random.split(k_blocks, n)
+        per_layer = jax.vmap(
+            lambda k: block.init(k, dummy, None, False)["params"]
+        )(block_keys)
+        stages = jax.tree_util.tree_map(
+            lambda v: v.reshape(
+                (self.num_stages, self.layers_per_stage) + v.shape[1:]
+            ),
+            per_layer,
+        )
+        params = {
+            "wte": jax.random.normal(
+                k_wte, (self.vocab_size, self.hidden_size), jnp.float32
+            ) * 0.02,
+            "wpe": jax.random.normal(
+                k_wpe, (self.max_position, self.hidden_size), jnp.float32
+            ) * 0.02,
+            "stages": stages,
+            "ln_final": {
+                "scale": jnp.ones((self.hidden_size,), jnp.float32),
+                "bias": jnp.zeros((self.hidden_size,), jnp.float32),
+            },
+        }
+        return {"params": params}
+
+    # -- apply ---------------------------------------------------------------
+    def apply(
+        self,
+        variables: dict,
+        tokens: jax.Array,
+        train: bool = False,
+        rngs: Optional[dict] = None,
+    ) -> jax.Array:
+        del rngs  # dropout fixed at 0; see module docstring
+        p = variables["params"]
+        batch, seq = tokens.shape
+        if seq > self.max_position:
+            raise ValueError(f"seq {seq} > max_position {self.max_position}")
+
+        x = jnp.take(p["wte"], tokens, axis=0)
+        x = x + p["wpe"][None, :seq]
+        x = x.astype(self.dtype)
+
+        block = self._block()
+
+        def layer_in_pipe(h, lp):
+            # use_axes(None): inside shard_map every mesh axis is manual, so
+            # the blocks' `constrain` annotations (which name full-mesh axes)
+            # must degrade to identity here.
+            with axes_lib.use_axes(None):
+                return block.apply({"params": lp}, h, None, train), None
+
+        def layer_seq(h, lp):
+            return block.apply({"params": lp}, h, None, train), None
+
+        if self.remat:
+            layer_in_pipe = jax.checkpoint(
+                layer_in_pipe, policy=jax.checkpoint_policies.nothing_saveable
+            )
+            layer_seq = jax.checkpoint(
+                layer_seq, policy=jax.checkpoint_policies.nothing_saveable
+            )
+
+        def stage_fn(stage_params, h):
+            # stage_params: [layers_per_stage, ...] pytree; scan applies the
+            # same traced block per layer — compiler-friendly, no unrolling.
+            h, _ = jax.lax.scan(layer_in_pipe, h, stage_params)
+            return h
+
+        mesh = axes_lib.current_mesh()
+        pipelined = (
+            mesh is not None
+            and "pipe" in mesh.axis_names
+            and mesh.shape["pipe"] > 1
+        )
+        if pipelined:
+            m = self.microbatches
+            if batch % m:
+                raise ValueError(
+                    f"global batch {batch} must divide by microbatches {m}"
+                )
+            xm = x.reshape((m, batch // m, seq, self.hidden_size))
+            xm = pipeline_apply(stage_fn, p["stages"], xm, mesh)
+            x = xm.reshape((batch, seq, self.hidden_size))
+        else:
+            # sequential fallback: one scan over all S*L layers
+            flat = jax.tree_util.tree_map(
+                lambda v: v.reshape((self.depth,) + v.shape[2:]), p["stages"]
+            )
+            x, _ = jax.lax.scan(layer_seq, x, flat)
+
+        # final LN in fp32, then the tied LM head (GPT-2 convention)
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        x32 = (x32 - mean) * jax.lax.rsqrt(var + 1e-6)
+        x32 = x32 * p["ln_final"]["scale"] + p["ln_final"]["bias"]
+        logits = x32.astype(self.dtype) @ p["wte"].astype(self.dtype).T
+        return logits.astype(jnp.float32)
+
+
+def pipelined_tiny_test(**kw) -> PipelinedLM:
+    """CI config for the 8-device CPU mesh (SURVEY.md §4)."""
+    defaults = dict(
+        vocab_size=97, hidden_size=32, num_heads=4, mlp_dim=64,
+        max_position=64, num_stages=2, layers_per_stage=2, microbatches=4,
+        dtype=jnp.float32,
+    )
+    defaults.update(kw)
+    return PipelinedLM(**defaults)

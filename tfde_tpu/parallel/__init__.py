@@ -11,6 +11,7 @@ from tfde_tpu.parallel.strategies import (  # noqa: F401
     MultiWorkerMirroredStrategy,
     ParameterServerStrategy,
     FSDPStrategy,
+    PipelineParallelStrategy,
     TensorParallelStrategy,
     SequenceParallelStrategy,
     ExpertParallelStrategy,

@@ -19,15 +19,24 @@ def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
+def data_axes(mesh: Mesh) -> tuple:
+    """The data-like mesh axes a batch dim splits over: ('data', 'fsdp') ∩
+    mesh, size-1 axes dropped. Single source of truth for batch_spec and the
+    pipeline's microbatch sharding (parallel/pipeline.py)."""
+    return tuple(
+        a for a in ("data", "fsdp")
+        if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+
+
 def batch_spec(mesh: Mesh, extra_dims: int = 0) -> P:
     """PartitionSpec for a [global_batch, ...] array: batch dim split over all
     data-like axes present in the mesh (data, then fsdp if present — FSDP
     shards the batch over both so that weight all-gathers amortize)."""
-    data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1)
-    if not data_axes:
+    axes = data_axes(mesh)
+    if not axes:
         return P(*(None,) * (1 + extra_dims))
-    axes = data_axes[0] if len(data_axes) == 1 else data_axes
-    return P(axes, *(None,) * extra_dims)
+    return P(axes[0] if len(axes) == 1 else axes, *(None,) * extra_dims)
 
 
 def _largest_divisible_dim(shape: Sequence[int], size: int, min_elems: int) -> Optional[int]:

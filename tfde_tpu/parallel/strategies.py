@@ -291,6 +291,61 @@ class SequenceParallelStrategy(Strategy):
         return mesh_lib.make_mesh({"data": self._data, "seq": -1})
 
 
+class PipelineParallelStrategy(Strategy):
+    """Pipeline parallelism over the 'pipe' mesh axis (GPipe schedule).
+
+    Scale-up scope beyond the reference (SURVEY.md §2c: "Pipeline parallel:
+    absent"). Pairs with models/pipelined.PipelinedLM: the model's
+    stage-stacked params ([num_stages, layers_per_stage, ...] leaves under
+    the top-level 'stages' key) shard their leading dim over 'pipe' — each
+    pipe rank holds exactly its stage's weights — while the embedding / head
+    / final-norm params replicate. The batch still splits over 'data'
+    (inherited batch_spec ignores 'pipe'), so DP composes with pipelining on
+    a {'data': D, 'pipe': S} mesh; microbatches shard over 'data' inside
+    `pipeline_apply`.
+
+    The optimizer state follows the params (inherited opt_state_spec walk),
+    so each pipe rank also owns only its stage's Adam moments.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        data: int = 1,
+        pipe: Optional[int] = None,
+    ):
+        self._data = data
+        self._pipe = pipe
+        super().__init__(mesh)
+
+    def _default_mesh(self) -> Mesh:
+        if self._pipe is not None:
+            # explicit stage count: use the first data*pipe devices so the
+            # mesh matches the model's num_stages even when the host has more
+            devices = jax.devices()[: self._data * self._pipe]
+            return mesh_lib.make_mesh(
+                {"data": self._data, "pipe": self._pipe}, devices
+            )
+        return mesh_lib.make_mesh({"data": self._data, "pipe": -1})
+
+    def params_spec(self, params: Any) -> Any:
+        psize = self.mesh.shape["pipe"]
+
+        def leaf_spec(path, leaf):
+            names = _path_names(path)
+            shape = getattr(leaf, "shape", ())
+            if (
+                psize > 1
+                and "stages" in names
+                and shape
+                and shape[0] == psize
+            ):
+                return P("pipe", *(None,) * (len(shape) - 1))
+            return P()
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
 class FSDPStrategy(Strategy):
     """Fully-sharded DP: params + opt state sharded over 'fsdp' axis.
 
