@@ -132,3 +132,22 @@ def test_evaluate_without_state_or_checkpoint_errors():
     est = Estimator(PlainCNN(), optax.sgd(0.1), config=RunConfig())
     with pytest.raises(RuntimeError, match="no checkpoint"):
         est.evaluate(eval_fn)
+
+
+def test_profile_window_writes_trace(tmp_path):
+    """RunConfig.profile_steps captures an XProf trace under
+    <model_dir>/plugins/profile — the reference's ProfilerHook capability
+    (mnist_keras_distributed.py:235-237,261) restored first-class."""
+    train_fn, _ = _input_fns()
+    cfg = RunConfig(
+        model_dir=str(tmp_path / "run"),
+        save_checkpoints_steps=None,
+        profile_steps=(2, 4),
+    )
+    est = Estimator(PlainCNN(), optax.sgd(0.1), config=cfg)
+    est.train(train_fn, max_steps=5)
+    est.close()
+    found = glob.glob(
+        os.path.join(str(tmp_path / "run"), "plugins", "profile", "*", "*")
+    )
+    assert found, "no profiler trace artifacts under model_dir"

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import export as jax_export
 
+from tfde_tpu.utils import fs
+
 log = logging.getLogger(__name__)
 
 _FLAT_SEP = "/"
@@ -78,8 +80,8 @@ def export_serving(
     placeholder shape (mnist_keras:159).
     """
     stamp = datetime.datetime.now().strftime("%Y%m%d%H%M%S")
-    out_dir = os.path.join(directory, stamp)
-    os.makedirs(out_dir, exist_ok=True)
+    out_dir = fs.join(directory, stamp)
+    fs.makedirs(out_dir, exist_ok=True)
 
     host_vars = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), variables)
 
@@ -95,16 +97,16 @@ def export_serving(
     arg = jax.ShapeDtypeStruct(tuple(dims), input_dtype)
 
     exported = jax_export.export(jax.jit(serve), platforms=platforms)(arg)
-    with open(os.path.join(out_dir, "model.stablehlo"), "wb") as f:
+    with fs.fs_open(fs.join(out_dir, "model.stablehlo"), "wb") as f:
         f.write(exported.serialize())
 
     buf = io.BytesIO()
     np.savez(buf, **_flatten_params(host_vars))
-    with open(os.path.join(out_dir, "params.npz"), "wb") as f:
+    with fs.fs_open(fs.join(out_dir, "params.npz"), "wb") as f:
         f.write(buf.getvalue())
 
     out_shape = jax.eval_shape(serve, arg)
-    with open(os.path.join(out_dir, "signature.json"), "w") as f:
+    with fs.fs_open(fs.join(out_dir, "signature.json"), "w") as f:
         json.dump(
             {
                 "input": {"shape": list(input_shape), "dtype": str(np.dtype(input_dtype))},
@@ -137,19 +139,21 @@ class ServingModel:
 
 def load_serving(export_dir: str) -> ServingModel:
     """Load a serving artifact from its timestamped directory (or the parent,
-    resolving the newest timestamp — FinalExporter keeps history)."""
+    resolving the newest timestamp — FinalExporter keeps history). Works on
+    local paths and remote URLs (gs://, memory://)."""
     entries = sorted(
-        d for d in os.listdir(export_dir)
-        if os.path.isdir(os.path.join(export_dir, d)) and d.isdigit()
+        d for d in fs.listdir(export_dir)
+        if fs.isdir(fs.join(export_dir, d)) and d.isdigit()
     )
-    if entries and not os.path.exists(os.path.join(export_dir, "signature.json")):
-        export_dir = os.path.join(export_dir, entries[-1])
-    with open(os.path.join(export_dir, "signature.json")) as f:
+    if entries and not fs.exists(fs.join(export_dir, "signature.json")):
+        export_dir = fs.join(export_dir, entries[-1])
+    with fs.fs_open(fs.join(export_dir, "signature.json"), "r") as f:
         signature = json.load(f)
-    with open(os.path.join(export_dir, "model.stablehlo"), "rb") as f:
+    with fs.fs_open(fs.join(export_dir, "model.stablehlo"), "rb") as f:
         exported = jax_export.deserialize(f.read())
-    with np.load(os.path.join(export_dir, "params.npz")) as z:
-        params = _unflatten_params({k: z[k] for k in z.files})
+    with fs.fs_open(fs.join(export_dir, "params.npz"), "rb") as f:
+        z = np.load(io.BytesIO(f.read()))
+    params = _unflatten_params({k: z[k] for k in z.files})
     return ServingModel(exported, signature, params)
 
 
@@ -174,7 +178,7 @@ class FinalExporter:
             apply_fn,
             variables,
             self.input_shape,
-            os.path.join(model_dir, "export", self.name),
+            fs.join(model_dir, "export", self.name),
             input_dtype=self.input_dtype,
             apply_softmax=self.apply_softmax,
         )

@@ -46,3 +46,74 @@ def profile_trace(
 def annotate(name: str):
     """Named region inside a trace (TraceAnnotation)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def _parse_window(raw: str) -> Optional[tuple]:
+    """'100:110' -> (100, 110); '100' -> (100, 110) (10-step default)."""
+    raw = raw.strip()
+    if not raw or raw in ("0", "false", "False"):
+        return None
+    if ":" in raw:
+        a, b = raw.split(":", 1)
+        return (int(a), int(b))
+    start = int(raw)
+    return (start, start + 10)
+
+
+class StepWindowProfiler:
+    """Trace a [start, stop) window of training steps into
+    `<logdir>/plugins/profile/` — the ProfilerHook capability
+    (mnist_keras_distributed.py:235-237: save_steps + output_dir), wired
+    into Estimator.train via RunConfig.profile_steps or $TFDE_PROFILE
+    ("start:stop" or "start").
+
+    Steps are *global* steps, so on resume the window refers to the same
+    steps it would in an uninterrupted run. The default window starts past
+    step 1 to keep the first-compile out of the trace.
+    """
+
+    def __init__(self, logdir: Optional[str], window: Optional[tuple] = None):
+        if window is None:
+            window = _parse_window(os.environ.get("TFDE_PROFILE", ""))
+        self._window = window
+        self._logdir = logdir
+        self._active = False
+        if window is not None and logdir is None:
+            log.warning("profiling requested but no model_dir — disabled")
+            self._window = None
+        from tfde_tpu.utils import fs
+
+        if self._window is not None and fs.is_remote(logdir):
+            # the profiler's C++ writer only handles local paths here;
+            # remote trace upload would need TF's gfile machinery
+            log.warning(
+                "profiling to a remote model_dir (%s) is not supported — "
+                "disabled; point model_dir at local disk to trace", logdir
+            )
+            self._window = None
+
+    @property
+    def enabled(self) -> bool:
+        return self._window is not None
+
+    def step(self, step: int) -> None:
+        """Call once per train step with the *post-increment* global step."""
+        if self._window is None:
+            return
+        start, stop = self._window
+        if not self._active and start <= step < stop:
+            log.info(
+                "profiler: tracing steps [%d, %d) -> %s/plugins/profile",
+                step, stop, self._logdir,
+            )
+            jax.profiler.start_trace(self._logdir)
+            self._active = True
+        elif self._active and step >= stop:
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profiler: trace complete at step %d", step)
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

@@ -18,12 +18,13 @@ Wire formats implemented:
 
 from __future__ import annotations
 
-import os
 import socket
 import struct
 import threading
 import time
 from typing import Dict, Optional
+
+from tfde_tpu.utils import fs
 
 # -- crc32c (Castagnoli), table-driven ---------------------------------------
 
@@ -128,24 +129,40 @@ class SummaryWriter:
     Only the chief process should construct one (host-side side effects are
     chief-only, matching the reference's worker-0 TensorBoard gating,
     mnist_keras:277-280).
+
+    The logdir may be a remote URL (gs://...) — the reference documents the
+    working dir as GCS-capable (mnist_keras:41-44). Local dirs get a real
+    append stream; remote ones buffer the event stream in memory and rewrite
+    the whole object on flush (object stores have no append; event files are
+    scalar-only and tiny, so the rewrite is cheap and gives true flush
+    durability — see utils/fs.py).
     """
 
     def __init__(self, logdir: str, filename_suffix: str = ""):
-        os.makedirs(logdir, exist_ok=True)
+        fs.makedirs(logdir, exist_ok=True)
         fname = "events.out.tfevents.%010d.%s%s" % (
             int(time.time()),
             socket.gethostname(),
             filename_suffix,
         )
-        self._path = os.path.join(logdir, fname)
+        self._path = fs.join(logdir, fname)
         self._lock = threading.Lock()
-        self._f = open(self._path, "ab")
+        self._remote = fs.is_remote(logdir)
+        if self._remote:
+            self._buf = bytearray()
+            self._f = None
+        else:
+            self._f = open(self._path, "ab")
         self._write(_event(time.time(), file_version="brain.Event:2"))
         self.flush()
 
     def _write(self, event_bytes: bytes) -> None:
         with self._lock:
-            self._f.write(_tfrecord(event_bytes))
+            record = _tfrecord(event_bytes)
+            if self._remote:
+                self._buf.extend(record)
+            else:
+                self._f.write(record)
 
     def scalars(self, step: int, values: Dict[str, float]) -> None:
         self._write(
@@ -157,11 +174,15 @@ class SummaryWriter:
 
     def flush(self) -> None:
         with self._lock:
-            self._f.flush()
+            if self._remote:
+                fs.write_bytes(self._path, bytes(self._buf))
+            else:
+                self._f.flush()
 
     def close(self) -> None:
         self.flush()
-        self._f.close()
+        if self._f is not None:
+            self._f.close()
 
     @property
     def path(self) -> str:

@@ -33,6 +33,7 @@ import numpy as np
 from tfde_tpu.checkpoint.manager import CheckpointManager
 from tfde_tpu.data.device import device_prefetch
 from tfde_tpu.data.pipeline import AutoShardPolicy
+from tfde_tpu.observability.profiler import StepWindowProfiler
 from tfde_tpu.observability.tensorboard import SummaryWriter
 from tfde_tpu.parallel.strategies import Strategy, MultiWorkerMirroredStrategy
 from tfde_tpu.training.step import (
@@ -54,8 +55,16 @@ class RunConfig:
     model_dir: Optional[str] = None
     save_summary_steps: int = 100
     log_step_count_steps: int = 100
-    save_checkpoints_steps: int = 500
+    # None/0 disables checkpointing (and resume) entirely — useful when the
+    # model_dir is a filesystem the checkpoint backend doesn't support
+    # (Orbax/tensorstore speak gs:// but not e.g. memory://), or for
+    # throwaway runs. Summaries and export still honor model_dir.
+    save_checkpoints_steps: Optional[int] = 500
     keep_checkpoint_max: int = 5
+    # (start, stop) global-step window to capture a profiler trace into
+    # <model_dir>/plugins/profile — the reference's ProfilerHook capability
+    # (mnist_keras:235-237,261). None defers to $TFDE_PROFILE ("start:stop").
+    profile_steps: Optional[Tuple[int, int]] = None
     seed: int = 0
 
 
@@ -117,7 +126,7 @@ class Estimator:
         return self._writers[name]
 
     def _ckpt_mngr(self) -> Optional[CheckpointManager]:
-        if self.config.model_dir is None:
+        if self.config.model_dir is None or not self.config.save_checkpoints_steps:
             return None
         if self._ckpt is None:
             self._ckpt = CheckpointManager(
@@ -184,6 +193,11 @@ class Estimator:
         rng = jax.random.key(cfg.seed + 1)
         writer = self._writer()
         mngr = self._ckpt_mngr()
+        profiler = (
+            StepWindowProfiler(cfg.model_dir, cfg.profile_steps)
+            if self._is_chief
+            else StepWindowProfiler(None, None)
+        )
 
         def batches():
             yield first
@@ -202,6 +216,7 @@ class Estimator:
             # deleted arrays if train() is interrupted mid-run
             self._state = state
             step += 1
+            profiler.step(step)
             if writer is not None and step % cfg.save_summary_steps == 0:
                 vals = {k: float(jax.device_get(v)) for k, v in last_metrics.items()}
                 writer.scalars(step, vals)
@@ -218,6 +233,7 @@ class Estimator:
                 _eval_hook(state, step)
 
         self._state = state
+        profiler.close()
         if mngr is not None:
             mngr.save(state, force=True)
             mngr.wait()

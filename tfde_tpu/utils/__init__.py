@@ -1,0 +1,12 @@
+"""Shared utilities: filesystem abstraction for remote working dirs."""
+
+from tfde_tpu.utils.fs import (  # noqa: F401
+    exists,
+    fs_open,
+    is_remote,
+    isdir,
+    join,
+    listdir,
+    makedirs,
+    write_bytes,
+)
