@@ -1,33 +1,55 @@
 """Headline benchmark: prints ONE JSON line for the driver — always.
 
-Two-process design (round-2 hardening per VERDICT.md "What's weak" #1):
+Two-process design (round-2 hardening): driver mode retries a fresh-interpreter
+run mode while the TPU backend comes up; on final failure it still prints one
+parseable JSON line with an "error" field.
 
-- **Driver mode** (`python bench.py`, no jax import): runs the measurement as
-  a subprocess (`python bench.py --run`) and retries with exponential backoff
-  when the TPU backend comes up `UNAVAILABLE` (the round-1 failure:
-  `BENCH_r01.json` rc=1 at the first `jax.local_devices()` call). A failed
-  backend init poisons the in-process jax backend cache, so each attempt gets
-  a fresh interpreter. On final failure the driver STILL prints one parseable
-  JSON line with an `"error"` field and the last attempt's stderr tail.
-- **Run mode** (`--run`): brings up jax, refuses a silent CPU fallback
-  (platform is recorded and cpu is an error unless TFDE_BENCH_ALLOW_CPU=1),
-  and measures two configs:
+Round-3 trust layer (VERDICT r2 "What's weak" #1: the round-2 bench printed
+2531 achieved TFLOPs on a 197-TFLOP chip — 1285% MFU — without noticing):
 
-  1. The reference's richest training path — the BN-CNN of
-     mnist_keras_distributed.py:67-120 at its train batch 128
-     (tf2_mnist_distributed.py:33), SGD, sparse-CE — as a jitted DP train
-     step. Metric: images/sec/chip. `vs_baseline` divides by
-     REFERENCE_ESTIMATE (the reference publishes nothing, BASELINE.md).
-  2. A compute-bound config: BERT-base MLM fwd+bwd at bf16, seq 512 —
-     reported as **MFU = achieved matmul FLOPs / chip peak** (`bert_mfu`
-     field) plus tokens/sec/chip. FLOPs are computed analytically from the
-     model dims (training = 3x forward — the "6N" params convention —
-     attention matmuls included); chip peak comes from the device_kind table
-     below.
+- **Host-fetch timing.** Measured on this hardware ('axon' experimental
+  platform): `jax.block_until_ready` returns ~immediately with device work
+  still pending (10 chained 4096^3 matmuls "completed" in 0.3 ms), so every
+  round-2 number was enqueue time, not compute. Every timed window now ends
+  with a device->host fetch of a scalar that is data-dependent on the final
+  step (the jitted step's own loss output / the calibration chain's out[0,0]),
+  which no backend can fake, minus a separately-measured fetch latency. The
+  residual block->fetch gap is reported as `sync_block_gap_ms` — direct
+  evidence of whether block_until_ready lied.
+- **Calibration matmul.** A bf16 matmul chain of analytically-known FLOPs
+  (lax.fori_loop inside one jit, so dispatch overhead is out of the picture)
+  runs first; its achieved TFLOPs vs chip peak (`calib_frac_of_peak`) gates
+  everything: >1.05x peak means timing is broken and the bench says so in an
+  `"error"` field instead of printing numbers.
+- **Peak gate per config.** Any config whose achieved FLOPs exceed 1.05x chip
+  peak withholds its number and reports `<cfg>_error` instead.
+- **Loss-motion check.** The loss scalar is fetched before and after each
+  timed window and must change (`<cfg>_loss_moved`) — a window that executes
+  nothing cannot pass.
+- **No invented baseline.** The reference publishes no numbers (BASELINE.md),
+  so `vs_baseline` is null with a note — round 2's `/ 10_000.0` estimate was
+  fiction and is gone.
+- **End-to-end config.** `mnist_e2e_*` times training *through the host input
+  pipeline* (data.Dataset shuffle/repeat/batch/prefetch + device_prefetch),
+  not just a resident device batch — the overlap the >=90% scaling story
+  depends on (SURVEY.md §7).
+- **Flash qualification.** `flash_*` runs the Pallas flash-attention kernel
+  vs the reference einsum at S=2048 on the real chip: max |err| + fwd+bwd
+  speedup (`flash_speedup`). This is the hardware qualification that flips
+  ops/attention.py auto-dispatch.
+
+Configs measured (each in try/except; one failure never kills the line):
+  calib   — bf16 4096^3 matmul chain, known FLOPs (the trust anchor)
+  mnist   — BN-CNN of mnist_keras_distributed.py:67-120 @ batch 128, SGD,
+            resident device batch: images/sec/chip (compute path)
+  mnist_e2e — same model fed by the real host pipeline: images/sec/chip
+  bert    — BERT-base MLM fwd+bwd bf16 @ seq 512: MFU vs chip peak
+  flash   — Pallas flash kernel vs reference attention @ S=2048
 
 Env knobs: TFDE_BENCH_BUDGET_S (total retry budget, default 900),
 TFDE_BENCH_ATTEMPT_TIMEOUT_S (per attempt, default 600),
-TFDE_BENCH_ALLOW_CPU=1 (let the measurement run on cpu and say so).
+TFDE_BENCH_ALLOW_CPU=1 (let the measurement run on cpu and say so),
+TFDE_BENCH_SMOKE=1 (tiny shapes, path validation only).
 """
 
 from __future__ import annotations
@@ -38,8 +60,7 @@ import subprocess
 import sys
 import time
 
-REFERENCE_ESTIMATE = 10_000.0  # images/sec; see module docstring
-GLOBAL_BATCH = 128             # tf2_mnist_distributed.py:33
+GLOBAL_BATCH = 128  # tf2_mnist_distributed.py:33
 
 # Peak bf16 matmul FLOP/s per chip, keyed by substrings of
 # jax.Device.device_kind (public figures; first match wins).
@@ -54,6 +75,7 @@ PEAK_FLOPS = [
     ("v2", 45e12),
 ]
 DEFAULT_PEAK = 275e12
+PEAK_TOLERANCE = 1.05  # achieved/peak above this = broken timing, not speed
 
 
 def chip_peak_flops(device_kind: str) -> tuple[float, bool]:
@@ -78,10 +100,132 @@ def bert_train_flops_per_token(hidden: int, mlp: int, depth: int,
 
 
 # --------------------------------------------------------------------------
+# Trusted timing: the clock stops at a host fetch, never at block_until_ready.
+# --------------------------------------------------------------------------
+
+class _Clock:
+    """Timing helper calibrated against the backend's sync behavior.
+
+    fetch(x): device_get a scalar jit *output* (cheap: no new compile) —
+    the only synchronization this backend honors.
+    """
+
+    def __init__(self):
+        import jax
+        import numpy as np
+
+        self._jax = jax
+        self._np = np
+        # Warm the transfer channel, then measure steady-state fetch latency
+        # on an already-ready scalar.
+        z = jax.jit(lambda: jax.numpy.zeros(()))()
+        self.fetch_scalar(z)
+        lats = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            self.fetch_scalar(z)
+            lats.append(time.perf_counter() - t0)
+        self.fetch_latency_s = float(np.median(lats))
+
+    def fetch_scalar(self, x) -> float:
+        return float(self._np.asarray(self._jax.device_get(x)))
+
+    def timed(self, run_reps, scalar_of, min_window_s: float,
+              start_reps: int, max_reps: int):
+        """Run `run_reps(n)` (returns an object whose scalar_of(obj) is a
+        jit-output scalar data-dependent on the final rep), growing n until
+        the fetched window is long enough to swamp fetch latency.
+
+        Returns (reps, window_s, block_gap_s, fetched_value).
+        """
+        jax = self._jax
+        reps = start_reps
+        while True:
+            t0 = time.perf_counter()
+            out = run_reps(reps)
+            jax.block_until_ready(out)
+            t_block = time.perf_counter()
+            val = self.fetch_scalar(scalar_of(out))
+            t_fetch = time.perf_counter()
+            window = t_fetch - t0 - self.fetch_latency_s
+            if window >= min_window_s or reps >= max_reps:
+                return reps, max(window, 1e-9), t_fetch - t_block, val
+            scale = max(2.0, 1.3 * min_window_s / max(window, 1e-3))
+            reps = min(max_reps, int(reps * scale) + 1)
+
+
+def _gate(result: dict, prefix: str, achieved: float, peak: float) -> bool:
+    """False (and records an error) if achieved FLOPs are physically
+    impossible — the round-2 failure mode, now a refusal instead of a
+    headline."""
+    if achieved > PEAK_TOLERANCE * peak:
+        result[f"{prefix}_error"] = (
+            f"achieved {achieved / 1e12:.1f} TFLOPs/chip exceeds "
+            f"{PEAK_TOLERANCE:.2f}x chip peak {peak / 1e12:.1f} — timing or "
+            f"synchronization is broken; number withheld"
+        )
+        return False
+    return True
+
+
+# --------------------------------------------------------------------------
 # Run mode: the actual measurement (fresh interpreter per attempt).
 # --------------------------------------------------------------------------
 
-def _bench_mnist(strategy, n_chips: int, smoke: bool = False) -> dict:
+def _bench_calibration(clock: _Clock, peak: float, smoke: bool) -> dict:
+    """bf16 matmul chain of known FLOPs inside ONE jit (fori_loop), so
+    per-call dispatch overhead — ~2 ms/call through the axon tunnel, the
+    entire round-2 'BERT step' — cannot contaminate it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = 256 if smoke else 4096
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((n, n)) , jnp.bfloat16)
+    # scale so the chained product stays O(1) (bf16 overflow -> inf/nan
+    # could let the backend shortcut; keep the numerics honest)
+    b = jnp.asarray(rng.standard_normal((n, n)) / np.sqrt(n), jnp.bfloat16)
+
+    @jax.jit
+    def chain(x, reps):
+        # reps is TRACED (fori_loop -> while_loop): one compile serves every
+        # rep count the adaptive window picks. With a static rep count the
+        # recompile landed inside the timed window and read as 0.7 TFLOPs.
+        def body(_, acc):
+            return jax.lax.dot(
+                acc, b, preferred_element_type=jnp.float32
+            ).astype(jnp.bfloat16)
+        out = jax.lax.fori_loop(0, reps, body, x)
+        return out[0, 0].astype(jnp.float32)
+
+    clock.fetch_scalar(chain(a, jnp.int32(2)))  # compile + warm
+    flops_per = 2.0 * n ** 3
+    min_window = 0.02 if smoke else 1.0
+    reps, window, gap, val = clock.timed(
+        lambda r: chain(a, jnp.int32(r)), lambda s: s, min_window,
+        start_reps=4 if smoke else 64, max_reps=1 << 14,
+    )
+    achieved = reps * flops_per / window
+    out = {
+        "calib_matmul_n": n,
+        "calib_reps": reps,
+        "calib_tflops": round(achieved / 1e12, 1),
+        "calib_frac_of_peak": round(achieved / peak, 4),
+        "calib_value_finite": bool(np.isfinite(val)),
+        "sync_fetch_latency_ms": round(clock.fetch_latency_s * 1e3, 3),
+        "sync_block_gap_ms": round(gap * 1e3, 2),
+    }
+    if achieved > PEAK_TOLERANCE * peak and not smoke:
+        out["calib_error"] = (
+            f"calibration matmul 'achieved' {achieved / 1e12:.0f} TFLOPs on a "
+            f"{peak / 1e12:.0f}-TFLOP chip: the timing itself is broken on "
+            f"this backend; all numbers below are untrustworthy"
+        )
+    return out
+
+
+def _mnist_setup(strategy):
     import jax
     import numpy as np
     import optax
@@ -94,7 +238,15 @@ def _bench_mnist(strategy, n_chips: int, smoke: bool = False) -> dict:
     sample = np.zeros((GLOBAL_BATCH, 784), np.float32)
     state, _ = init_state(model, tx, strategy, sample, seed=0)
     step_fn = make_train_step(strategy, state, donate=True)
+    return state, step_fn
 
+
+def _bench_mnist(clock: _Clock, strategy, n_chips: int, smoke: bool) -> dict:
+    """Compute-path MNIST: resident device batch (no host feed)."""
+    import jax
+    import numpy as np
+
+    state, step_fn = _mnist_setup(strategy)
     rng = np.random.default_rng(0)
     images = rng.random((GLOBAL_BATCH, 784), np.float32)
     labels = rng.integers(0, 10, (GLOBAL_BATCH, 1)).astype(np.int32)
@@ -103,26 +255,89 @@ def _bench_mnist(strategy, n_chips: int, smoke: bool = False) -> dict:
     labels = jax.device_put(labels, batch_sh)
     key = jax.random.key(0)
 
-    warmup, timed = (3, 20) if smoke else (20, 400)
-    for _ in range(warmup):
-        state, _ = step_fn(state, (images, labels), key)
-    jax.block_until_ready(state.params)
+    holder = {"state": state}
+    metrics = None
+    for _ in range(2 if smoke else 20):  # warmup
+        holder["state"], metrics = step_fn(holder["state"], (images, labels), key)
+    loss_start = clock.fetch_scalar(metrics["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(timed):
-        state, _ = step_fn(state, (images, labels), key)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    def run(reps):
+        m = None
+        for _ in range(reps):
+            holder["state"], m = step_fn(holder["state"], (images, labels), key)
+        return m
 
-    per_chip = timed * GLOBAL_BATCH / dt / n_chips
+    reps, window, gap, loss_end = clock.timed(
+        run, lambda m: m["loss"], 0.05 if smoke else 1.5,
+        start_reps=5 if smoke else 200, max_reps=20_000,
+    )
+    step_s = window / reps
     return {
-        "mnist_images_per_sec_per_chip": round(per_chip, 1),
-        "mnist_step_ms": round(dt / timed * 1e3, 3),
+        "mnist_images_per_sec_per_chip": round(GLOBAL_BATCH / step_s / n_chips, 1),
+        "mnist_step_ms": round(step_s * 1e3, 3),
+        "mnist_timed_steps": reps,
+        "mnist_block_gap_ms": round(gap * 1e3, 2),
+        "mnist_loss_start": round(loss_start, 5),
+        "mnist_loss_end": round(loss_end, 5),
+        "mnist_loss_moved": bool(abs(loss_end - loss_start) > 1e-9),
     }
 
 
-def _bench_bert_mfu(strategy, n_chips: int, device_kind: str,
-                    smoke: bool = False) -> dict:
+def _bench_mnist_e2e(clock: _Clock, strategy, n_chips: int, smoke: bool) -> dict:
+    """End-to-end MNIST: host pipeline (Dataset shuffle/repeat/batch/prefetch)
+    + device_prefetch feeding the same train step — measures what the
+    reference's input_fn path (mnist_keras:123-148) actually delivers,
+    including host->device transfer overlap."""
+    import numpy as np
+
+    from tfde_tpu.data.device import device_prefetch
+    from tfde_tpu.data.pipeline import Dataset
+
+    state, step_fn = _mnist_setup(strategy)
+    n = 1024 if smoke else 16384
+    rng = np.random.default_rng(0)
+    images = rng.random((n, 784), np.float32)
+    labels = rng.integers(0, 10, (n, 1)).astype(np.int32)
+    ds = (
+        Dataset.from_tensor_slices((images, labels))
+        .shuffle(n, seed=0)
+        .repeat()
+        .batch(GLOBAL_BATCH, drop_remainder=True)
+        .prefetch(4)
+    )
+    feed = device_prefetch(iter(ds), strategy.mesh, buffer_size=2)
+    import jax
+
+    key = jax.random.key(0)
+    holder = {"state": state}
+    metrics = None
+    for _ in range(2 if smoke else 20):  # warmup
+        holder["state"], metrics = step_fn(holder["state"], next(feed), key)
+    loss_start = clock.fetch_scalar(metrics["loss"])
+
+    def run(reps):
+        m = None
+        for _ in range(reps):
+            holder["state"], m = step_fn(holder["state"], next(feed), key)
+        return m
+
+    reps, window, gap, loss_end = clock.timed(
+        run, lambda m: m["loss"], 0.05 if smoke else 1.5,
+        start_reps=5 if smoke else 200, max_reps=20_000,
+    )
+    step_s = window / reps
+    return {
+        "mnist_e2e_images_per_sec_per_chip": round(
+            GLOBAL_BATCH / step_s / n_chips, 1
+        ),
+        "mnist_e2e_step_ms": round(step_s * 1e3, 3),
+        "mnist_e2e_timed_steps": reps,
+        "mnist_e2e_loss_moved": bool(abs(loss_end - loss_start) > 1e-9),
+    }
+
+
+def _bench_bert_mfu(clock: _Clock, strategy, n_chips: int, peak: float,
+                    smoke: bool) -> dict:
     import jax
     import numpy as np
     import optax
@@ -135,12 +350,11 @@ def _bench_bert_mfu(strategy, n_chips: int, device_kind: str,
         seq, per_chip_batch = 128, 2
         model = Bert(vocab_size=1024, hidden_size=128, depth=2, num_heads=4,
                      mlp_dim=256, dropout_rate=0.0, pad_vocab=True)
-        warmup, timed = 1, 3
+        warmup = 1
     else:
         seq, per_chip_batch = 512, 16
         model = BertBase(dropout_rate=0.0, pad_vocab=True)
-        warmup, timed = 3, 20
-    dims = (model.hidden_size, model.mlp_dim, model.depth)
+        warmup = 3
     global_batch = per_chip_batch * n_chips
     vocab = model.padded_vocab
 
@@ -162,30 +376,143 @@ def _bench_bert_mfu(strategy, n_chips: int, device_kind: str,
     labels[:, ::7] = ids[:, ::7]  # ~15% positions predicted
     key = jax.random.key(0)
 
+    holder = {"state": state}
+    metrics = None
     for _ in range(warmup):
-        state, _ = step_fn(state, (ids, labels), key)
-    jax.block_until_ready(state.params)
+        holder["state"], metrics = step_fn(holder["state"], (ids, labels), key)
+    loss_start = clock.fetch_scalar(metrics["loss"])
 
+    def run(reps):
+        m = None
+        for _ in range(reps):
+            holder["state"], m = step_fn(holder["state"], (ids, labels), key)
+        return m
+
+    reps, window, gap, loss_end = clock.timed(
+        run, lambda m: m["loss"], 0.05 if smoke else 2.0,
+        start_reps=2 if smoke else 10, max_reps=2_000,
+    )
+    step_s = window / reps
+
+    # Diagnostic (VERDICT r2 next-steps 1b): a short per-step-synced window —
+    # each step's loss fetched to host before the next starts. Dispatch
+    # overhead + fetch latency make this an upper bound on step time; the
+    # primary (amortized-fetch) number must lie between compute truth and
+    # this bound.
     t0 = time.perf_counter()
-    for _ in range(timed):
-        state, _ = step_fn(state, (ids, labels), key)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    synced_reps = 2 if smoke else 5
+    for _ in range(synced_reps):
+        holder["state"], m = step_fn(holder["state"], (ids, labels), key)
+        clock.fetch_scalar(m["loss"])
+    synced_step_s = (time.perf_counter() - t0) / synced_reps
 
-    step_s = dt / timed
     tokens_per_step = global_batch * seq
-    hidden, mlp, depth = dims
-    flops_per_token = bert_train_flops_per_token(hidden, mlp, depth, seq, vocab)
+    flops_per_token = bert_train_flops_per_token(
+        model.hidden_size, model.mlp_dim, model.depth, seq, vocab
+    )
     achieved = tokens_per_step * flops_per_token / step_s / n_chips
-    peak, known = chip_peak_flops(device_kind)
-    return {
-        "bert_mfu": round(achieved / peak, 4),
-        "bert_tokens_per_sec_per_chip": round(tokens_per_step / step_s / n_chips, 1),
+    out = {
         "bert_step_ms": round(step_s * 1e3, 2),
-        "bert_achieved_tflops_per_chip": round(achieved / 1e12, 2),
-        "chip_peak_tflops": round(peak / 1e12, 1),
-        "chip_peak_known": known,
+        "bert_step_ms_synced": round(synced_step_s * 1e3, 2),
+        "bert_timed_steps": reps,
+        "bert_block_gap_ms": round(gap * 1e3, 2),
+        "bert_loss_moved": bool(abs(loss_end - loss_start) > 1e-9),
     }
+    if _gate(out, "bert", achieved, peak):
+        out.update({
+            "bert_mfu": round(achieved / peak, 4),
+            "bert_tokens_per_sec_per_chip": round(
+                tokens_per_step / step_s / n_chips, 1
+            ),
+            "bert_achieved_tflops_per_chip": round(achieved / 1e12, 2),
+        })
+    return out
+
+
+def _bench_flash(clock: _Clock, smoke: bool) -> dict:
+    """Hardware qualification of the Pallas flash-attention kernel
+    (VERDICT r2 next-steps 4): numerics vs the reference einsum, then
+    fwd+bwd timing at S=2048. On CPU/smoke, interpret-mode numerics only."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfde_tpu.ops.attention import reference_attention
+    from tfde_tpu.ops.flash_attention import flash_attention
+
+    interpret = jax.default_backend() != "tpu"
+
+    def ref_loss(q, k, v):
+        return reference_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    def flash_loss(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=interpret).astype(
+            jnp.float32).sum()
+
+    def make_qkv(b, s, h, d):
+        rng = np.random.default_rng(0)
+        return tuple(
+            jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+            for _ in range(3)
+        )
+
+    # numerics first (small enough for either backend)
+    b, s, h, d = (1, 256, 2, 64) if (smoke or interpret) else (2, 2048, 4, 64)
+    q, k, v = make_qkv(b, s, h, d)
+    ref_fwd = jax.jit(lambda q, k, v: reference_attention(q, k, v, causal=True))
+    fl_fwd = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=interpret)
+    )
+    o_ref = ref_fwd(q, k, v)
+    o_fl = fl_fwd(q, k, v)
+    err = float(
+        jnp.max(jnp.abs(o_ref.astype(jnp.float32) - o_fl.astype(jnp.float32)))
+    )
+    scale_ref = float(jnp.max(jnp.abs(o_ref.astype(jnp.float32))))
+    ok = err <= 2e-2 * max(scale_ref, 1.0)  # bf16 tolerance
+    out = {
+        "flash_max_abs_err": round(err, 5),
+        "flash_numerics_ok": bool(ok),
+        "flash_interpret": interpret,
+    }
+    if interpret or smoke:
+        return out  # interpret-mode timing is meaningless
+
+    # fwd+bwd timing across the length sweep (token count held constant):
+    # XLA's fused attention is strong at moderate S; the flash win is the
+    # long-S regime where the O(S^2) score tensor stops fitting.
+    ref_g = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))
+    fl_g = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))
+
+    def time_impl(g, q, k, v):
+        def run(reps):
+            dq = None
+            for _ in range(reps):
+                dq, _, _ = g(q, k, v)
+            return dq
+        reps, window, _, _ = clock.timed(
+            run, lambda dq: dq[0, 0, 0, 0].astype(jnp.float32), 1.0,
+            start_reps=5, max_reps=5_000,
+        )
+        return window / reps
+
+    for b, s in ((4, 2048), (2, 4096), (1, 8192)):
+        try:
+            q, k, v = make_qkv(b, s, 12, 64)
+            # compile + warm both before timing either
+            clock.fetch_scalar(ref_g(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32))
+            clock.fetch_scalar(fl_g(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32))
+            t_ref = time_impl(ref_g, q, k, v)
+            t_fl = time_impl(fl_g, q, k, v)
+            out[f"flash_speedup_s{s}"] = round(t_ref / t_fl, 3)
+            out[f"flash_ref_ms_s{s}"] = round(t_ref * 1e3, 3)
+            out[f"flash_ms_s{s}"] = round(t_fl * 1e3, 3)
+        except Exception as e:
+            out[f"flash_error_s{s}"] = f"{type(e).__name__}: {e}"[:200]
+    speedups = [v for k_, v in out.items() if k_.startswith("flash_speedup_s")]
+    if speedups:
+        out["flash_speedup"] = max(speedups)
+    return out
 
 
 def run_mode() -> None:
@@ -212,30 +539,50 @@ def run_mode() -> None:
 
     strategy = MirroredStrategy()
     n_chips = strategy.num_replicas
+    peak, peak_known = chip_peak_flops(device_kind)
     print(f"platform={platform} kind={device_kind} chips={n_chips}",
           file=sys.stderr)
 
     smoke = os.environ.get("TFDE_BENCH_SMOKE") == "1"
     result = {"platform": platform, "device_kind": device_kind,
-              "n_chips": n_chips}
+              "n_chips": n_chips,
+              "chip_peak_tflops": round(peak / 1e12, 1),
+              "chip_peak_known": peak_known}
     if smoke:
         result["smoke"] = True
-    result.update(_bench_mnist(strategy, n_chips, smoke))
-    print(f"mnist done: {result}", file=sys.stderr)
-    try:
-        result.update(_bench_bert_mfu(strategy, n_chips, device_kind, smoke))
-    except Exception as e:  # OOM on small chips etc. — keep the mnist number
-        result["bert_error"] = f"{type(e).__name__}: {e}"[:400]
-    print(f"bert done: {result}", file=sys.stderr)
 
-    per_chip = result["mnist_images_per_sec_per_chip"]
+    clock = _Clock()
+    configs = [
+        ("calib", lambda: _bench_calibration(clock, peak, smoke)),
+        ("mnist", lambda: _bench_mnist(clock, strategy, n_chips, smoke)),
+        ("mnist_e2e", lambda: _bench_mnist_e2e(clock, strategy, n_chips, smoke)),
+        ("bert", lambda: _bench_bert_mfu(clock, strategy, n_chips, peak, smoke)),
+        ("flash", lambda: _bench_flash(clock, smoke)),
+    ]
+    for name, fn in configs:
+        try:
+            result.update(fn())
+        except Exception as e:  # OOM on small chips etc. — keep the rest
+            result[f"{name}_error"] = f"{type(e).__name__}: {e}"[:400]
+        print(f"{name} done", file=sys.stderr)
+        if name == "calib" and "calib_error" in result:
+            break  # timing itself is broken; more numbers would be noise
+
+    value = result.get("mnist_images_per_sec_per_chip", 0.0)
+    errors = {k: v for k, v in result.items() if k.endswith("_error")}
     line = {
         "metric": "mnist_bncnn_train_images_per_sec_per_chip",
-        "value": per_chip,
+        "value": value,
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / REFERENCE_ESTIMATE, 3),
+        # The reference publishes no numbers (BASELINE.md; README is a bare
+        # title) — a ratio against an invented constant is not a baseline.
+        "vs_baseline": None,
+        "vs_baseline_note": "reference publishes no benchmark numbers",
         **result,
     }
+    if "calib_error" in errors:
+        line["error"] = errors["calib_error"]
+        line["value"] = 0.0
     print(json.dumps(line))
 
 
@@ -356,7 +703,8 @@ def driver_mode() -> None:
         "metric": "mnist_bncnn_train_images_per_sec_per_chip",
         "value": 0.0,
         "unit": "images/sec/chip",
-        "vs_baseline": 0.0,
+        "vs_baseline": None,
+        "vs_baseline_note": "reference publishes no benchmark numbers",
         "error": f"TPU backend unavailable after {attempt} attempts "
                  f"within {budget:.0f}s budget",
         "last_rc": last_rc,
