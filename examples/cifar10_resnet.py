@@ -16,12 +16,13 @@ from __future__ import annotations
 
 import argparse
 import logging
+from typing import Optional
 
 import jax
 import numpy as np
 import optax
 
-from tfde_tpu import bootstrap
+from tfde_tpu import bootstrap, native
 from tfde_tpu.data import Dataset, datasets
 from tfde_tpu.data.pipeline import AutoShardPolicy
 from tfde_tpu.models.resnet import resnet50_cifar
@@ -42,9 +43,31 @@ def augment(rng: np.random.Generator, images: np.ndarray) -> np.ndarray:
     return np.where(flip[:, None, None, None], out[:, :, ::-1], out)
 
 
-def make_train_dataset(global_batch: int, seed: int = 0) -> Dataset:
+def make_train_dataset(global_batch: int, seed: int = 0,
+                       use_native: Optional[bool] = None):
+    """Shuffle/repeat/batch + per-batch augmentation.
+
+    Hot path: the C++ NativeBatchLoader (GIL-free shuffle+gather+prefetch
+    ring, tfde_tpu/native) when the toolchain built it — the tf.data C++
+    engine capability at the batch sizes where it decisively beats the numpy
+    path (SURVEY.md §2b row 3). Same deterministic per-seed stream on every
+    host, as AutoShardPolicy.OFF requires. Python Dataset is the fallback.
+    """
     (train_x, train_y), _ = datasets.cifar10()
     rng = np.random.default_rng(seed)
+    if use_native is None:
+        use_native = native.available()
+    if use_native:
+        def gen():
+            loader = native.NativeBatchLoader(
+                [train_x, train_y], batch_size=global_batch, seed=seed,
+                drop_remainder=True, num_threads=4, depth=4,
+            )
+            for images, labels in loader:
+                # augment() materializes fresh arrays; labels still alias
+                # the slot ring, so copy before handing downstream
+                yield augment(rng, images), labels.copy()
+        return gen()
 
     def aug(images, labels):
         return augment(rng, images), labels

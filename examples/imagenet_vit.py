@@ -21,7 +21,7 @@ import jax
 import numpy as np
 import optax
 
-from tfde_tpu import bootstrap
+from tfde_tpu import bootstrap, native
 from tfde_tpu.data import Dataset, datasets
 from tfde_tpu.data.pipeline import AutoShardPolicy
 from tfde_tpu.models.vit import ViT_B16, vit_tiny_test
@@ -30,11 +30,28 @@ from tfde_tpu.training import Estimator, RunConfig
 
 
 def make_train_dataset(
-    global_batch: int, image_size: int, n: int, num_classes: int, seed: int = 0
-) -> Dataset:
+    global_batch: int, image_size: int, n: int, num_classes: int, seed: int = 0,
+    use_native: bool | None = None,
+):
+    """Shuffle/repeat/batch over the (synthetic-or-real) ImageNet arrays.
+
+    At ViT input sizes (224x224x3 = 588 KB/row) the batch gather is pure
+    memory bandwidth — the C++ NativeBatchLoader's GIL-free multi-thread
+    memcpy ring (tfde_tpu/native) is the intended hot path; the python
+    Dataset chain is the no-toolchain fallback. copy=True because the
+    yielded views alias the slot ring and the device transfer downstream
+    is asynchronous.
+    """
     (train_x, train_y), _ = datasets.imagenet(
         n_train=n, n_test=1, side=image_size, num_classes=num_classes
     )
+    if use_native is None:
+        use_native = native.available()
+    if use_native:
+        return native.NativeBatchLoader(
+            [train_x, train_y], batch_size=global_batch, seed=seed,
+            drop_remainder=True, num_threads=4, depth=4, copy=True,
+        )
     return (
         Dataset.from_tensor_slices((train_x, train_y))
         .shuffle(len(train_x), seed=seed)

@@ -69,3 +69,61 @@ def test_flash_bf16_inputs(rng):
         np.asarray(got, np.float32), np.asarray(expect, np.float32),
         rtol=2e-2, atol=2e-2,
     )
+
+
+def test_flash_rejects_cross_attention_shapes(rng):
+    """All tiling derives from q.shape; Sk != Sq must be a loud error, not a
+    silent wrong-range attend (ADVICE r1)."""
+    from tfde_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.asarray(rng.standard_normal((1, 128, 2, 16)), jnp.float32)
+    kv = jnp.asarray(rng.standard_normal((1, 256, 2, 16)), jnp.float32)
+    with pytest.raises(ValueError, match="cross-attention"):
+        flash_attention(q, kv, kv, interpret=True)
+
+
+def test_auto_dispatch_flash_on_tpu_threshold(monkeypatch):
+    """Auto-dispatch (hardware-qualified 2026-07): flash on TPU from S>=4096,
+    reference below; TFDE_FLASH=0 disables, =1 lowers the threshold."""
+    import tfde_tpu.ops.attention as att
+    import tfde_tpu.ops.flash_attention as fa
+
+    chosen = []
+    monkeypatch.setattr(att, "_on_tpu", lambda: True)
+
+    def fake_flash(q, k, v, causal=False, **kw):
+        chosen.append("flash")
+        return q
+
+    def fake_ref(q, k, v, mask=None, causal=False):
+        chosen.append("reference")
+        return q
+
+    monkeypatch.setattr(fa, "flash_attention", fake_flash)
+    monkeypatch.setattr(att, "reference_attention", fake_ref)
+    monkeypatch.delenv("TFDE_FLASH", raising=False)
+
+    long = jnp.zeros((1, 4096, 1, 4), jnp.bfloat16)
+    mid = jnp.zeros((1, 2048, 1, 4), jnp.bfloat16)
+    short = jnp.zeros((1, 1024, 1, 4), jnp.bfloat16)
+
+    att.attention(long, long, long)
+    att.attention(mid, mid, mid)
+    assert chosen == ["flash", "reference"]
+
+    chosen.clear()
+    monkeypatch.setenv("TFDE_FLASH", "0")
+    att.attention(long, long, long)
+    assert chosen == ["reference"]
+
+    chosen.clear()
+    monkeypatch.setenv("TFDE_FLASH", "1")
+    att.attention(short, short, short)
+    assert chosen == ["flash"]
+
+    # cross-attention shapes never auto-pick flash
+    chosen.clear()
+    monkeypatch.delenv("TFDE_FLASH", raising=False)
+    kv = jnp.zeros((1, 8192, 1, 4), jnp.bfloat16)
+    att.attention(long, kv, kv)
+    assert chosen == ["reference"]

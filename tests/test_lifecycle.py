@@ -151,3 +151,122 @@ def test_profile_window_writes_trace(tmp_path):
         os.path.join(str(tmp_path / "run"), "plugins", "profile", "*", "*")
     )
     assert found, "no profiler trace artifacts under model_dir"
+
+
+def test_eval_distribute_matches_train_strategy_eval(tmp_path):
+    """eval_strategy (the reference's DistributeConfig eval_distribute,
+    mnist_keras_distributed.py:241-243): training under ParameterServer
+    (ZeRO-1) while evaluating under Mirrored must give metrics identical to
+    evaluating under the training strategy itself."""
+    from tfde_tpu.parallel.strategies import (
+        MirroredStrategy,
+        ParameterServerStrategy,
+    )
+
+    train_fn, eval_fn = _input_fns()
+    est_same = Estimator(
+        PlainCNN(), optax.sgd(0.1),
+        strategy=ParameterServerStrategy(), config=RunConfig(seed=0),
+    )
+    est_same.train(train_fn, max_steps=4)
+    m_same = est_same.evaluate(eval_fn)
+
+    est_cross = Estimator(
+        PlainCNN(), optax.sgd(0.1),
+        strategy=ParameterServerStrategy(),
+        eval_strategy=MirroredStrategy(),
+        config=RunConfig(seed=0),
+    )
+    est_cross.train(train_fn, max_steps=4)
+    m_cross = est_cross.evaluate(eval_fn)
+
+    assert m_cross["accuracy"] == m_same["accuracy"]
+    np.testing.assert_allclose(m_cross["loss"], m_same["loss"], rtol=1e-6)
+
+    # training continues fine after a cross-strategy eval (state untouched)
+    state = est_cross.train(train_fn, max_steps=6)
+    assert int(jax.device_get(state.step)) == 6
+
+
+def test_profile_repeating_windows(tmp_path):
+    """profile_steps="every:N" re-traces like the reference's
+    ProfilerHook(save_steps=100): multiple windows from one training run."""
+    train_fn, _ = _input_fns()
+    cfg = RunConfig(
+        model_dir=str(tmp_path / "run"),
+        save_checkpoints_steps=None,
+        profile_steps="every:3:1",  # trace 1 step at steps 3, 6, 9...
+    )
+    est = Estimator(PlainCNN(), optax.sgd(0.1), config=cfg)
+    # reach into train's profiler via a fresh one to assert window math,
+    # then check the real run produced trace artifacts
+    from tfde_tpu.observability.profiler import StepWindowProfiler
+
+    p = StepWindowProfiler.__new__(StepWindowProfiler)
+    p._window = ("every", 3, 1)
+    assert [s for s in range(1, 10) if p._in_window(s)] == [3, 6, 9]
+
+    est.train(train_fn, max_steps=8)  # windows at 3 and 6
+    est.close()
+    found = glob.glob(
+        os.path.join(str(tmp_path / "run"), "plugins", "profile", "*")
+    )
+    assert found, "no profiler trace artifacts under model_dir"
+
+
+def test_continuous_eval_from_checkpoint(tmp_path):
+    """eval_mode='from_checkpoint' (the reference's concurrent evaluator,
+    mnist_keras_distributed.py:255-283): training runs to completion without
+    inline eval pauses while a background evaluator follows the checkpoint
+    stream; the final checkpoint is always evaluated."""
+    train_fn, eval_fn = _input_fns()
+    cfg = RunConfig(
+        model_dir=str(tmp_path / "run"),
+        save_checkpoints_steps=5,
+        save_summary_steps=100,
+    )
+    est = Estimator(PlainCNN(), optax.sgd(0.1), config=cfg)
+    state, metrics = train_and_evaluate(
+        est,
+        TrainSpec(train_fn, max_steps=20),
+        EvalSpec(eval_fn, start_delay_secs=0, throttle_secs=0.2),
+        eval_mode="from_checkpoint",
+    )
+    est.close()
+    assert int(jax.device_get(state.step)) == 20
+    # the evaluator caught the trainer's final force-saved checkpoint
+    assert np.isfinite(metrics["loss"])
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+    # eval summaries came from the evaluator thread
+    assert glob.glob(str(tmp_path / "run" / "eval" / "events.out.tfevents.*"))
+
+
+def test_continuous_eval_standalone_evaluator_job(tmp_path):
+    """continuous_eval() as a dedicated evaluator: a separate Estimator
+    (fresh process analog) follows checkpoints until stop_after_step."""
+    from tfde_tpu.training.lifecycle import continuous_eval
+
+    train_fn, eval_fn = _input_fns()
+    cfg = RunConfig(model_dir=str(tmp_path / "run"), save_checkpoints_steps=5)
+    trainer = Estimator(PlainCNN(), optax.sgd(0.1), config=cfg)
+    trainer.train(train_fn, max_steps=10)
+    trainer.close()
+
+    evaluator = Estimator(PlainCNN(), optax.sgd(0.1), config=cfg)
+    step, metrics = continuous_eval(
+        evaluator, EvalSpec(eval_fn, throttle_secs=0.1),
+        stop_after_step=10,
+    )
+    evaluator.close()
+    assert step == 10
+    assert np.isfinite(metrics["loss"])
+
+
+def test_continuous_eval_requires_checkpointing():
+    train_fn, eval_fn = _input_fns()
+    est = Estimator(PlainCNN(), optax.sgd(0.1), config=RunConfig())
+    with pytest.raises(ValueError, match="model_dir"):
+        train_and_evaluate(
+            est, TrainSpec(train_fn, max_steps=2), EvalSpec(eval_fn),
+            eval_mode="from_checkpoint",
+        )

@@ -107,3 +107,136 @@ def test_two_process_sync_dp_agrees(tmp_path):
     assert results[0]["params_sha"] == results[1]["params_sha"]
     assert results[0]["last_loss"] < results[0]["first_loss"]
     assert results[0]["last_loss"] == pytest.approx(results[1]["last_loss"])
+
+
+_LIFECYCLE_CHILD = textwrap.dedent(
+    """
+    import json, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    import numpy as np, optax
+    from tfde_tpu import bootstrap
+    from tfde_tpu.data import Dataset
+    from tfde_tpu.data.device import local_slice_for_process
+    from tfde_tpu.data.pipeline import AutoShardPolicy
+    from tfde_tpu.export.serving import FinalExporter
+    from tfde_tpu.models.cnn import PlainCNN
+    from tfde_tpu.training.lifecycle import Estimator, RunConfig
+
+    phase, model_dir = sys.argv[1], sys.argv[2]
+    info = bootstrap()
+    assert jax.process_count() == 2, jax.process_count()
+
+    rng = np.random.default_rng(0)  # same stream on both hosts (policy OFF)
+    X = rng.random((64, 784), np.float32)
+    Y = rng.integers(0, 10, (64, 1)).astype(np.int32)
+    train_fn = lambda: (
+        Dataset.from_tensor_slices((X, Y))
+        .shuffle(64, seed=0).repeat().batch(16, drop_remainder=True)
+    )
+    eval_fn = lambda: Dataset.from_tensor_slices((X[:32], Y[:32])).batch(16)
+
+    cfg = RunConfig(model_dir=model_dir, save_checkpoints_steps=5,
+                    save_summary_steps=5)
+    est = Estimator(PlainCNN(), optax.sgd(0.1), config=cfg)
+
+    if phase == "first":
+        state = est.train(train_fn, max_steps=10,
+                          shard_policy=AutoShardPolicy.OFF)
+    else:
+        # 'restarted cluster': same model_dir, fresh processes. max_steps is
+        # absolute, so the completed 10 steps must be a no-op...
+        state = est.train(train_fn, max_steps=10,
+                          shard_policy=AutoShardPolicy.OFF)
+        assert int(jax.device_get(state.step)) == 10, "resume failed"
+        # ...and training continues from the checkpoint to 16
+        state = est.train(train_fn, max_steps=16,
+                          shard_policy=AutoShardPolicy.OFF)
+
+    metrics = est.evaluate(eval_fn)
+    export_path = None
+    if phase == "resume":
+        export_path = est.export_saved_model(
+            FinalExporter("exporter", (None, 784))
+        )
+    est.close()
+
+    per, sl = local_slice_for_process(16)
+    print(json.dumps({
+        "process_id": info.process_id,
+        "step": int(jax.device_get(state.step)),
+        "loss": metrics["loss"],
+        "accuracy": metrics["accuracy"],
+        "chief_gating_ok": (est._writer() is not None) == (info.process_id == 0),
+        "slice": [sl.start, sl.stop],
+        "per_host": per,
+        "export": export_path,
+    }))
+    """
+)
+
+
+def _run_group(script_path, argv, n=2, timeout=300):
+    ports = [_free_port() for _ in range(n)]
+    cluster = {"worker": [f"127.0.0.1:{p}" for p in ports]}
+    procs = []
+    for i in range(n):
+        env = dict(os.environ)
+        env.update(
+            CLUSTER_SPEC=json.dumps(cluster),
+            TASK_INDEX=str(i),
+            JOB_NAME="worker",
+            PYTHONPATH=os.pathsep.join(
+                [os.path.dirname(os.path.dirname(__file__))]
+                + env.get("PYTHONPATH", "").split(os.pathsep)
+            ),
+        )
+        env.pop("TF_CONFIG", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script_path)] + argv,
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
+        results.append(json.loads(out.strip().splitlines()[-1]))
+    return results
+
+
+def test_two_process_estimator_lifecycle_and_resume(tmp_path):
+    """VERDICT r2 #7: the full Estimator lifecycle across 2 real processes —
+    train with chief-only summaries, collective checkpointing, eval, restart
+    the whole group and resume from the checkpoint, final export; OFF-policy
+    host slices reconstruct the global batch."""
+    script = tmp_path / "child_lifecycle.py"
+    script.write_text(_LIFECYCLE_CHILD)
+    model_dir = str(tmp_path / "run")
+
+    first = _run_group(script, ["first", model_dir])
+    assert {r["process_id"] for r in first} == {0, 1}
+    assert all(r["step"] == 10 for r in first)
+    assert all(r["chief_gating_ok"] for r in first)
+    # sync SPMD: both processes computed identical eval metrics
+    assert first[0]["loss"] == pytest.approx(first[1]["loss"])
+    assert first[0]["accuracy"] == first[1]["accuracy"]
+    # OFF-policy slices tile the global batch exactly (data/device.py)
+    slices = sorted(tuple(r["slice"]) for r in first)
+    assert slices == [(0, 8), (8, 16)]
+    assert all(r["per_host"] == 8 for r in first)
+    # checkpoints landed in the shared model_dir
+    ckpts = os.listdir(os.path.join(model_dir, "checkpoints"))
+    assert any(d.isdigit() for d in ckpts)
+
+    # "kill" the cluster (phase-1 processes have exited) and restart
+    resumed = _run_group(script, ["resume", model_dir])
+    assert all(r["step"] == 16 for r in resumed)
+    assert resumed[0]["loss"] == pytest.approx(resumed[1]["loss"])
+    # chief exported; non-chief didn't
+    exports = {r["process_id"]: r["export"] for r in resumed}
+    assert exports[0] is not None and os.path.exists(exports[0])
+    assert exports[1] is None

@@ -2,6 +2,9 @@
 semantics parity with the python pipeline, seed determinism, buffer-aliasing
 contract (SURVEY.md §4)."""
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -100,6 +103,37 @@ def test_matches_python_pipeline_multiset():
     nat = native.NativeBatchLoader([x, y], batch_size=8, seed=5, repeat=1)
     nat_rows = sorted(r for b in nat for r in b[1][:, 0].tolist())
     assert py_rows == nat_rows
+
+
+def test_close_while_consumer_blocked_in_next():
+    """Destroying the loader while a consumer thread is blocked inside
+    next() must wake it (StopIteration) and return promptly — the round-1/2
+    wait predicate ignored `stop`, so this deadlocked (ADVICE r1 low)."""
+    n = 1 << 19
+    data = np.arange(n, dtype=np.int64).reshape(n, 1)
+    # one worker + big batches: the consumer outruns the fill and spends
+    # most of its time blocked in next()
+    loader = native.NativeBatchLoader(
+        [data], batch_size=n // 4, seed=0, num_threads=1, depth=2
+    )
+    consumed = []
+
+    def consume():
+        try:
+            for (b,) in loader:  # infinite repeat: only close() ends this
+                consumed.append(b.shape[0])
+        except Exception:
+            pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)  # let it settle into the blocked-in-next steady state
+    start = time.perf_counter()
+    loader.close()
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "consumer never woke after destroy"
+    assert time.perf_counter() - start < 10.0
+    assert consumed, "consumer never received a batch before close"
 
 
 def test_batch_larger_than_dataset_spans_many_epochs():

@@ -69,6 +69,12 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def reload(self) -> None:
+        """Re-read the checkpoint directory. Orbax caches the step listing
+        at construction; an evaluator job following a live trainer's
+        model_dir must reload to see checkpoints written since."""
+        self._mngr.reload()
+
     def restore_latest(self, state: "TrainState") -> Optional["TrainState"]:
         """Resume-by-default: restore the newest checkpoint into the given
         state's shardings, or None if the directory has no checkpoint."""

@@ -15,6 +15,7 @@ import logging
 import os
 import subprocess
 import threading
+import time
 from pathlib import Path
 from typing import Iterator, Optional, Sequence, Tuple
 
@@ -69,6 +70,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)
             ]
             lib.tfde_loader_release.argtypes = [ctypes.c_void_p]
+            lib.tfde_loader_stop.argtypes = [ctypes.c_void_p]
             lib.tfde_loader_destroy.argtypes = [ctypes.c_void_p]
             _lib = lib
         except Exception as e:  # no toolchain / build error -> python fallback
@@ -146,17 +148,30 @@ class NativeBatchLoader:
             raise RuntimeError("tfde_loader_create failed")
         self._out = (ctypes.c_void_p * n_arr)()
         self._pending_release = False
+        self._close_lock = threading.Lock()
+        self._in_next = 0  # consumers currently inside the native call
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, ...]]:
         return self
 
     def __next__(self) -> Tuple[np.ndarray, ...]:
-        if self._handle is None:
-            raise StopIteration
-        if self._pending_release:
-            self._lib.tfde_loader_release(self._handle)
-            self._pending_release = False
-        rows = self._lib.tfde_loader_next(self._handle, self._out)
+        # capture the handle and count ourselves in under the lock, so a
+        # concurrent close() either (a) sees us and defers the free until we
+        # drain, or (b) swapped the handle first and we stop here — the
+        # handle can never be freed between our check and the native call
+        with self._close_lock:
+            handle = self._handle
+            if handle is None:
+                raise StopIteration
+            self._in_next += 1
+        try:
+            if self._pending_release:
+                self._lib.tfde_loader_release(handle)
+                self._pending_release = False
+            rows = self._lib.tfde_loader_next(handle, self._out)
+        finally:
+            with self._close_lock:
+                self._in_next -= 1
         if rows == 0:
             self.close()
             raise StopIteration
@@ -170,9 +185,23 @@ class NativeBatchLoader:
         return tuple(out)
 
     def close(self) -> None:
-        if self._handle is not None:
-            self._lib.tfde_loader_destroy(self._handle)
-            self._handle = None
+        """Stop workers and free the loader. Safe to call from a second
+        thread while a consumer is anywhere in ``__next__``: stop() wakes a
+        blocked waiter (it raises StopIteration), we wait for in-flight
+        consumers to drain, and only then free — two phases, so a consumer
+        that captured the handle just before the swap still lands on live
+        memory."""
+        with self._close_lock:
+            handle, self._handle = self._handle, None
+        if handle is None:
+            return
+        self._lib.tfde_loader_stop(handle)
+        while True:
+            with self._close_lock:
+                if self._in_next == 0:
+                    break
+            time.sleep(0.001)
+        self._lib.tfde_loader_destroy(handle)
 
     def __del__(self):
         try:

@@ -16,6 +16,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -85,6 +86,7 @@ struct Loader {
   std::atomic<int64_t> next_batch{0};  // claimed by workers
   int64_t consumed = 0;                // consumer cursor
   std::atomic<bool> stop{false};
+  std::atomic<int> active_next{0};  // consumers currently inside next()
   std::vector<std::thread> workers;
 
   std::shared_ptr<const std::vector<int64_t>> permutation_for(int64_t epoch) {
@@ -209,16 +211,29 @@ void* tfde_loader_create(
   return L;
 }
 
-// Blocks for the next batch. Returns rows in the batch (0 = end of data).
-// Buffer pointers for each array are written to out_ptrs; they stay valid
-// until the matching tfde_loader_release call.
+// Blocks for the next batch. Returns rows in the batch (0 = end of data or
+// loader stopped). Buffer pointers for each array are written to out_ptrs;
+// they stay valid until the matching tfde_loader_release call.
 int64_t tfde_loader_next(void* handle, void** out_ptrs) {
   auto* L = (Loader*)handle;
+  // Count the consumer in so a concurrent destroy waits for it to leave
+  // before freeing the loader (destroy racing a blocked next() used to
+  // hang the worker join — and, fixed, would otherwise free slot.mu while
+  // the waiter still held it).
+  L->active_next.fetch_add(1);
+  struct Dec {
+    std::atomic<int>* c;
+    ~Dec() { c->fetch_sub(1); }
+  } dec{&L->active_next};
+  if (L->stop.load()) return 0;
   int64_t b = L->consumed;
   if (L->total_batches >= 0 && b >= L->total_batches) return 0;
   Slot& slot = L->slots[(size_t)b % L->slots.size()];
   std::unique_lock<std::mutex> lk(slot.mu);
-  slot.cv.wait(lk, [&] { return slot.ready && slot.batch_id == b; });
+  slot.cv.wait(lk, [&] {
+    return L->stop.load() || (slot.ready && slot.batch_id == b);
+  });
+  if (L->stop.load()) return 0;
   for (size_t a = 0; a < L->data.size(); ++a)
     out_ptrs[a] = slot.buffers[a].data();
   return slot.rows;
@@ -238,11 +253,28 @@ void tfde_loader_release(void* handle) {
   slot.cv.notify_all();
 }
 
+// Stop workers and wake any blocked consumer WITHOUT freeing — phase one of
+// a safe cross-thread shutdown. The Python binding calls stop, waits for its
+// consumers to drain out of next() (they return 0), then calls destroy; a
+// consumer that captured the handle just before close() swapped it away can
+// still safely enter next() between stop and destroy.
+void tfde_loader_stop(void* handle) {
+  auto* L = (Loader*)handle;
+  L->stop.store(true);
+  for (auto& s : L->slots) s.cv.notify_all();
+}
+
 void tfde_loader_destroy(void* handle) {
   auto* L = (Loader*)handle;
   L->stop.store(true);
   for (auto& s : L->slots) s.cv.notify_all();
   for (auto& t : L->workers) t.join();
+  // Wait out any consumer still inside next() (it wakes on stop and returns
+  // 0); deleting while it holds slot.mu would be use-after-free.
+  while (L->active_next.load() != 0) {
+    for (auto& s : L->slots) s.cv.notify_all();
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
   delete L;
 }
 

@@ -49,10 +49,23 @@ def annotate(name: str):
 
 
 def _parse_window(raw: str) -> Optional[tuple]:
-    """'100:110' -> (100, 110); '100' -> (100, 110) (10-step default)."""
+    """'100:110' -> (100, 110); '100' -> (100, 110) (10-step default);
+    'every:N' -> ('every', N, 10); 'every:N:S' -> ('every', N, S)."""
     raw = raw.strip()
     if not raw or raw in ("0", "false", "False"):
         return None
+    if raw.startswith("every:"):
+        parts = raw.split(":")
+        n = int(parts[1])
+        if n <= 0:  # 'every:0' means disabled, like the documented '0'
+            return None
+        span = int(parts[2]) if len(parts) > 2 else 10
+        if not 0 < span < n:
+            raise ValueError(
+                f"profile window 'every:{n}:{span}': the traced span must "
+                f"be shorter than the period (else the trace never closes)"
+            )
+        return ("every", n, span)
     if ":" in raw:
         a, b = raw.split(":", 1)
         return (int(a), int(b))
@@ -61,21 +74,39 @@ def _parse_window(raw: str) -> Optional[tuple]:
 
 
 class StepWindowProfiler:
-    """Trace a [start, stop) window of training steps into
-    `<logdir>/plugins/profile/` — the ProfilerHook capability
-    (mnist_keras_distributed.py:235-237: save_steps + output_dir), wired
-    into Estimator.train via RunConfig.profile_steps or $TFDE_PROFILE
-    ("start:stop" or "start").
+    """Trace training-step windows into `<logdir>/plugins/profile/` — the
+    ProfilerHook capability (mnist_keras_distributed.py:235-237: save_steps +
+    output_dir), wired into Estimator.train via RunConfig.profile_steps or
+    $TFDE_PROFILE.
+
+    Window forms:
+    - (start, stop) or "start:stop": one trace of steps [start, stop).
+    - "every:N" (or ("every", N, span)): a repeating window — trace `span`
+      steps (default 10) every N steps, i.e. [N, N+span), [2N, 2N+span), ...
+      The ProfilerHook re-traced every save_steps=100; this is that, each
+      window landing in its own timestamped plugins/profile run.
 
     Steps are *global* steps, so on resume the window refers to the same
-    steps it would in an uninterrupted run. The default window starts past
-    step 1 to keep the first-compile out of the trace.
+    steps it would in an uninterrupted run. Windows start past step 1 to
+    keep the first-compile out of the trace.
     """
 
-    def __init__(self, logdir: Optional[str], window: Optional[tuple] = None):
+    def __init__(self, logdir: Optional[str], window=None):
         if window is None:
             window = _parse_window(os.environ.get("TFDE_PROFILE", ""))
+        elif isinstance(window, str):
+            window = _parse_window(window)
+        if window is not None and window[0] == "every":
+            _, n, span = window
+            if n <= 0:
+                window = None
+            elif not 0 < span < n:
+                raise ValueError(
+                    f"profile window ('every', {n}, {span}): span must be "
+                    f"in (0, {n}) or the trace never closes"
+                )
         self._window = window
+        self.windows_traced = 0
         self._logdir = logdir
         self._active = False
         if window is not None and logdir is None:
@@ -96,24 +127,33 @@ class StepWindowProfiler:
     def enabled(self) -> bool:
         return self._window is not None
 
+    def _in_window(self, step: int) -> bool:
+        if self._window[0] == "every":
+            _, n, span = self._window
+            return step >= n and (step % n) < span
+        start, stop = self._window
+        return start <= step < stop
+
     def step(self, step: int) -> None:
         """Call once per train step with the *post-increment* global step."""
         if self._window is None:
             return
-        start, stop = self._window
-        if not self._active and start <= step < stop:
+        in_window = self._in_window(step)
+        if not self._active and in_window:
             log.info(
-                "profiler: tracing steps [%d, %d) -> %s/plugins/profile",
-                step, stop, self._logdir,
+                "profiler: trace window opening at step %d -> %s/plugins/profile",
+                step, self._logdir,
             )
             jax.profiler.start_trace(self._logdir)
             self._active = True
-        elif self._active and step >= stop:
+        elif self._active and not in_window:
             jax.profiler.stop_trace()
             self._active = False
+            self.windows_traced += 1
             log.info("profiler: trace complete at step %d", step)
 
     def close(self) -> None:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            self.windows_traced += 1

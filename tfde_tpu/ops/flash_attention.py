@@ -102,6 +102,14 @@ def _flash_forward(
     causal: bool, block_q: int, block_k: int, interpret: bool,
 ) -> Tuple[jax.Array, jax.Array]:
     b, s, h, d = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        # All tiling below derives from q.shape; a cross-attention call with
+        # longer K/V would silently attend over the wrong range (ADVICE r1).
+        raise ValueError(
+            f"flash_attention requires self-attention shapes: q {q.shape}, "
+            f"k {k.shape}, v {v.shape}; use impl='reference' for "
+            f"cross-attention (Sk != Sq)"
+        )
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k:

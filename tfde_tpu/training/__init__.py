@@ -8,5 +8,6 @@ from tfde_tpu.training.lifecycle import (  # noqa: F401
     RunConfig,
     TrainSpec,
     EvalSpec,
+    continuous_eval,
     train_and_evaluate,
 )

@@ -61,10 +61,12 @@ class RunConfig:
     # throwaway runs. Summaries and export still honor model_dir.
     save_checkpoints_steps: Optional[int] = 500
     keep_checkpoint_max: int = 5
-    # (start, stop) global-step window to capture a profiler trace into
-    # <model_dir>/plugins/profile — the reference's ProfilerHook capability
-    # (mnist_keras:235-237,261). None defers to $TFDE_PROFILE ("start:stop").
-    profile_steps: Optional[Tuple[int, int]] = None
+    # Profiler window(s) captured into <model_dir>/plugins/profile — the
+    # reference's ProfilerHook capability (mnist_keras:235-237,261).
+    # (start, stop) for one global-step window, or "every:N" / "every:N:S"
+    # to re-trace S steps (default 10) every N steps the way
+    # ProfilerHook(save_steps=100) did. None defers to $TFDE_PROFILE.
+    profile_steps: Any = None
     seed: int = 0
 
 
@@ -98,10 +100,18 @@ class Estimator:
         optimizer,
         strategy: Optional[Strategy] = None,
         config: Optional[RunConfig] = None,
+        eval_strategy: Optional[Strategy] = None,
     ):
+        """eval_strategy: evaluate under a *different* strategy than training
+        — the reference's `DistributeConfig(train_distribute=
+        ParameterServerStrategy, eval_distribute=MirroredStrategy)`
+        (mnist_keras_distributed.py:241-243). Defaults to the training
+        strategy. At eval time the train state is device_put onto the eval
+        strategy's shardings and eval_step compiles on its mesh."""
         self.model = model
         self.tx = optimizer
         self.strategy = strategy or MultiWorkerMirroredStrategy()
+        self.eval_strategy = eval_strategy
         self.config = config or RunConfig()
         self._state: Optional[TrainState] = None
         self._ckpt: Optional[CheckpointManager] = None
@@ -250,13 +260,21 @@ class Estimator:
     ) -> dict:
         """Weighted full-dataset metrics (EvalSpec steps=None semantics)."""
         state = self._state_for_inference(input_fn, "evaluate()")
+        strat = self.eval_strategy or self.strategy
+        if self.eval_strategy is not None:
+            # eval_distribute: re-lay the state out per the eval strategy
+            # (the reference evaluates PS-trained variables under
+            # MirroredStrategy, mnist_keras:241-243)
+            from tfde_tpu.training.step import _state_shardings
+
+            state = jax.device_put(state, _state_shardings(strat, state))
         if self._eval_step is None:
-            self._eval_step = make_eval_step(self.strategy, state)
+            self._eval_step = make_eval_step(strat, state)
         totals = None
         n = 0
-        divisor = self.strategy.batch_divisor
+        divisor = strat.batch_divisor
         padded = (pad_batch_for_mesh(b, divisor) for b in input_fn())
-        feed = device_prefetch(padded, self.strategy.mesh)
+        feed = device_prefetch(padded, strat.mesh)
         for batch in feed:
             if steps is not None and n >= steps:
                 break
@@ -279,6 +297,31 @@ class Estimator:
             w.flush()
         log.info("eval[%s] @ step %d: %s", name, step, results)
         return results
+
+    def reload_from_checkpoint(
+        self, input_fn, newer_than: Optional[int] = None
+    ) -> Optional[int]:
+        """Restore the *newest* checkpoint into this estimator, re-reading
+        the directory every call (unlike the resume-by-default path, which
+        restores once) — the continuous-eval flow. Returns the restored
+        global step; None if the directory has no checkpoint yet or none
+        newer than `newer_than` (the cheap no-restore path a polling
+        evaluator takes on idle ticks)."""
+        mngr = self._ckpt_mngr()
+        if mngr is None:
+            return None
+        mngr.reload()  # another process/thread writes this directory
+        latest = mngr.latest_step
+        if latest is None or (newer_than is not None and latest <= newer_than):
+            return None
+        first = next(iter(input_fn()))
+        state = self._ensure_state(first)
+        restored = mngr.restore_latest(state)
+        if restored is None:
+            return None
+        self._state = restored
+        self._from_checkpoint = True
+        return int(jax.device_get(restored.step))
 
     # -- predict -------------------------------------------------------------
     def predict(self, input_fn: Callable[[], Iterable]):
@@ -331,8 +374,66 @@ class Estimator:
             w.close()
 
 
+def continuous_eval(
+    estimator: Estimator,
+    eval_spec: EvalSpec,
+    stop_after_step: Optional[int] = None,
+    poll_secs: Optional[float] = None,
+    idle_timeout_secs: Optional[float] = None,
+    stop_event=None,
+) -> Tuple[int, dict]:
+    """Evaluator-job loop: evaluate each NEW checkpoint in model_dir as it
+    appears — the reference's *separate-cluster* evaluator capability
+    (`train_and_evaluate` runs eval in its own process group concurrently
+    with training, mnist_keras_distributed.py:255-283). Run this from a
+    dedicated process (group) sharing the trainer's model_dir — the
+    TF_CONFIG 'evaluator' role analog — or let
+    `train_and_evaluate(eval_mode="from_checkpoint")` drive it in a thread.
+
+    Stops when `stop_after_step` is reached, `idle_timeout_secs` passes with
+    no new checkpoint, or `stop_event` is set (after a final catch-up pass).
+    Returns (last_evaluated_step, last_metrics).
+    """
+    poll = eval_spec.throttle_secs if poll_secs is None else poll_secs
+    seen, last = -1, {}
+    idle_since = time.time()
+
+    def eval_new() -> bool:
+        nonlocal seen, last, idle_since
+        step = estimator.reload_from_checkpoint(
+            eval_spec.input_fn, newer_than=None if seen < 0 else seen
+        )
+        if step is None or step <= seen:
+            return False
+        seen = step
+        idle_since = time.time()
+        last = estimator.evaluate(eval_spec.input_fn, eval_spec.steps, eval_spec.name)
+        return True
+
+    while True:
+        eval_new()
+        if stop_after_step is not None and seen >= stop_after_step:
+            break
+        if stop_event is not None and stop_event.is_set():
+            # a checkpoint may have landed while we were evaluating: one
+            # final catch-up so the trainer's force-saved last step is seen
+            eval_new()
+            break
+        if (idle_timeout_secs is not None
+                and time.time() - idle_since > idle_timeout_secs):
+            break
+        if stop_event is not None:
+            stop_event.wait(poll)
+        else:
+            time.sleep(poll)
+    return seen, last
+
+
 def train_and_evaluate(
-    estimator: Estimator, train_spec: TrainSpec, eval_spec: EvalSpec
+    estimator: Estimator,
+    train_spec: TrainSpec,
+    eval_spec: EvalSpec,
+    eval_mode: str = "inline",
 ) -> Tuple[TrainState, dict]:
     """The reference's lifecycle loop (mnist_keras:283), explicit:
 
@@ -341,7 +442,23 @@ def train_and_evaluate(
     - a final eval after training completes;
     - then run every exporter (FinalExporter semantics, §3.4).
     Returns (final_state, final_eval_metrics).
+
+    eval_mode:
+    - "inline" (default): eval runs on the training mesh between steps;
+      training pauses for its duration (documented deviation — no idle eval
+      fleet on TPU).
+    - "from_checkpoint": eval runs concurrently in a background thread (on
+      the chief) against the latest checkpoint via `continuous_eval`, so the
+      train-step cadence is unaffected — the reference's concurrent-
+      evaluator behavior in one process. Requires model_dir + checkpointing;
+      single-process only (a multi-process evaluator is a dedicated job
+      running `continuous_eval`, like the reference's evaluator cluster).
     """
+    if eval_mode not in ("inline", "from_checkpoint"):
+        raise ValueError(f"unknown eval_mode {eval_mode!r}")
+    if eval_mode == "from_checkpoint":
+        return _train_with_continuous_eval(estimator, train_spec, eval_spec)
+
     t_start = time.time()
     last_eval = {"t": t_start}
 
@@ -363,4 +480,68 @@ def train_and_evaluate(
     metrics = estimator.evaluate(eval_spec.input_fn, eval_spec.steps, eval_spec.name)
     for exporter in eval_spec.exporters:
         estimator.export_saved_model(exporter)
+    return state, metrics
+
+
+def _train_with_continuous_eval(
+    estimator: Estimator, train_spec: TrainSpec, eval_spec: EvalSpec
+) -> Tuple[TrainState, dict]:
+    import threading
+
+    cfg = estimator.config
+    if cfg.model_dir is None or not cfg.save_checkpoints_steps:
+        raise ValueError(
+            "eval_mode='from_checkpoint' needs model_dir + "
+            "save_checkpoints_steps: eval reads what the trainer checkpoints"
+        )
+    if jax.process_count() > 1:
+        raise ValueError(
+            "eval_mode='from_checkpoint' inside the trainer is single-process "
+            "(a background thread cannot coordinate multi-process collectives); "
+            "run continuous_eval() as a dedicated evaluator job instead"
+        )
+
+    # A separate Estimator instance = the 'evaluator job': own eval-step
+    # compilation (on eval_strategy if given), own checkpoint reader.
+    evaluator = Estimator(
+        estimator.model,
+        estimator.tx,
+        strategy=estimator.eval_strategy or estimator.strategy,
+        config=cfg,
+    )
+    stop = threading.Event()
+    box: dict = {}
+
+    def loop():
+        try:
+            stop.wait(eval_spec.start_delay_secs)
+            box["result"] = continuous_eval(evaluator, eval_spec,
+                                            stop_event=stop)
+        except BaseException as e:  # surfaced to the caller after train
+            box["error"] = e
+
+    thread = threading.Thread(target=loop, daemon=True, name="continuous-eval")
+    thread.start()
+    try:
+        state = estimator.train(
+            train_spec.input_fn,
+            train_spec.max_steps,
+            shard_policy=train_spec.shard_policy,
+        )
+    finally:
+        stop.set()
+    thread.join(timeout=600.0)
+    if thread.is_alive():
+        # don't tear down resources under a still-running eval; leak instead
+        log.error("continuous-eval thread did not finish within 600s; "
+                  "skipping evaluator teardown")
+    else:
+        evaluator.close()
+    if "error" in box:
+        raise RuntimeError(
+            "continuous evaluator failed during training"
+        ) from box["error"]
+    for exporter in eval_spec.exporters:
+        estimator.export_saved_model(exporter)
+    _, metrics = box.get("result", (-1, {}))
     return state, metrics
