@@ -9,8 +9,13 @@ chip, long sequences use the Pallas flash kernel when TFDE_FLASH=1.
 
 `--pipeline S` switches to the stage-stacked PipelinedLM
 (models/pipelined.py) on a {'data': D, 'pipe': S} mesh: each pipe rank holds
-depth/S transformer blocks and microbatches (--microbatches) flow through
-the GPipe schedule via ppermute (parallel/pipeline.py).
+depth/S transformer blocks, microbatches (--microbatches) flow through the
+GPipe schedule via ppermute (parallel/pipeline.py), and the loss rides the
+last-stage reduction (scalars cross the ring, not full logits).
+
+`--moe E` swaps every 2nd block's MLP for an E-expert routed MoE
+(models/moe.py, GShard per-group capacity) and shards the expert weights
+over an 'expert' mesh axis (ExpertParallelStrategy).
 
 Run single-host: python examples/gpt_lm.py --max-steps 200
 CPU smoke:       python examples/gpt_lm.py --fake-devices 8 --tiny \
@@ -55,6 +60,9 @@ def main(argv=None):
                         help="size of the 'pipe' mesh axis (GPipe stages)")
     parser.add_argument("--microbatches", type=int, default=4,
                         help="GPipe microbatches (with --pipeline)")
+    parser.add_argument("--moe", type=int, default=0,
+                        help="experts per MoE block; shards them over an "
+                             "'expert' mesh axis (expert parallelism)")
     parser.add_argument("--tiny", action="store_true")
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--fake-devices", type=int, default=None)
@@ -69,6 +77,10 @@ def main(argv=None):
 
     if args.pipeline > 1 and args.seq_parallel > 1:
         raise ValueError("--pipeline and --seq-parallel don't compose yet")
+    if args.moe > 1 and (args.pipeline > 1 or args.seq_parallel > 1):
+        # loud, not silent: PipelinedLM has no MoE blocks, and the seq/pipe
+        # strategies would drop the expert-axis sharding --moe promises
+        raise ValueError("--moe doesn't compose with --pipeline/--seq-parallel yet")
     if args.pipeline > 1:
         from tfde_tpu.models.pipelined import PipelinedLM, pipelined_tiny_test
 
@@ -88,8 +100,10 @@ def main(argv=None):
                 remat=args.remat,
             )
     else:
-        model = gpt_tiny_test(remat=args.remat) if args.tiny else GPT2Small(
-            remat=args.remat
+        moe = {"num_experts": args.moe} if args.moe > 1 else {}
+        model = (
+            gpt_tiny_test(remat=args.remat, **moe) if args.tiny
+            else GPT2Small(remat=args.remat, **moe)
         )
     if args.seq_len % max(args.seq_parallel, 1) != 0:
         raise ValueError("--seq-len must divide evenly by --seq-parallel")
@@ -124,13 +138,29 @@ def main(argv=None):
                 f"count {n}"
             )
         strategy = SequenceParallelStrategy(data=n // args.seq_parallel)
+    elif args.moe > 1:
+        from tfde_tpu.parallel.strategies import ExpertParallelStrategy
+
+        n = jax.device_count()
+        expert = min(args.moe, n)
+        while n % expert or args.moe % expert:
+            expert -= 1  # largest expert-axis size dividing devices & experts
+        strategy = ExpertParallelStrategy(data=n // expert)
     else:
         strategy = MultiWorkerMirroredStrategy()
 
     state, _ = init_state(
         model, tx, strategy, np.zeros((global_batch, args.seq_len), np.int32)
     )
-    step_fn = make_custom_train_step(strategy, state, next_token_loss)
+    if args.pipeline > 1:
+        # last-stage-reduction loss: only {loss, correct, count} scalars
+        # cross the pipe ring instead of the full-logit broadcast
+        from tfde_tpu.models.pipelined import pipelined_next_token_loss
+
+        loss_fn = pipelined_next_token_loss
+    else:
+        loss_fn = next_token_loss
+    step_fn = make_custom_train_step(strategy, state, loss_fn)
     rng = jax.random.key(1)
     nrng = np.random.default_rng(0)
     t0 = time.time()

@@ -37,6 +37,10 @@ class GPT(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "auto"
     remat: bool = False
+    # > 0 swaps every `moe_every`-th block's MLP for a routed expert MLP
+    # (models/moe.py) — train under ExpertParallelStrategy to shard experts
+    num_experts: int = 0
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, train: bool = False) -> jax.Array:
@@ -64,6 +68,8 @@ class GPT(nn.Module):
             attn_impl=self.attn_impl,
             causal=True,
             remat=self.remat,
+            num_experts=self.num_experts,
+            moe_every=self.moe_every,
             name="decoder",
         )(x, train=train)
         logits = wte.attend(x.astype(self.dtype)).astype(jnp.float32)

@@ -15,10 +15,14 @@ Forward is a Pallas kernel (per /opt/skills/guides/pallas_guide.md):
 - causal masking predicates whole future K-tiles off (pl.when), halving the
   work for causal models rather than masking it.
 
-Backward is blockwise JAX (custom_vjp): recompute P per K-tile from the
-saved logsumexp under lax.scan — also O(S) memory, XLA-fused matmuls. A
-Pallas backward is a later optimization; the contract (numerics + memory
-scaling) is already met.
+Backward (round 3) is a pair of Pallas kernels, the FlashAttention-2
+arrangement: a dK/dV kernel (grid K-major, Q minor: each K tile's grads
+accumulate in VMEM scratch while Q tiles stream past) and a dQ kernel (grid
+Q-major, K minor) — both recompute P from the saved logsumexp, O(S) memory,
+fp32 accumulation, causal tiles predicated off. `delta = rowsum(dO*O)` is
+precomputed in JAX. The previous blockwise-JAX backward remains as
+`TFDE_FLASH_BWD=jax` (fallback + an independent numerics oracle for the
+kernel tests).
 
 Ring attention (ops/ring_attention.py) composes with this by construction:
 its per-device block computation is the same recurrence, so the flash kernel
@@ -196,6 +200,197 @@ def _bwd_blockwise(res, g, *, causal: bool, block_k: int):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, causal, scale,
+):
+    # grid (B, H, Sk/bk, Sq/bq) with the Q dimension minor: one K/V tile's
+    # gradient accumulators live in VMEM scratch while every Q tile streams
+    # past; refs are BHSD tiles [1, 1, bq|bk, D], lse/delta [1, 1, bq, 1].
+    kb = pl.program_id(2)
+    qi = pl.program_id(3)
+    num_qi = pl.num_programs(3)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _step():
+        q = q_ref[0, 0]          # [bq, D]
+        k_blk = k_ref[0, 0]      # [bk, D]
+        v_blk = v_ref[0, 0]
+        do = do_ref[0, 0]        # [bq, D]
+        lse = lse_ref[0, 0]      # [bq, 1]
+        delta = delta_ref[0, 0]  # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        # dV += P^T dO
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dP = dO V^T ; dS = P * (dP - delta) * scale
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale  # [bq, bk]
+        # dK += dS^T Q
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Q tiles strictly above this K tile's first column see none of it
+        pl.when((qi + 1) * bq - 1 >= kb * bk)(_step)
+    else:
+        _step()
+
+    @pl.when(qi == num_qi - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, causal, scale,
+):
+    # grid (B, H, Sq/bq, Sk/bk) with K minor: one Q tile's dQ accumulates in
+    # VMEM scratch while K/V tiles stream past (same traversal as forward).
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    num_kb = pl.num_programs(3)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _step():
+        q = q_ref[0, 0]
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale  # [bq, bk]
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(kb * bk <= (qi + 1) * bq - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
+                interpret: bool):
+    """FlashAttention-2 backward: dK/dV kernel + dQ kernel, O(S) memory."""
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    from jax.experimental.pallas import tpu as pltpu
+
+    # delta[b,h,s] = rowsum(dO * O), fp32 — cheap elementwise, stays in JAX
+    delta = jnp.einsum(
+        "bshd,bshd->bhs", g.astype(jnp.float32), out.astype(jnp.float32)
+    )
+    # BSHD -> BHSD tiles; lse/delta -> [b,h,s,1] so the tile minor dim is 1
+    qt, kt, vt, gt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v, g))
+    lse4 = lse[..., None]
+    delta4 = delta[..., None]
+
+    def tile(n, idx):
+        return pl.BlockSpec((1, 1, n, d), idx)
+
+    def col(n, idx):
+        return pl.BlockSpec((1, 1, n, 1), idx)
+
+    kq_q = lambda bi, hi, kb, qi: (bi, hi, qi, 0)  # Q-streaming tiles
+    kq_k = lambda bi, hi, kb, qi: (bi, hi, kb, 0)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale),
+        grid=(b, h, s // block_k, s // block_q),
+        in_specs=[
+            tile(block_q, kq_q),   # q
+            tile(block_k, kq_k),   # k
+            tile(block_k, kq_k),   # v
+            tile(block_q, kq_q),   # dO
+            col(block_q, kq_q),    # lse
+            col(block_q, kq_q),    # delta
+        ],
+        out_specs=[tile(block_k, kq_k), tile(block_k, kq_k)],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse4, delta4)
+
+    qk_q = lambda bi, hi, qi, kb: (bi, hi, qi, 0)
+    qk_k = lambda bi, hi, qi, kb: (bi, hi, kb, 0)
+    (dq,) = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale),
+        grid=(b, h, s // block_q, s // block_k),
+        in_specs=[
+            tile(block_q, qk_q),
+            tile(block_k, qk_k),
+            tile(block_k, qk_k),
+            tile(block_q, qk_q),
+            col(block_q, qk_q),
+            col(block_q, qk_q),
+        ],
+        out_specs=[tile(block_q, qk_q)],
+        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse4, delta4)
+
+    return (
+        jnp.swapaxes(dq, 1, 2),
+        jnp.swapaxes(dk, 1, 2),
+        jnp.swapaxes(dv, 1, 2),
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(
     q: jax.Array,
@@ -217,7 +412,12 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
-    return _bwd_blockwise(res, g, causal=causal, block_k=block_k)
+    import os
+
+    if os.environ.get("TFDE_FLASH_BWD", "pallas") == "jax":
+        return _bwd_blockwise(res, g, causal=causal, block_k=block_k)
+    return _bwd_pallas(res, g, causal=causal, block_q=block_q,
+                       block_k=block_k, interpret=interpret)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -36,8 +36,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG = -1e30  # finite -inf stand-in: keeps exp() NaN-free on fully-masked blocks
 
 
-def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal):
-    """One online-softmax accumulation step against the current KV block."""
+def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal):
+    """One online-softmax accumulation step against one KV chunk."""
     o, m, l = carry
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -59,6 +59,37 @@ def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal):
     return o_new, m_new, l_new
 
 
+def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
+                     block_k: int = 1024):
+    """Online-softmax accumulation against the current KV shard, blockwise:
+    the shard is scanned in `block_k` chunks so per-device score memory is
+    O(sq * block_k), never O(sq * sk_shard) — the 'blockwise' half of ring
+    attention's memory story (the ring shards the sequence across chips;
+    this keeps each chip's local block from re-materializing a quadratic
+    score tensor at large per-chip shards). Shards at or below `block_k`
+    take the single-chunk path unchanged."""
+    sk = k.shape[1]
+    if sk <= block_k or sk % block_k:
+        return _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal)
+
+    def chunk(carry, i):
+        start = i * block_k
+        kc = jax.lax.dynamic_slice_in_dim(k, start, block_k, axis=1)
+        vc = jax.lax.dynamic_slice_in_dim(v, start, block_k, axis=1)
+        kvc = (
+            None if kv_valid is None
+            else jax.lax.dynamic_slice_in_dim(kv_valid, start, block_k, axis=1)
+        )
+        kpc = jax.lax.dynamic_slice_in_dim(k_pos, start, block_k, axis=0)
+        return (
+            _chunk_attention(carry, q, kc, vc, kvc, q_pos, kpc, causal),
+            None,
+        )
+
+    carry, _ = jax.lax.scan(chunk, carry, jnp.arange(sk // block_k))
+    return carry
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -67,12 +98,14 @@ def ring_attention(
     causal: bool = False,
     mesh: Optional[Mesh] = None,
     axis: str = "seq",
+    block_k: int = 1024,
 ) -> jax.Array:
     """[B, S, H, D] attention with S sharded over `axis` of `mesh`.
 
     Global arrays in, global arrays out — call it like any attention; the
     shard_map inside binds the mesh axes. Degrades to a single local block
     (i.e. plain blockwise attention) when the mesh has no 'seq' axis.
+    `block_k` caps the per-chip score-tensor chunk (see _block_attention).
     """
     if mesh is None or axis not in mesh.axis_names:
         raise ValueError(
@@ -119,7 +152,8 @@ def ring_attention(
             src = (idx - t) % n  # whose KV shard we hold at step t
             k_pos = src * sq + jnp.arange(sq)
             o_m_l = _block_attention(
-                o_m_l, q, k, v, kv_valid, q_pos, k_pos, causal
+                o_m_l, q, k, v, kv_valid, q_pos, k_pos, causal,
+                block_k=block_k,
             )
             # rotate KV one hop; skipped after the last accumulation
             def rotate(args):
