@@ -60,3 +60,30 @@ def test_load_resolves_latest_timestamp(tmp_path):
     p1 = exporter.export(base, lambda v, x: m.apply(v, x, train=False), variables)
     served = load_serving(os.path.join(base, "export", "exporter"))
     assert served.predict(np.zeros((2, 784), np.float32)).shape == (2, 10)
+
+
+def test_export_token_model_int_signature(tmp_path):
+    """Transformer-era serving: a GPT export over int32 token ids — the
+    export layer isn't MNIST-shaped (SURVEY.md §3.4 generalized to the
+    scale-config model families)."""
+    from tfde_tpu.models.gpt import gpt_tiny_test
+
+    m = gpt_tiny_test()
+    toks = jnp.zeros((1, 16), jnp.int32)
+    variables = m.init(jax.random.key(0), toks, train=False)
+
+    def apply_fn(v, x):
+        return m.apply(v, x, train=False)
+
+    out = export_serving(
+        apply_fn, variables, (None, 16), str(tmp_path / "exp"),
+        input_dtype=jnp.int32,
+    )
+    sig = json.load(open(os.path.join(out, "signature.json")))
+    assert sig["input"]["dtype"] == "int32"
+
+    served = load_serving(out)
+    x = np.random.default_rng(0).integers(0, 97, (3, 16)).astype(np.int32)
+    probs = served.predict(x)
+    assert probs.shape == (3, 16, 97)
+    np.testing.assert_allclose(probs.sum(-1), np.ones((3, 16)), rtol=1e-4)

@@ -270,3 +270,38 @@ def test_continuous_eval_requires_checkpointing():
             est, TrainSpec(train_fn, max_steps=2), EvalSpec(eval_fn),
             eval_mode="from_checkpoint",
         )
+
+
+def test_continuous_eval_under_different_strategy(tmp_path):
+    """The two round-3 eval features compose: a PS-trained (ZeRO-1) run with
+    a continuous evaluator that restores checkpoints directly into a
+    MirroredStrategy layout."""
+    from tfde_tpu.parallel.strategies import (
+        MirroredStrategy,
+        ParameterServerStrategy,
+    )
+
+    train_fn, eval_fn = _input_fns()
+    cfg = RunConfig(model_dir=str(tmp_path / "run"), save_checkpoints_steps=5)
+    est = Estimator(
+        PlainCNN(), optax.sgd(0.1),
+        strategy=ParameterServerStrategy(),
+        eval_strategy=MirroredStrategy(),
+        config=cfg,
+    )
+    state, metrics = train_and_evaluate(
+        est,
+        TrainSpec(train_fn, max_steps=12),
+        EvalSpec(eval_fn, start_delay_secs=0, throttle_secs=0.2),
+        eval_mode="from_checkpoint",
+    )
+    est.close()
+    assert int(jax.device_get(state.step)) == 12
+    assert np.isfinite(metrics["loss"])
+
+    # and the metrics equal an inline same-checkpoint eval
+    ref = Estimator(PlainCNN(), optax.sgd(0.1), config=cfg)
+    m2 = ref.evaluate(eval_fn)
+    ref.close()
+    assert metrics["accuracy"] == m2["accuracy"]
+    np.testing.assert_allclose(metrics["loss"], m2["loss"], rtol=1e-6)

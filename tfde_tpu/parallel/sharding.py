@@ -39,8 +39,13 @@ def batch_spec(mesh: Mesh, extra_dims: int = 0) -> P:
     return P(axes[0] if len(axes) == 1 else axes, *(None,) * extra_dims)
 
 
-def _largest_divisible_dim(shape: Sequence[int], size: int, min_elems: int) -> Optional[int]:
-    """Pick the largest dim divisible by `size`, if the array is big enough."""
+def _largest_divisible_dim(
+    shape: Sequence[int], size: int, min_elems: int,
+    eligible: Optional[Callable[[int], bool]] = None,
+) -> Optional[int]:
+    """Pick the largest dim divisible by `size`, if the array is big enough;
+    `eligible(dim_index)` restricts the candidates (add_axis_to_spec uses it
+    to skip already-sharded dims)."""
     total = 1
     for s in shape:
         total *= s
@@ -48,6 +53,8 @@ def _largest_divisible_dim(shape: Sequence[int], size: int, min_elems: int) -> O
         return None
     best, best_size = None, 0
     for i, s in enumerate(shape):
+        if eligible is not None and not eligible(i):
+            continue
         if s % size == 0 and s > best_size:
             best, best_size = i, s
     return best
@@ -87,6 +94,33 @@ def shard_pytree_spec(
         return P(*spec)
 
     return jax.tree_util.tree_map_with_path(leaf_spec, tree)
+
+
+def add_axis_to_spec(
+    spec: P, shape: Sequence[int], mesh: Mesh, axis: str,
+    min_elems: int = 2**14,
+) -> P:
+    """Layer `axis` onto an existing PartitionSpec: shard the largest dim the
+    spec leaves unsharded (divisible by the axis size; big-enough arrays
+    only). The ZeRO-over-TP composition primitive — e.g. a Megatron qkv
+    kernel P(None, 'tensor', None) gains 'data' on its embed dim for ZeRO-1
+    optimizer-state sharding."""
+    size = mesh.shape[axis]
+    if size <= 1 or not shape:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for e in entries:
+        # a mesh axis may map to at most one dimension: leave specs that
+        # already use `axis` (possibly inside a tuple entry) untouched
+        if e == axis or (isinstance(e, tuple) and axis in e):
+            return spec
+    best = _largest_divisible_dim(
+        shape, size, min_elems, eligible=lambda i: entries[i] is None
+    )
+    if best is None:
+        return spec
+    entries[best] = axis
+    return P(*entries)
 
 
 def replicated_spec(tree: Any) -> Any:

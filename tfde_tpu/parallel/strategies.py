@@ -55,11 +55,18 @@ class Strategy:
     def params_spec(self, params: Any) -> Any:
         return shd.replicated_spec(params)
 
+    def _opt_params_spec(self, params: Any) -> Any:
+        """Specs used for params-shaped optimizer slots (mu/nu/trace).
+        Defaults to the params' own specs; strategies that shard optimizer
+        state differently from params (ZeRO-1 layered on TP) override."""
+        return self.params_spec(params)
+
     def opt_state_spec(self, opt_state: Any, params: Any) -> Any:
         """Optimizer state follows params: any sub-tree of the optimizer state
         that is *structurally* a params tree (optax mu/nu/trace slots) gets the
-        params' specs; everything else (counts, scalars) replicates."""
-        pspec = self.params_spec(params)
+        `_opt_params_spec` specs; everything else (counts, scalars)
+        replicates."""
+        pspec = self._opt_params_spec(params)
         ptreedef = jax.tree_util.tree_structure(params)
 
         def walk(node):
@@ -191,14 +198,23 @@ class TensorParallelStrategy(Strategy):
 
     `extra_rules`: optional [(predicate(names)->bool, spec_fn(shape)->P)]
     applied before the built-ins, for model-specific overrides.
+
+    `zero1=True` composes ZeRO-1 on top: params-shaped optimizer slots
+    (Adam mu/nu) additionally shard their largest TP-unsharded dim over
+    'data' (sharding.add_axis_to_spec) — the Megatron+ZeRO combination,
+    same memory story as ParameterServerStrategy but under a TP layout.
     """
 
     _COLUMN = ("query", "key", "value", "fc1")   # shard output dim(s)
     _ROW = ("out", "fc2")                        # shard input dim(s)
 
-    def __init__(self, mesh: Optional[Mesh] = None, data: int = 1, extra_rules=()):
+    def __init__(self, mesh: Optional[Mesh] = None, data: int = 1,
+                 extra_rules=(), zero1: bool = False,
+                 min_shard_elems: int = 2**14):
         self._data = data
         self._extra = tuple(extra_rules)
+        self._zero1 = zero1
+        self._min = min_shard_elems
         super().__init__(mesh)
 
     def _default_mesh(self) -> Mesh:
@@ -231,6 +247,19 @@ class TensorParallelStrategy(Strategy):
             return P()
 
         return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+    def _opt_params_spec(self, params: Any) -> Any:
+        pspec = self.params_spec(params)
+        if not self._zero1:
+            return pspec
+        return jax.tree_util.tree_map(
+            lambda sp, leaf: shd.add_axis_to_spec(
+                sp, getattr(leaf, "shape", ()), self.mesh, "data",
+                min_elems=self._min,
+            ),
+            pspec, params,
+            is_leaf=lambda x: isinstance(x, P),
+        )
 
 
 class ExpertParallelStrategy(Strategy):
