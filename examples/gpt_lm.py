@@ -11,7 +11,10 @@ chip, long sequences use the Pallas flash kernel when TFDE_FLASH=1.
 (models/pipelined.py) on a {'data': D, 'pipe': S} mesh: each pipe rank holds
 depth/S transformer blocks, microbatches (--microbatches) flow through the
 GPipe schedule via ppermute (parallel/pipeline.py), and the loss rides the
-last-stage reduction (scalars cross the ring, not full logits).
+last-stage reduction (scalars cross the ring, not full logits). Add
+`--tensor T` for 3D dp x pp x tp: stage weights also shard Megatron-style
+over a 'tensor' axis, with the pipe in partial-manual shard_map mode so the
+automatic partitioner handles the tensor collectives inside the ring.
 
 `--moe E` swaps every 2nd block's MLP for an E-expert routed MoE
 (models/moe.py, GShard per-group capacity) and shards the expert weights
@@ -60,6 +63,9 @@ def main(argv=None):
                         help="size of the 'pipe' mesh axis (GPipe stages)")
     parser.add_argument("--microbatches", type=int, default=4,
                         help="GPipe microbatches (with --pipeline)")
+    parser.add_argument("--tensor", type=int, default=1,
+                        help="with --pipeline: Megatron tensor-parallel "
+                             "size inside each stage (dp x pp x tp, 3D)")
     parser.add_argument("--moe", type=int, default=0,
                         help="experts per MoE block; shards them over an "
                              "'expert' mesh axis (expert parallelism)")
@@ -81,6 +87,11 @@ def main(argv=None):
         # loud, not silent: PipelinedLM has no MoE blocks, and the seq/pipe
         # strategies would drop the expert-axis sharding --moe promises
         raise ValueError("--moe doesn't compose with --pipeline/--seq-parallel yet")
+    if args.tensor > 1 and args.pipeline <= 1:
+        raise ValueError(
+            "--tensor requires --pipeline (3D dp x pp x tp); for TP without "
+            "pipelining use TensorParallelStrategy via a custom entrypoint"
+        )
     if args.pipeline > 1:
         from tfde_tpu.models.pipelined import PipelinedLM, pipelined_tiny_test
 
@@ -123,12 +134,15 @@ def main(argv=None):
         from tfde_tpu.parallel.strategies import PipelineParallelStrategy
 
         n = jax.device_count()
-        if n % args.pipeline:
+        if n % (args.pipeline * args.tensor):
             raise ValueError(
-                f"--pipeline {args.pipeline} must divide the device count {n}"
+                f"--pipeline {args.pipeline} x --tensor {args.tensor} must "
+                f"divide the device count {n}"
             )
         strategy = PipelineParallelStrategy(
-            data=n // args.pipeline, pipe=args.pipeline
+            data=n // (args.pipeline * args.tensor),
+            pipe=args.pipeline,
+            tensor=args.tensor,
         )
     elif args.seq_parallel > 1:
         n = jax.device_count()

@@ -104,3 +104,40 @@ def test_pipeline_rejects_stage_count_mismatch(rng):
         pipeline_apply(
             _stage_fn, stack_stage_params(stages), jnp.zeros((2, 2, 4)), mesh
         )
+
+
+@pytest.mark.parametrize("s,m", [(2, 4), (4, 8)])
+def test_pipeline_auto_mode_matches_sequential(rng, s, m):
+    """mode='auto' (manual over 'pipe' only; data under the automatic
+    partitioner) must equal the sequential stage application — same contract
+    as the fully-manual mode."""
+    mesh = _mesh({"data": 2, "pipe": s})
+    stages = _stages(rng, s, 8)
+    x = jnp.asarray(rng.standard_normal((m, 4, 8)), jnp.float32)
+    got = jax.jit(
+        lambda p, x: pipeline_apply(_stage_fn, p, x, mesh, mode="auto")
+    )(stack_stage_params(stages), x)
+    want = _sequential(stages, x.reshape(m * 4, 8)).reshape(m, 4, 8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_auto_mode_gradients_match_manual(rng):
+    mesh = _mesh({"data": 2, "pipe": 2})
+    stages = stack_stage_params(_stages(rng, 2, 8))
+    x = jnp.asarray(rng.standard_normal((4, 4, 8)), jnp.float32)
+
+    def loss(mode):
+        def fn(p):
+            return jnp.sum(pipeline_apply(_stage_fn, p, x, mesh, mode=mode) ** 2)
+        return fn
+
+    va, ga = jax.jit(jax.value_and_grad(loss("auto")))(stages)
+    vm, gm = jax.jit(jax.value_and_grad(loss("manual")))(stages)
+    np.testing.assert_allclose(float(va), float(vm), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        ga, gm,
+    )

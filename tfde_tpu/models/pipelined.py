@@ -69,6 +69,11 @@ class PipelinedLM:
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"
     remat: bool = False  # jax.checkpoint each block: HBM for FLOPs
+    # pipeline_apply execution mode: None auto-selects — 'auto' (partial-
+    # manual shard_map; required for tensor-parallel stage weights, dp x pp
+    # x tp) when the mesh has a >1 'tensor' axis, the proven fully-'manual'
+    # ring otherwise. Set explicitly to force either.
+    pipeline_mode: Optional[str] = None
 
     @property
     def depth(self) -> int:
@@ -161,10 +166,11 @@ class PipelinedLM:
         return logits.astype(jnp.float32)
 
     def _make_layer_fn(self, train: bool, base_key, in_pipe: bool,
-                       shard_axes: tuple = ()):
+                       shard_axes: tuple = (), auto_axes: bool = False):
         """One block application, scanned over a stage's layers. Carries
         (h, mb_idx); per-layer dropout key = fold_in(base, mb, layer) plus,
-        inside the pipe, the data-shard index (see _dropout_base)."""
+        inside the fully-manual pipe, the data-shard index (see
+        _dropout_base; in auto mode masks are global, no fold needed)."""
         block = self._block()
 
         def layer(carry, lp_li):
@@ -178,11 +184,17 @@ class PipelinedLM:
                 for a in shard_axes:
                     key = jax.random.fold_in(key, jax.lax.axis_index(a))
                 kwargs["rngs"] = {"dropout": key}
-            if in_pipe:
-                # use_axes(None): inside shard_map every mesh axis is
-                # manual, so the blocks' `constrain` annotations (which name
-                # full-mesh axes) must degrade to identity here.
+            if in_pipe and not auto_axes:
+                # fully-manual shard_map: every mesh axis is manual, so the
+                # blocks' `constrain` annotations (which name full-mesh
+                # axes) must degrade to identity here.
                 with axes_lib.use_axes(None):
+                    h = block.apply({"params": lp}, h, None, train, **kwargs)
+            elif in_pipe:
+                # partial-manual (auto) mode: non-pipe axes stay under the
+                # automatic partitioner — bind constraints to the abstract
+                # mesh so 'tensor'/'data' annotations apply inside the ring
+                with axes_lib.use_axes(jax.sharding.get_abstract_mesh()):
                     h = block.apply({"params": lp}, h, None, train, **kwargs)
             else:
                 h = block.apply({"params": lp}, h, None, train, **kwargs)
@@ -194,13 +206,20 @@ class PipelinedLM:
             )
         return layer
 
+    def _pipe_mode(self, mesh) -> str:
+        if self.pipeline_mode is not None:
+            return self.pipeline_mode
+        tensor = "tensor" in mesh.axis_names and mesh.shape["tensor"] > 1
+        return "auto" if tensor else "manual"
+
     def _make_stage_fn(self, train: bool, base_key, mesh=None):
         from tfde_tpu.parallel.sharding import data_axes as _data_axes
 
+        auto = mesh is not None and self._pipe_mode(mesh) == "auto"
         shard_axes = _data_axes(mesh) if (mesh is not None and base_key
-                                          is not None) else ()
+                                          is not None and not auto) else ()
         layer = self._make_layer_fn(train, base_key, in_pipe=True,
-                                    shard_axes=shard_axes)
+                                    shard_axes=shard_axes, auto_axes=auto)
         lps = self.layers_per_stage
 
         def stage_fn(stage_params, h, mb_idx):
@@ -280,7 +299,7 @@ class PipelinedLM:
             xm = self._microbatched(x)
             xm = pipeline_apply(
                 self._make_stage_fn(train, base_key, mesh), p["stages"],
-                xm, mesh,
+                xm, mesh, mode=self._pipe_mode(mesh),
             )
             x = xm.reshape((batch, seq, self.hidden_size))
         else:
@@ -336,6 +355,7 @@ class PipelinedLM:
         red = pipeline_apply(
             self._make_stage_fn(train, base_key, mesh), p["stages"], xm, mesh,
             reduce_fn=reduce_fn, reduce_aux=labels_m, extra_params=extra,
+            mode=self._pipe_mode(mesh),
         )
         denom = jnp.maximum(red["count"], 1.0)
         loss = red["loss_sum"] / denom

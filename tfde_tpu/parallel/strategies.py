@@ -176,6 +176,33 @@ def _path_names(path) -> tuple:
     return tuple(names)
 
 
+_TP_COLUMN = ("query", "key", "value", "fc1")   # shard output dim(s)
+_TP_ROW = ("out", "fc2")                        # shard input dim(s)
+
+
+def _megatron_tensor_dim(module: str, kind: str, shape, tsize: int,
+                         offset: int = 0):
+    """Dim index to split over 'tensor' per the Megatron column/row rules,
+    or None. `offset` skips leading stacking dims (the [S, L] prefix of
+    pipelined stage leaves) — one rule shared by the 2D and 3D strategies
+    so they cannot drift."""
+    if tsize <= 1:
+        return None
+    body = shape[offset:]
+    if module in _TP_COLUMN:
+        # qkv [embed, heads, hd] / fc1 [embed, ffn]: split dim 1
+        if kind == "kernel" and len(body) >= 2 and body[1] % tsize == 0:
+            return offset + 1
+        # qkv bias [heads, hd] / fc1 bias [ffn]: split dim 0
+        if kind == "bias" and len(body) >= 1 and body[0] % tsize == 0:
+            return offset
+        return None
+    # out [heads, hd, embed] / fc2 [ffn, embed]: split dim 0
+    if module in _TP_ROW and kind == "kernel" and len(body) >= 1             and body[0] % tsize == 0:
+        return offset
+    return None
+
+
 class TensorParallelStrategy(Strategy):
     """Megatron-style tensor parallelism over the 'tensor' mesh axis.
 
@@ -205,8 +232,8 @@ class TensorParallelStrategy(Strategy):
     same memory story as ParameterServerStrategy but under a TP layout.
     """
 
-    _COLUMN = ("query", "key", "value", "fc1")   # shard output dim(s)
-    _ROW = ("out", "fc2")                        # shard input dim(s)
+    _COLUMN = _TP_COLUMN
+    _ROW = _TP_ROW
 
     def __init__(self, mesh: Optional[Mesh] = None, data: int = 1,
                  extra_rules=(), zero1: bool = False,
@@ -233,18 +260,12 @@ class TensorParallelStrategy(Strategy):
                 return P()
             module = names[-2] if len(names) >= 2 else ""
             kind = names[-1]
-            if module in self._COLUMN:
-                if kind == "kernel" and len(shape) >= 2 and shape[1] % tsize == 0:
-                    # qkv [embed, heads, hd] / fc1 [embed, ffn]: split dim 1
-                    return P(None, "tensor", *(None,) * (len(shape) - 2))
-                if kind == "bias" and shape[0] % tsize == 0:
-                    # qkv bias [heads, hd] / fc1 bias [ffn]: split dim 0
-                    return P("tensor", *(None,) * (len(shape) - 1))
+            dim = _megatron_tensor_dim(module, kind, shape, tsize)
+            if dim is None:
                 return P()
-            if module in self._ROW and kind == "kernel" and shape[0] % tsize == 0:
-                # out [heads, hd, embed] / fc2 [ffn, embed]: split dim 0
-                return P("tensor", *(None,) * (len(shape) - 1))
-            return P()
+            spec = [None] * len(shape)
+            spec[dim] = "tensor"
+            return P(*spec)
 
         return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
@@ -335,6 +356,15 @@ class PipelineParallelStrategy(Strategy):
 
     The optimizer state follows the params (inherited opt_state_spec walk),
     so each pipe rank also owns only its stage's Adam moments.
+
+    `tensor > 1` composes Megatron tensor parallelism INSIDE the stages
+    (dp x pp x tp, 3D): stage-stacked block weights additionally shard
+    their column/row dims over a 'tensor' axis — the same rules as
+    TensorParallelStrategy, offset by the [num_stages, layers_per_stage]
+    leading dims. Requires the model to run the pipe in partial-manual
+    ('auto') mode (models/pipelined.PipelinedLM auto-selects it when the
+    mesh has a tensor axis) so the automatic partitioner handles the
+    tensor collectives inside the ring.
     """
 
     def __init__(
@@ -342,35 +372,50 @@ class PipelineParallelStrategy(Strategy):
         mesh: Optional[Mesh] = None,
         data: int = 1,
         pipe: Optional[int] = None,
+        tensor: int = 1,
     ):
         self._data = data
         self._pipe = pipe
+        self._tensor = tensor
         super().__init__(mesh)
 
     def _default_mesh(self) -> Mesh:
+        axes = {"data": self._data, "pipe": self._pipe or -1}
+        if self._tensor > 1:
+            axes["tensor"] = self._tensor
         if self._pipe is not None:
-            # explicit stage count: use the first data*pipe devices so the
-            # mesh matches the model's num_stages even when the host has more
-            devices = jax.devices()[: self._data * self._pipe]
-            return mesh_lib.make_mesh(
-                {"data": self._data, "pipe": self._pipe}, devices
-            )
-        return mesh_lib.make_mesh({"data": self._data, "pipe": -1})
+            # explicit stage count: use the first data*pipe*tensor devices
+            # so the mesh matches the model's num_stages even when the host
+            # has more
+            devices = jax.devices()[: self._data * self._pipe * self._tensor]
+            return mesh_lib.make_mesh(axes, devices)
+        return mesh_lib.make_mesh(axes)
 
     def params_spec(self, params: Any) -> Any:
         psize = self.mesh.shape["pipe"]
+        tsize = self.mesh.shape.get("tensor", 1)
+        if tsize > 1 and psize <= 1:
+            raise ValueError(
+                "PipelineParallelStrategy with a 'tensor' axis but pipe<=1 "
+                "would replicate every weight across the tensor devices — "
+                "use TensorParallelStrategy for TP without pipelining"
+            )
 
         def leaf_spec(path, leaf):
             names = _path_names(path)
             shape = getattr(leaf, "shape", ())
-            if (
-                psize > 1
-                and "stages" in names
-                and shape
-                and shape[0] == psize
-            ):
-                return P("pipe", *(None,) * (len(shape) - 1))
-            return P()
+            if not (psize > 1 and "stages" in names and shape
+                    and shape[0] == psize):
+                return P()
+            spec = ["pipe"] + [None] * (len(shape) - 1)
+            if tsize > 1 and len(names) >= 2:
+                # the shared Megatron rules, offset past [S, L]
+                dim = _megatron_tensor_dim(
+                    names[-2], names[-1], shape, tsize, offset=2
+                )
+                if dim is not None:
+                    spec[dim] = "tensor"
+            return P(*spec)
 
         return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
