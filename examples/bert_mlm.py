@@ -69,7 +69,8 @@ def main(argv=None):
     parser.add_argument("--train-examples", type=int, default=8192)
     parser.add_argument("--model-dir", type=str, default=None)
     parser.add_argument("--tiny", action="store_true", help="CI-sized model")
-    parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--remat", nargs="?", const="full", default=False,
+                        choices=["full", "dots"])
     parser.add_argument("--fake-devices", type=int, default=None)
     args, _ = parser.parse_known_args(argv)
 

@@ -70,7 +70,8 @@ def main(argv=None):
                         help="experts per MoE block; shards them over an "
                              "'expert' mesh axis (expert parallelism)")
     parser.add_argument("--tiny", action="store_true")
-    parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--remat", nargs="?", const="full", default=False,
+                        choices=["full", "dots"])
     parser.add_argument("--fake-devices", type=int, default=None)
     args, _ = parser.parse_known_args(argv)
 

@@ -74,8 +74,11 @@ def main(argv=None):
     parser.add_argument("--data", type=int, default=1,
                         help="size of the 'data' mesh axis; 'fsdp' fills the rest")
     parser.add_argument("--tiny", action="store_true", help="CI-sized model")
-    parser.add_argument("--remat", action="store_true",
-                        help="checkpoint each block: HBM for FLOPs")
+    parser.add_argument("--remat", nargs="?", const="full", default=False,
+                        choices=["full", "dots"],
+                        help="checkpoint each block: bare --remat recomputes "
+                             "everything; '--remat dots' saves MXU outputs "
+                             "and recomputes only elementwise ops")
     parser.add_argument("--fake-devices", type=int, default=None)
     args, _ = parser.parse_known_args(argv)
 

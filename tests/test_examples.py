@@ -65,3 +65,21 @@ def test_cifar_resnet_example_smoke():
         ["--max-steps", "2", "--batch-size", "8"]  # 8 fake devices -> divisible
     )
     assert int(jax.device_get(state.step)) == 2
+
+
+def test_gpt_lm_example_3d_and_moe_smoke():
+    """gpt_lm's round-3 surfaces: 3D (--pipeline x --tensor) and --moe run
+    a couple of steps end-to-end on the fake mesh."""
+    from examples import gpt_lm
+
+    state, metrics = gpt_lm.main(
+        ["--tiny", "--seq-len", "32", "--max-steps", "2", "--batch-size",
+         "16", "--pipeline", "2", "--tensor", "2"]
+    )
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+    state, metrics = gpt_lm.main(
+        ["--tiny", "--seq-len", "32", "--max-steps", "2", "--batch-size",
+         "16", "--moe", "4"]
+    )
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))

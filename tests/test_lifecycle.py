@@ -305,3 +305,22 @@ def test_continuous_eval_under_different_strategy(tmp_path):
     ref.close()
     assert metrics["accuracy"] == m2["accuracy"]
     np.testing.assert_allclose(metrics["loss"], m2["loss"], rtol=1e-6)
+
+
+def test_profiler_window_validation():
+    from tfde_tpu.observability.profiler import StepWindowProfiler, _parse_window
+
+    # 'every:0' means disabled, like the documented '0'
+    assert _parse_window("every:0") is None
+    assert _parse_window("0") is None
+    assert _parse_window("every:100") == ("every", 100, 10)
+    assert _parse_window("every:100:25") == ("every", 100, 25)
+    assert _parse_window("7:12") == (7, 12)
+    # span >= period would open a trace that never closes
+    with pytest.raises(ValueError, match="never closes"):
+        _parse_window("every:10:10")
+    with pytest.raises(ValueError, match="span"):
+        StepWindowProfiler("/tmp/x", ("every", 10, 12))
+    # disabled tuples pass through quietly
+    p = StepWindowProfiler("/tmp/x", ("every", 0, 10))
+    assert not p.enabled

@@ -272,3 +272,17 @@ def test_tensor_without_pipe_rejected():
     strat = PipelineParallelStrategy(data=2, pipe=1, tensor=2)
     with pytest.raises(ValueError, match="tensor"):
         strat.params_spec({"stages": {"w": jnp.zeros((1, 2, 4, 4))}})
+
+
+def test_3d_with_dropout_trains(tokens):
+    """3D mesh + dropout: auto-mode global masks, one finite training step
+    through the last-stage-reduction loss."""
+    from tfde_tpu.models.pipelined import pipelined_next_token_loss
+
+    model = pipelined_tiny_test(dropout_rate=0.1)
+    strat = PipelineParallelStrategy(data=2, pipe=2, tensor=2)
+    state, _ = init_state(model, optax.adam(1e-3), strat, tokens)
+    step = make_custom_train_step(strat, state, pipelined_next_token_loss,
+                                  donate=False)
+    state, m = step(state, (tokens,), jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))

@@ -133,3 +133,38 @@ def test_attention_dispatcher_reference_path(rng):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(reference_attention(q, k, v)), rtol=1e-6
     )
+
+
+def test_remat_policies_match_no_remat_numerics(rng):
+    """remat=False / 'full' / 'dots' are schedule choices, not math changes:
+    identical forward values and gradients."""
+    import optax
+
+    from tfde_tpu.models.transformer import Encoder, remat_policy
+
+    x = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+
+    def run(remat):
+        m = Encoder(depth=2, num_heads=2, head_dim=8, mlp_dim=32,
+                    dtype=jnp.float32, remat=remat)
+        v = m.init(jax.random.key(0), x)
+
+        def loss(params):
+            return jnp.sum(m.apply({"params": params}, x) ** 2)
+
+        val, grads = jax.jit(jax.value_and_grad(loss))(v["params"])
+        return float(val), grads
+
+    v0, g0 = run(False)
+    for mode in (True, "full", "dots"):
+        v1, g1 = run(mode)
+        np.testing.assert_allclose(v0, v1, rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            ),
+            g0, g1,
+        )
+
+    with pytest.raises(ValueError, match="remat"):
+        remat_policy("bogus")

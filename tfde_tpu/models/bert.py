@@ -18,7 +18,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -82,7 +82,7 @@ class Bert(nn.Module):
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "auto"
-    remat: bool = False
+    remat: Any = False  # False | True/'full' | 'dots' (transformer.remat_policy)
     pad_vocab: bool = False
 
     @property

@@ -14,7 +14,7 @@ Weight tying (GPT-2 convention): LM head = embedding transpose via
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -36,7 +36,7 @@ class GPT(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "auto"
-    remat: bool = False
+    remat: Any = False  # False | True/'full' | 'dots' (transformer.remat_policy)
     # > 0 swaps every `moe_every`-th block's MLP for a routed expert MLP
     # (models/moe.py) — train under ExpertParallelStrategy to shard experts
     num_experts: int = 0

@@ -68,7 +68,7 @@ class PipelinedLM:
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"
-    remat: bool = False  # jax.checkpoint each block: HBM for FLOPs
+    remat: Any = False  # False | True/'full' | 'dots' (transformer.remat_policy)
     # pipeline_apply execution mode: None auto-selects — 'auto' (partial-
     # manual shard_map; required for tensor-parallel stage weights, dp x pp
     # x tp) when the mesh has a >1 'tensor' axis, the proven fully-'manual'
@@ -200,10 +200,11 @@ class PipelinedLM:
                 h = block.apply({"params": lp}, h, None, train, **kwargs)
             return (h, mb), None
 
-        if self.remat:
-            layer = jax.checkpoint(
-                layer, policy=jax.checkpoint_policies.nothing_saveable
-            )
+        from tfde_tpu.models.transformer import remat_policy
+
+        policy = remat_policy(self.remat)
+        if policy is not None:
+            layer = jax.checkpoint(layer, policy=policy)
         return layer
 
     def _pipe_mode(self, mesh) -> str:

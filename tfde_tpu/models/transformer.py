@@ -19,7 +19,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional
+from typing import Callable, Any, Optional
 
 import flax.linen as nn
 import jax
@@ -166,8 +166,27 @@ class TransformerBlock(nn.Module):
         raise ValueError(f"norm_style must be 'pre' or 'post', got {self.norm_style!r}")
 
 
+def remat_policy(remat):
+    """Checkpoint-policy selector shared by every model family:
+    False — no remat; True / 'full' — nothing_saveable (recompute the whole
+    block in backward: max HBM savings, ~1.33x FLOPs); 'dots' — save MXU
+    matmul outputs and recompute only the elementwise/fusible ops (the
+    usual best HBM/FLOPs tradeoff on TPU: backward recompute is nearly
+    free because it never re-runs the matmuls)."""
+    if not remat:
+        return None
+    if remat is True or remat == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    if remat == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(
+        f"remat must be False, True, 'full', or 'dots'; got {remat!r}"
+    )
+
+
 class Encoder(nn.Module):
-    """Stack of TransformerBlocks with optional per-block rematerialization."""
+    """Stack of TransformerBlocks with optional per-block rematerialization
+    (`remat`: False | True/'full' | 'dots', see remat_policy)."""
 
     depth: int
     num_heads: int
@@ -178,7 +197,7 @@ class Encoder(nn.Module):
     attn_impl: str = "auto"
     causal: bool = False
     norm_style: str = "pre"
-    remat: bool = False
+    remat: Any = False
     num_experts: int = 0   # > 0: MoE MLP in every `moe_every`-th block
     experts_per_token: int = 2
     moe_every: int = 2     # GShard convention: alternate dense / MoE
@@ -195,10 +214,9 @@ class Encoder(nn.Module):
             # flow to them — mask is boolean, train is a Python bool).
             return mdl(h, mask, train)
 
-        if self.remat:
-            body = nn.remat(
-                body, policy=jax.checkpoint_policies.nothing_saveable
-            )
+        policy = remat_policy(self.remat)
+        if policy is not None:
+            body = nn.remat(body, policy=policy)
         for i in range(self.depth):
             is_moe = (
                 self.num_experts > 0 and i % self.moe_every == self.moe_every - 1

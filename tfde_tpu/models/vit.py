@@ -17,7 +17,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -40,7 +40,7 @@ class ViT(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     pool: str = "cls"  # 'cls' | 'gap'
     attn_impl: str = "auto"
-    remat: bool = False
+    remat: Any = False  # False | True/'full' | 'dots' (transformer.remat_policy)
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:

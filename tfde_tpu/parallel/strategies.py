@@ -198,7 +198,8 @@ def _megatron_tensor_dim(module: str, kind: str, shape, tsize: int,
             return offset
         return None
     # out [heads, hd, embed] / fc2 [ffn, embed]: split dim 0
-    if module in _TP_ROW and kind == "kernel" and len(body) >= 1             and body[0] % tsize == 0:
+    if module in _TP_ROW and kind == "kernel" \
+            and len(body) >= 1 and body[0] % tsize == 0:
         return offset
     return None
 
