@@ -286,3 +286,53 @@ def test_3d_with_dropout_trains(tokens):
                                   donate=False)
     state, m = step(state, (tokens,), jax.random.key(0))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_3d_with_remat_dots_trains(tokens):
+    """jax.checkpoint('dots' policy) inside the partial-manual pipe: one
+    finite training step on the 3D mesh."""
+    from tfde_tpu.models.pipelined import pipelined_next_token_loss
+
+    model = pipelined_tiny_test(remat="dots")
+    strat = PipelineParallelStrategy(data=2, pipe=2, tensor=2)
+    state, _ = init_state(model, optax.adam(1e-3), strat, tokens)
+    step = make_custom_train_step(strat, state, pipelined_next_token_loss,
+                                  donate=False)
+    state, m = step(state, (tokens,), jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_flash_refused_inside_partial_manual_pipe(tokens):
+    """Explicit flash inside the partial-manual 3D pipe must error with
+    guidance (the kernel's custom-VJP variance doesn't compose with a
+    nested shard_map), and 'auto' must quietly pick the reference einsum
+    there — never a silent replicate-or-crash."""
+    from tfde_tpu.models.pipelined import pipelined_next_token_loss
+
+    strat = PipelineParallelStrategy(data=2, pipe=2, tensor=2)
+    m_flash = pipelined_tiny_test(attn_impl="flash")
+    state_f, _ = init_state(m_flash, optax.adam(1e-3), strat, tokens)
+    step_f = make_custom_train_step(strat, state_f, pipelined_next_token_loss,
+                                    donate=False)
+    with pytest.raises(NotImplementedError, match="partial-manual"):
+        step_f(state_f, (tokens,), jax.random.key(0))
+
+
+def test_auto_dispatch_skips_flash_under_abstract_mesh(monkeypatch):
+    """'auto' never picks flash inside a partial-manual region, even at
+    flash-eligible lengths on TPU."""
+    import tfde_tpu.ops.attention as att
+    from tfde_tpu.parallel import axes as axes_lib
+
+    chosen = []
+    monkeypatch.setattr(att, "_on_tpu", lambda: True)
+    monkeypatch.setattr(
+        att, "reference_attention",
+        lambda q, k, v, mask=None, causal=False:
+        (chosen.append("reference"), q)[1],
+    )
+    q = jnp.zeros((1, 4096, 1, 4), jnp.bfloat16)
+    abstract = jax.sharding.AbstractMesh((2,), ("data",))
+    with axes_lib.use_axes(abstract):
+        att.attention(q, q, q)
+    assert chosen == ["reference"]

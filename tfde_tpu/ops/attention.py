@@ -117,6 +117,12 @@ def attention(
             and q.shape[1] % 128 == 0
             and mask is None
             and _have("flash_attention")
+            # inside a partial-manual pipeline region (AbstractMesh) the
+            # kernel's custom-VJP variance doesn't compose with a nested
+            # shard_map; the reference einsum partitions fine there
+            and not isinstance(
+                axes_lib.current_mesh(), jax.sharding.AbstractMesh
+            )
         ):
             impl = "flash"
         else:
@@ -130,9 +136,7 @@ def attention(
                 "impl='reference' (or 'auto', which refuses flash when a "
                 "mask is present)"
             )
-        from tfde_tpu.ops import flash_attention
-
-        return flash_attention.flash_attention(q, k, v, causal=causal)
+        return _flash_sharded(q, k, v, causal)
     if impl == "ring":
         from tfde_tpu.ops import ring_attention
 
@@ -140,6 +144,70 @@ def attention(
             q, k, v, mask=mask, causal=causal, mesh=axes_lib.current_mesh()
         )
     raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def _flash_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool) -> jax.Array:
+    """Call the Pallas flash kernel batch-parallel over the active mesh.
+
+    A pallas_call under plain jit with sharded operands is NOT partitioned
+    automatically — XLA gathers the inputs and replicates the whole kernel
+    (measured: sharded-in, replicated-out), silently destroying data
+    parallelism. Attention is embarrassingly parallel over batch (and over
+    heads under TP), so when a concrete mesh is active we shard_map the
+    kernel over those axes; each device runs flash on its own shard with
+    zero communication. Falls back to the direct (replicating) call when no
+    mesh is active, inside a fully-manual region (current_mesh is None
+    there), or when the shapes don't divide. Inside a *partial-manual*
+    region (AbstractMesh — the 3D pipe) flash is refused outright: the
+    kernel's custom-VJP loses the pipe-variance annotations through a
+    nested shard_map, so auto-dispatch picks the reference einsum there
+    and an explicit impl='flash' errors with guidance."""
+    from tfde_tpu.ops import flash_attention as fa
+
+    # interpret on CPU only, for the fake-device test methodology; any
+    # other non-TPU backend should fail loudly at Mosaic lowering rather
+    # than silently run the orders-of-magnitude-slower interpreter
+    interpret = jax.default_backend() == "cpu"
+    mesh = axes_lib.current_mesh()
+    if isinstance(mesh, jax.sharding.AbstractMesh):
+        raise NotImplementedError(
+            "flash attention inside a partial-manual pipeline region is not "
+            "supported (the kernel's custom-VJP variance does not compose "
+            "with a nested shard_map); use attn_impl='reference' (or 'auto', "
+            "which picks it automatically) for pipelined models"
+        )
+    if not isinstance(mesh, jax.sharding.Mesh):
+        return fa.flash_attention(q, k, v, causal=causal, interpret=interpret)
+    from jax.sharding import PartitionSpec as P
+
+    from tfde_tpu.parallel.sharding import data_axes as _data_axes
+
+    batch_axes = _data_axes(mesh)
+    d = 1
+    for a in batch_axes:
+        d *= mesh.shape[a]
+    heads = None
+    if "tensor" in mesh.axis_names and mesh.shape["tensor"] > 1 \
+            and q.shape[2] % mesh.shape["tensor"] == 0:
+        heads = "tensor"
+    if q.shape[0] % max(d, 1):
+        batch_axes, d = (), 1
+    if d <= 1 and heads is None:
+        return fa.flash_attention(q, k, v, causal=causal, interpret=interpret)
+    spec = P(batch_axes if batch_axes else None, None, heads, None)
+    fn = jax.shard_map(
+        lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=causal, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        # pallas_call's out_shape carries no vma annotations; the kernel is
+        # pure per-shard compute (no collectives), so the check adds nothing
+        check_vma=False,
+    )
+    return fn(q, k, v)
 
 
 def padding_mask(valid: jax.Array) -> jax.Array:
