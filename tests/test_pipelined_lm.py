@@ -336,3 +336,14 @@ def test_auto_dispatch_skips_flash_under_abstract_mesh(monkeypatch):
     with axes_lib.use_axes(abstract):
         att.attention(q, q, q)
     assert chosen == ["reference"]
+
+
+def test_pp_with_seq_axis_rejected(tokens):
+    """pp x sp doesn't lower in jax 0.9 (Shardy rejects the ring backward's
+    residual shardings inside a nested manual region) — the strategy must
+    say so loudly instead of failing deep in MLIR."""
+    strat = PipelineParallelStrategy(
+        mesh=make_mesh({"data": 2, "pipe": 2, "seq": 2}, jax.devices()[:8])
+    )
+    with pytest.raises(ValueError, match="SequenceParallelStrategy"):
+        init_state(pipelined_tiny_test(), optax.adam(1e-3), strat, tokens)

@@ -279,6 +279,15 @@ class PipelinedLM:
             and "pipe" in mesh.axis_names
             and mesh.shape["pipe"] > 1
         ):
+            if "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
+                # covers the direct use_axes(mesh) entry point too, not
+                # just PipelineParallelStrategy's params_spec guard
+                raise ValueError(
+                    "the pipeline does not compose with a 'seq' axis: the "
+                    "ring's backward residuals do not lower through nested "
+                    "manual regions (Shardy, jax 0.9) — use "
+                    "SequenceParallelStrategy for SP without pipelining"
+                )
             return mesh
         return None
 

@@ -120,6 +120,11 @@ def ring_attention(
             )
         kv_valid = mask[:, 0, 0, :].astype(jnp.bool_)
 
+    # Note on pp x sp: the FORWARD of this construction nests inside a
+    # partial-manual pipe region (AbstractMesh with 'pipe' typed Manual),
+    # but the backward's saved residuals do not lower — Shardy (jax 0.9)
+    # rejects their shardings inside a nested manual computation — so
+    # PipelineParallelStrategy refuses 'seq' axes loudly instead.
     batch = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
     batch = batch if batch else None
     heads = "tensor" if "tensor" in mesh.axis_names else None
@@ -135,16 +140,15 @@ def ring_attention(
         out_dtype = q.dtype
         q_pos = idx * sq + jnp.arange(sq)
         b, _, h, d = q.shape
-        # mark the accumulators device-varying over the ring axis up front,
-        # or the fori_loop carry type check rejects the first iteration
-        o, m, l = jax.lax.pcast(
-            (
-                jnp.zeros((b, sq, h, d), jnp.float32),
-                jnp.full((b, h, sq), _NEG, jnp.float32),
-                jnp.zeros((b, h, sq), jnp.float32),
-            ),
-            tuple(mesh.axis_names),  # q/k/v vary over every mesh axis
-            to="varying",
+        # mark the accumulators device-varying over every mesh axis (the
+        # incoming q/k/v end up varying over all of them, and the fori_loop
+        # carry type check requires input/output variance to match)
+        from tfde_tpu.parallel.axes import vary_over
+
+        o, m, l = (
+            vary_over(jnp.zeros((b, sq, h, d), jnp.float32), mesh.axis_names),
+            vary_over(jnp.full((b, h, sq), _NEG, jnp.float32), mesh.axis_names),
+            vary_over(jnp.zeros((b, h, sq), jnp.float32), mesh.axis_names),
         )
 
         def body(t, carry):

@@ -76,6 +76,17 @@ def constrain(x: jax.Array, *axes: Axis) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def vary_over(x: jax.Array, axes: Sequence[str]) -> jax.Array:
+    """Promote `x` to device-varying over exactly the axes it lacks from
+    `axes` (jax vma typing inside shard_map regions): carries entering a
+    fori_loop/scan must match the loop body's variance, and psums demand
+    their operands vary over the reduced axes. Shared by the pipeline's
+    reductions and ring attention's accumulators."""
+    have = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(a for a in axes if a not in have)
+    return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+
 def batch_axes() -> tuple:
     """The axis-name tuple activations' batch dim is split over: ('data',
     'fsdp') — mirrors sharding.batch_spec so activation constraints agree

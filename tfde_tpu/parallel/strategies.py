@@ -401,6 +401,13 @@ class PipelineParallelStrategy(Strategy):
                 "would replicate every weight across the tensor devices — "
                 "use TensorParallelStrategy for TP without pipelining"
             )
+        if self.mesh.shape.get("seq", 1) > 1:
+            raise ValueError(
+                "PipelineParallelStrategy does not compose with a 'seq' "
+                "axis: the ring's backward residuals do not lower through "
+                "nested manual regions (Shardy, jax 0.9) — use "
+                "SequenceParallelStrategy for SP without pipelining"
+            )
 
         def leaf_spec(path, leaf):
             names = _path_names(path)
