@@ -23,6 +23,13 @@ stacking. Instead the class duck-types `model.init(rng, sample, train=...)`
 / `model.apply(variables, batch, train=..., rngs=...)`, which is all
 training/step.py's `init_state` + `make_custom_train_step` consume.
 
+3D (round 3): on a mesh with a >1 'tensor' axis the pipe auto-selects
+pipeline_apply's partial-manual mode — stage weights shard over 'pipe' AND
+Megatron-split over 'tensor' (PipelineParallelStrategy(tensor=T)), with the
+automatic partitioner inserting the TP collectives inside the ring
+(dp x pp x tp; tests/test_pipelined_lm.py::test_3d_dp_pp_tp_matches_dp).
+A 'seq' axis is refused loudly — see _pipe_mesh.
+
 Dropout (round-3, closing VERDICT r2 weak #8's capability cliff vs GPT):
 `dropout_rate > 0` threads per-tick keys through the shard_map schedule —
 each stage derives fold_in(base, microbatch, global_layer, data_shard) from
