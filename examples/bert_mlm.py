@@ -25,12 +25,21 @@ import logging
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
+
+import os as _os
+import sys as _sys
+
+# runnable as `python examples/<name>.py` without an install: put the repo
+# root (the directory holding tfde_tpu/) ahead of the script dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 from tfde_tpu import bootstrap
 from tfde_tpu.checkpoint.manager import CheckpointManager
 from tfde_tpu.data import datasets
+from tfde_tpu.data import mlm
 from tfde_tpu.data.mlm import MlmConfig, mask_tokens
 from tfde_tpu.models.bert import BertBase, bert_tiny_test
 from tfde_tpu.observability.tensorboard import SummaryWriter
@@ -42,13 +51,17 @@ log = logging.getLogger(__name__)
 
 
 def mlm_loss_fn(state, params, batch, rng):
-    """(loss, metrics) for make_custom_train_step."""
+    """(loss, metrics) for make_custom_train_step. `grad_weight` carries the
+    masked-position count: the MLM loss normalizes by it, so gradient
+    accumulation must weight each microbatch by its own count to reproduce
+    the full-batch update (training/step.py grad_accum)."""
     input_ids, labels = batch
     logits = state.apply_fn(
         {"params": params}, input_ids, train=True, rngs={"dropout": rng}
     )
     loss, acc = losses.masked_lm_loss(logits, labels)
-    return loss, {"mlm_accuracy": acc}
+    n_targets = jnp.sum((labels != mlm.IGNORE_ID).astype(jnp.float32))
+    return loss, {"mlm_accuracy": acc, "grad_weight": n_targets}
 
 
 def batch_stream(tokens: np.ndarray, cfg: MlmConfig, global_batch: int, seed: int):
@@ -67,6 +80,9 @@ def main(argv=None):
     parser.add_argument("--learning-rate", type=float, default=1e-4)
     parser.add_argument("--warmup-steps", type=int, default=100)
     parser.add_argument("--train-examples", type=int, default=8192)
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="sequential microbatches per optimizer update "
+                             "(training/step.py grad_accum)")
     parser.add_argument("--model-dir", type=str, default=None)
     parser.add_argument("--tiny", action="store_true", help="CI-sized model")
     parser.add_argument("--remat", nargs="?", const="full", default=False,
@@ -115,7 +131,8 @@ def main(argv=None):
         else None
     )
 
-    step_fn = make_custom_train_step(strategy, state, mlm_loss_fn)
+    step_fn = make_custom_train_step(strategy, state, mlm_loss_fn,
+                                     grad_accum=args.grad_accum)
     rng = jax.random.key(1)
     stream = batch_stream(tokens, cfg, global_batch, seed=0)
     start = int(jax.device_get(state.step))

@@ -37,6 +37,13 @@ import jax
 import numpy as np
 import optax
 
+import os as _os
+import sys as _sys
+
+# runnable as `python examples/<name>.py` without an install: put the repo
+# root (the directory holding tfde_tpu/) ahead of the script dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 from tfde_tpu import bootstrap
 from tfde_tpu.data import datasets
 from tfde_tpu.models.gpt import GPT2Small, gpt_tiny_test, next_token_loss
@@ -57,6 +64,8 @@ def main(argv=None):
     parser.add_argument("--learning-rate", type=float, default=3e-4)
     parser.add_argument("--warmup-steps", type=int, default=100)
     parser.add_argument("--train-examples", type=int, default=8192)
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="sequential microbatches per optimizer update")
     parser.add_argument("--seq-parallel", type=int, default=0,
                         help="size of the 'seq' mesh axis (ring attention)")
     parser.add_argument("--pipeline", type=int, default=0,
@@ -69,6 +78,12 @@ def main(argv=None):
     parser.add_argument("--moe", type=int, default=0,
                         help="experts per MoE block; shards them over an "
                              "'expert' mesh axis (expert parallelism)")
+    parser.add_argument("--generate", type=int, default=0, metavar="N",
+                        help="after training, sample N continuation tokens "
+                             "from a training prompt (inference/decode.py; "
+                             "not with --pipeline: PipelinedLM is a "
+                             "training-schedule model, export weights to "
+                             "GPT for serving)")
     parser.add_argument("--tiny", action="store_true")
     parser.add_argument("--remat", nargs="?", const="full", default=False,
                         choices=["full", "dots"])
@@ -92,6 +107,14 @@ def main(argv=None):
         raise ValueError(
             "--tensor requires --pipeline (3D dp x pp x tp); for TP without "
             "pipelining use TensorParallelStrategy via a custom entrypoint"
+        )
+    if args.generate > 0 and args.pipeline > 1:
+        # fail before training, not after: the post-training generate call
+        # would otherwise discard the whole run
+        raise ValueError(
+            "--generate doesn't apply to --pipeline runs: PipelinedLM is a "
+            "training-schedule model without a KV-cache decode path — serve "
+            "the weights through GPT instead"
         )
     if args.pipeline > 1:
         from tfde_tpu.models.pipelined import PipelinedLM, pipelined_tiny_test
@@ -175,7 +198,8 @@ def main(argv=None):
         loss_fn = pipelined_next_token_loss
     else:
         loss_fn = next_token_loss
-    step_fn = make_custom_train_step(strategy, state, loss_fn)
+    step_fn = make_custom_train_step(strategy, state, loss_fn,
+                                     grad_accum=args.grad_accum)
     rng = jax.random.key(1)
     nrng = np.random.default_rng(0)
     t0 = time.time()
@@ -188,6 +212,18 @@ def main(argv=None):
             sps = 100 / (time.time() - t0)
             t0 = time.time()
             log.info("step %d: %s (%.2f steps/s)", step + 1, vals, sps)
+
+    if args.generate > 0:
+        from tfde_tpu.inference.decode import generate
+
+        prompt = tokens[:2, : min(16, args.seq_len)]
+        out, lengths = generate(
+            model, state.params, prompt,
+            max_new_tokens=args.generate,
+            temperature=0.8, top_k=40, rng=jax.random.key(2),
+        )
+        for row, n in zip(np.asarray(out), np.asarray(lengths)):
+            log.info("generated: %s", row[: int(n)].tolist())
     return state, metrics
 
 

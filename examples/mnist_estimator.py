@@ -28,6 +28,13 @@ import logging
 
 import optax
 
+import os as _os
+import sys as _sys
+
+# runnable as `python examples/<name>.py` without an install: put the repo
+# root (the directory holding tfde_tpu/) ahead of the script dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 from tfde_tpu import bootstrap
 from tfde_tpu.data import Dataset, datasets
 from tfde_tpu.export.serving import FinalExporter

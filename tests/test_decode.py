@@ -1,0 +1,191 @@
+"""Autoregressive generation tests (inference/decode.py): KV-cache
+equivalence with the full forward, prefill consistency, sampling filters,
+EOS/length bookkeeping, MoE decode, and generation under a data mesh.
+
+The cache-equivalence tests are the decode analog of SURVEY.md §4's
+numerics-oracle strategy: the cached incremental decode must reproduce the
+uncached full-sequence forward bit-for-bit-ish (fp32 tiny model, tight
+tolerances), exactly as TP/PP/EP are tested against their single-device
+oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.inference.decode import generate, init_cache, sample_logits
+from tfde_tpu.models.gpt import GPT, gpt_tiny_test
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    m = gpt_tiny_test()
+    ids = jnp.zeros((2, 8), jnp.int32)
+    params = m.init(jax.random.key(1), ids)["params"]
+    return m, params
+
+
+def _full_forward_greedy(model, params, prompt, n_new):
+    """Oracle: re-run the whole (uncached) model per token, argmax."""
+    toks = np.asarray(prompt, np.int32)
+    for _ in range(n_new):
+        logits = model.apply({"params": params}, jnp.asarray(toks))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1), np.int32)
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    return toks
+
+
+def test_greedy_cache_matches_full_forward_rollout(tiny_lm, rng):
+    model, params = tiny_lm
+    prompt = jnp.asarray(rng.integers(0, 97, (2, 5)), jnp.int32)
+    out, lengths = generate(model, params, prompt, max_new_tokens=9)
+    oracle = _full_forward_greedy(model, params, prompt, 9)
+    np.testing.assert_array_equal(np.asarray(out), oracle)
+    np.testing.assert_array_equal(np.asarray(lengths), [14, 14])
+
+
+def test_prefill_logits_match_full_forward(tiny_lm, rng):
+    """The cached prefill's last-position logits must equal the uncached
+    forward's — same math, different K/V storage."""
+    model, params = tiny_lm
+    ids = jnp.asarray(rng.integers(0, 97, (2, 6)), jnp.int32)
+    full = model.apply({"params": params}, ids)
+    dm = model.clone(decode=True)
+    cache = init_cache(model, 2, 12)
+    cached, _ = dm.apply({"params": params, "cache": cache}, ids,
+                         mutable=["cache"])
+    np.testing.assert_allclose(
+        np.asarray(full[:, -1]), np.asarray(cached[:, -1]),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_decode_step_positions_advance(tiny_lm, rng):
+    """After a prefill of length P, each 1-token step must see position
+    P, P+1, ... — verified against full-forward logits at those positions."""
+    model, params = tiny_lm
+    ids = np.asarray(rng.integers(0, 97, (1, 7)), np.int32)
+    dm = model.clone(decode=True)
+    cache = init_cache(model, 1, 7)
+    _, vars_ = dm.apply({"params": params, "cache": cache},
+                        jnp.asarray(ids[:, :4]), mutable=["cache"])
+    cache = vars_["cache"]
+    for t in range(4, 7):
+        step_logits, vars_ = dm.apply(
+            {"params": params, "cache": cache}, jnp.asarray(ids[:, t:t + 1]),
+            mutable=["cache"],
+        )
+        cache = vars_["cache"]
+        full = model.apply({"params": params}, jnp.asarray(ids[:, :t + 1]))
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]), np.asarray(full[:, -1]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+def test_top_k1_equals_greedy(tiny_lm, rng):
+    model, params = tiny_lm
+    prompt = jnp.asarray(rng.integers(0, 97, (2, 4)), jnp.int32)
+    greedy, _ = generate(model, params, prompt, max_new_tokens=6)
+    topk1, _ = generate(model, params, prompt, max_new_tokens=6,
+                        temperature=1.0, top_k=1,
+                        rng=jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+
+def test_eos_pads_and_lengths(tiny_lm, rng):
+    """Pick the token greedy decoding emits first as the EOS: the row must
+    freeze at pad_id right after it and lengths must count through it."""
+    model, params = tiny_lm
+    prompt = jnp.asarray(rng.integers(0, 97, (1, 4)), jnp.int32)
+    free, _ = generate(model, params, prompt, max_new_tokens=8)
+    eos = int(np.asarray(free)[0, 4])  # first generated token
+    out, lengths = generate(model, params, prompt, max_new_tokens=8,
+                            eos_id=eos, pad_id=0)
+    out = np.asarray(out)
+    assert out[0, 4] == eos
+    np.testing.assert_array_equal(out[0, 5:], np.zeros(7, np.int32))
+    assert int(lengths[0]) == 5  # prompt 4 + the EOS token
+
+
+def test_sample_logits_filters(rng):
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0, -1.0]], jnp.float32)
+    # top_k=2 may only ever emit ids 3 and 2
+    seen = {
+        int(sample_logits(logits, jax.random.key(i), temperature=1.0, top_k=2)[0])
+        for i in range(50)
+    }
+    assert seen <= {2, 3} and seen
+    # top_p tiny keeps only the argmax (its exclusive mass is 0 < p)
+    seen_p = {
+        int(sample_logits(logits, jax.random.key(i), temperature=1.0,
+                          top_p=1e-6)[0])
+        for i in range(20)
+    }
+    assert seen_p == {3}
+    # temperature=0 ignores rng entirely
+    assert int(sample_logits(logits, jax.random.key(0),
+                             temperature=0.0)[0]) == 3
+
+
+def test_generate_rejects_over_budget_prompt(tiny_lm):
+    model, params = tiny_lm  # max_position=64
+    prompt = jnp.zeros((1, 60), jnp.int32)
+    with pytest.raises(ValueError, match="max_position"):
+        generate(model, params, prompt, max_new_tokens=10)
+
+
+def test_moe_gpt_decodes(rng):
+    """Routed-expert MLPs work per-token (capacity is per group, linear in
+    this call's tokens — models/moe.py), so MoE-GPT must decode unchanged."""
+    m = GPT(vocab_size=61, hidden_size=32, depth=2, num_heads=4, mlp_dim=64,
+            max_position=32, dtype=jnp.float32, num_experts=2, moe_every=2)
+    ids = jnp.zeros((2, 6), jnp.int32)
+    params = m.init(jax.random.key(0), ids)["params"]
+    prompt = jnp.asarray(rng.integers(0, 61, (2, 4)), jnp.int32)
+    out, lengths = generate(m, params, prompt, max_new_tokens=5)
+    assert out.shape == (2, 9)
+    oracle = _full_forward_greedy(m, params, prompt, 5)
+    np.testing.assert_array_equal(np.asarray(out), oracle)
+
+
+def test_generate_under_data_mesh(tiny_lm, rng):
+    """Generation traced inside use_axes(mesh): the activation constraints
+    (and the decode path's cache constraints) must compose with a data-
+    sharded batch on the 8-device mesh."""
+    from tfde_tpu.parallel.axes import use_axes
+    from tfde_tpu.runtime.mesh import make_mesh
+
+    model, params = tiny_lm
+    mesh = make_mesh({"data": 8}, jax.devices())
+    prompt = jnp.asarray(rng.integers(0, 97, (8, 4)), jnp.int32)
+    with use_axes(mesh):
+        out, _ = generate(model, params, prompt, max_new_tokens=4)
+    ref, _ = generate(model, params, prompt, max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_decode_refuses_remat():
+    m = gpt_tiny_test(remat=True).clone(decode=True)
+    with pytest.raises(ValueError, match="remat"):
+        m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def test_generate_serves_remat_trained_model(rng):
+    """A remat training config must not make the model unservable:
+    generate() clones with remat off (remat only shapes the backward, which
+    decode doesn't have) and must match the remat-free model exactly."""
+    base = gpt_tiny_test()
+    remat = gpt_tiny_test(remat="full")
+    params = base.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = jnp.asarray(rng.integers(0, 97, (1, 4)), jnp.int32)
+    out_r, _ = generate(remat, params, prompt, max_new_tokens=5)
+    out_b, _ = generate(base, params, prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(out_r), np.asarray(out_b))
+
+
+def test_generate_rejects_zero_new_tokens(tiny_lm):
+    model, params = tiny_lm
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(model, params, jnp.zeros((1, 4), jnp.int32),
+                 max_new_tokens=0)

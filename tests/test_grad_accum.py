@@ -1,0 +1,171 @@
+"""Gradient accumulation (training/step.py grad_accum): the microbatched
+step must reproduce the full-batch step exactly for microbatch-independent
+losses — the same single-device-oracle strategy as the DP/TP numerics tests
+(SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tfde_tpu.models.cnn import BatchNormCNN, PlainCNN
+from tfde_tpu.models.gpt import gpt_tiny_test, next_token_loss
+from tfde_tpu.parallel.strategies import FSDPStrategy, MirroredStrategy
+from tfde_tpu.training.step import (
+    init_state,
+    make_custom_train_step,
+    make_train_step,
+)
+
+
+def _leaves_allclose(a, b, **tol):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), **tol)
+
+
+def test_grad_accum_matches_full_batch_classification(rng):
+    """SGD + a BN-free CNN: mean-of-microbatch-grads == full-batch grad, so
+    accum=4 must track accum=1 step for step."""
+    strategy = MirroredStrategy()
+    images = rng.random((32, 784), np.float32)
+    labels = rng.integers(0, 10, (32, 1)).astype(np.int32)
+    key = jax.random.key(0)
+
+    results = {}
+    for accum in (1, 4):
+        state, _ = init_state(
+            PlainCNN(), optax.sgd(0.1), strategy, np.zeros((32, 784), np.float32)
+        )
+        step = make_train_step(strategy, state, donate=False, grad_accum=accum)
+        for _ in range(3):
+            state, metrics = step(state, (images, labels), key)
+        results[accum] = (state.params, metrics)
+
+    _leaves_allclose(results[1][0], results[4][0], rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(
+        float(results[1][1]["loss"]), float(results[4][1]["loss"]),
+        rtol=1e-5,
+    )
+
+
+def test_grad_accum_custom_loss_matches_under_fsdp(rng):
+    """The custom-loss path, sharded: accum=2 on an FSDP mesh must match the
+    accum=1 update. SGD, not adam: adam's bias-corrected first step is
+    ~sign(g)*lr, which amplifies fp32 reduction-order noise in near-zero
+    gradients into full-lr parameter differences — a property of the
+    optimizer, not of the accumulation being tested."""
+    strategy = FSDPStrategy(min_shard_elems=1)
+    tokens = rng.integers(0, 97, (16, 16)).astype(np.int32)
+    key = jax.random.key(1)
+
+    params = {}
+    for accum in (1, 2):
+        state, _ = init_state(
+            gpt_tiny_test(), optax.sgd(1e-2), strategy,
+            np.zeros((16, 16), np.int32),
+        )
+        step = make_custom_train_step(
+            strategy, state, next_token_loss, donate=False, grad_accum=accum
+        )
+        for _ in range(2):
+            state, _ = step(state, (tokens,), key)
+        params[accum] = state.params
+
+    _leaves_allclose(params[1], params[2], rtol=2e-5, atol=2e-6)
+
+
+def test_grad_accum_batchnorm_stats_chain(rng):
+    """BatchNorm stats thread through the microbatches in order; the step
+    must run and keep finite, updated stats (exact equality with accum=1 is
+    not expected — BN statistics are batch-dependent by construction)."""
+    strategy = MirroredStrategy()
+    state, _ = init_state(
+        BatchNormCNN(), optax.sgd(0.05), strategy,
+        np.zeros((16, 784), np.float32),
+    )
+    step = make_train_step(strategy, state, donate=False, grad_accum=2)
+    images = rng.random((16, 784), np.float32)
+    labels = rng.integers(0, 10, (16, 1)).astype(np.int32)
+    before = jax.tree_util.tree_map(np.asarray, state.batch_stats)
+    state, metrics = step(state, (images, labels), jax.random.key(0))
+    assert np.isfinite(float(metrics["loss"]))
+    after = state.batch_stats
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after)
+        )
+    )
+    assert moved, "BN stats did not update through the accumulation scan"
+
+
+def test_grad_accum_weighted_matches_masked_loss(rng):
+    """Mask-normalized losses (denominator = per-microbatch target count)
+    are a mean-of-means under uniform accumulation; the reserved
+    `grad_weight` metrics key must restore the exact full-batch update."""
+    from tfde_tpu.ops.losses import masked_lm_loss
+
+    def loss_fn(state, params, batch, rng_):
+        tokens, labels = batch
+        logits = state.apply_fn({"params": params}, tokens, train=True,
+                                rngs={"dropout": rng_})
+        loss, acc = masked_lm_loss(logits, labels)
+        n = jnp.sum((labels != -100).astype(jnp.float32))
+        return loss, {"mlm_accuracy": acc, "grad_weight": n}
+
+    strategy = MirroredStrategy()
+    tokens = rng.integers(0, 97, (16, 16)).astype(np.int32)
+    # deliberately unbalanced target counts between the microbatches: the
+    # device-major split (training/step.py) sends even global rows to
+    # microbatch 0 and odd rows to microbatch 1 at batch 16 / 8 shards /
+    # accum 2, so imbalance by row parity lands 64 targets in one
+    # microbatch and 16 in the other
+    labels = np.full((16, 16), -100, np.int32)
+    labels[::2, ::2] = tokens[::2, ::2]   # 8 targets in even rows
+    labels[1::2, ::8] = tokens[1::2, ::8]  # 2 targets in odd rows
+    key = jax.random.key(0)
+
+    out = {}
+    for accum in (1, 2):
+        state, _ = init_state(
+            gpt_tiny_test(), optax.sgd(1e-2), strategy,
+            np.zeros((16, 16), np.int32),
+        )
+        step = make_custom_train_step(
+            strategy, state, loss_fn, donate=False, grad_accum=accum
+        )
+        state, metrics = step(state, (tokens, labels), key)
+        out[accum] = (state.params, metrics)
+
+    _leaves_allclose(out[1][0], out[2][0], rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        float(out[1][1]["loss"]), float(out[2][1]["loss"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(out[1][1]["mlm_accuracy"]), float(out[2][1]["mlm_accuracy"]),
+        rtol=1e-5,
+    )
+    # the directive key must not leak into reported metrics
+    assert "grad_weight" not in out[1][1] and "grad_weight" not in out[2][1]
+
+
+def test_grad_accum_rejects_indivisible_batch(rng):
+    strategy = MirroredStrategy()
+    state, _ = init_state(
+        PlainCNN(), optax.sgd(0.1), strategy, np.zeros((8, 784), np.float32)
+    )
+    step = make_train_step(strategy, state, donate=False, grad_accum=3)
+    images = rng.random((8, 784), np.float32)
+    labels = np.zeros((8, 1), np.int32)
+    with pytest.raises(ValueError, match="grad_accum"):
+        step(state, (images, labels), jax.random.key(0))
+
+
+def test_grad_accum_rejects_nonpositive():
+    strategy = MirroredStrategy()
+    state, _ = init_state(
+        PlainCNN(), optax.sgd(0.1), strategy, np.zeros((8, 784), np.float32)
+    )
+    with pytest.raises(ValueError, match="grad_accum"):
+        make_train_step(strategy, state, grad_accum=0)

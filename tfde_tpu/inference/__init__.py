@@ -1,0 +1,7 @@
+"""Inference / serving-side ops: autoregressive generation for the causal
+LMs (decode.generate), the capability the reference's SavedModel export
+story implies for servable models (SURVEY.md §2a #12)."""
+
+from tfde_tpu.inference.decode import generate, init_cache, sample_logits
+
+__all__ = ["generate", "init_cache", "sample_logits"]

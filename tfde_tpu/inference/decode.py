@@ -1,0 +1,204 @@
+"""Autoregressive generation — the serving-side capability of the causal LMs.
+
+The reference's serving story ends at SavedModel export of a forward pass
+(`/root/reference/mnist_keras_distributed.py:287-292` — classifier in, probs
+out); for the token-model families this framework adds (GPT, MoE-GPT), the
+forward pass alone is not servable — generation is. This module is the
+TPU-native decode loop:
+
+- **One compile, every step.** Prefill (the whole prompt in one forward) and
+  the per-token decode step are two fixed-shape programs; the sampling loop
+  is a `lax.scan`, so the entire generate call is ONE XLA program — no
+  per-token dispatch from Python, no dynamic shapes, no recompiles as the
+  sequence grows (the cache is allocated at the full budget up front and
+  written by `dynamic_update_slice`, models/transformer.py decode path).
+- **KV cache in the flax "cache" collection** (cached_key/cached_value/
+  cache_index per attention layer + the model's position_index), threaded
+  through the scan as ordinary carry state.
+- **Sampling on device**: greedy (temperature=0), temperature, top-k
+  (`lax.top_k` threshold), nucleus/top-p (sort + exclusive-cumsum mask) —
+  composed in that order, then `jax.random.categorical`.
+- **EOS with static shapes**: generation always runs the full
+  `max_new_tokens` scan; finished rows emit `pad_id` and stop changing. The
+  returned `lengths` tells the caller where each row actually ended. (A
+  data-dependent early exit would be a `while_loop` barrier on the slowest
+  row — on TPU the fixed-length scan is the right trade at batch > 1.)
+
+Sampling params (temperature/top_k/top_p/eos_id) are static arguments: a
+generation config is picked once per deployment, and burning it into the
+compiled program lets XLA fold the sampling graph; changing it recompiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _decode_clone(model):
+    """The serving twin of a training model: decode on, remat off (remat
+    only shapes the backward pass, which decode doesn't have — a training
+    config with remat must not make the model unservable)."""
+    if not hasattr(model, "decode"):
+        raise ValueError(
+            f"{type(model).__name__} has no decode mode — autoregressive "
+            f"generation needs a causal LM with KV-cache support (GPT)"
+        )
+    kw = {"decode": True}
+    if getattr(model, "remat", False):
+        kw["remat"] = False
+    return model.clone(**kw)
+
+
+def init_cache(model, batch_size: int, max_len: int):
+    """Zero-filled "cache" collection for `model.clone(decode=True)` sized to
+    a [batch_size, max_len] generation budget.
+
+    Uses `jax.eval_shape` on the decode-mode init, so no model compute (and
+    no real parameter init) runs — only the cache pytree's shapes/dtypes are
+    derived, then materialized as zeros.
+    """
+    decode_model = _decode_clone(model)
+    tokens = jax.ShapeDtypeStruct((batch_size, max_len), jnp.int32)
+
+    def _init(tokens):
+        return decode_model.init(jax.random.key(0), tokens)
+
+    shapes = jax.eval_shape(_init, tokens)
+    if "cache" not in shapes:
+        raise ValueError(
+            f"{type(model).__name__} creates no cache variables in decode "
+            f"mode — generation needs a model with decode support (GPT)"
+        )
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        shapes["cache"])
+
+
+def sample_logits(
+    logits: jax.Array,
+    rng: jax.Array,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """[B, V] logits -> [B] sampled token ids. temperature=0 is greedy
+    (argmax); top_k and top_p filters compose (k first, then nucleus)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    neg = jnp.finfo(jnp.float32).min
+    if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # exclusive cumsum: a token stays if the mass strictly above it is
+        # still < top_p — the smallest set whose total reaches top_p (the
+        # top-1 always stays: its exclusive mass is 0)
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        keep_sorted = cum < top_p
+        # map the per-rank decision back to vocab order via the smallest
+        # kept logit (ties at the threshold keep both — harmless)
+        threshold = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf),
+            axis=-1, keepdims=True,
+        )
+        logits = jnp.where(logits < threshold, neg, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
+                     "top_p", "eos_id", "pad_id"),
+)
+def generate(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    rng: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+):
+    """Generate `max_new_tokens` continuations of `prompt` [B, P] int32.
+
+    Returns (tokens [B, P + max_new_tokens], lengths [B]): `tokens` is the
+    prompt followed by the generated continuation (post-EOS positions hold
+    `pad_id`); `lengths[b]` counts prompt + generated-through-EOS.
+
+    The whole call — prefill, scan of decode steps, sampling — is one jitted
+    program; recompiles happen per (shape, sampling-config), not per token.
+    Prompts are dense [B, P]: batch rows share a prompt length (bucket or
+    left-trim ragged prompts; per-row validity masking would put a [B,
+    max_len] mask on the attention hot path for a capability batching
+    usually handles upstream).
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if rng is None:
+        rng = jax.random.key(0)
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    max_pos = getattr(model, "max_position", None)
+    if max_pos is not None and total > max_pos:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds the model's max_position {max_pos}"
+        )
+    decode_model = _decode_clone(model)
+    cache = init_cache(model, b, total)
+    prompt = prompt.astype(jnp.int32)
+
+    def model_step(cache, tokens):
+        logits, mutated = decode_model.apply(
+            {"params": params, "cache": cache}, tokens, train=False,
+            mutable=["cache"],
+        )
+        return mutated["cache"], logits[:, -1].astype(jnp.float32)
+
+    sample = functools.partial(sample_logits, temperature=temperature,
+                               top_k=top_k, top_p=top_p)
+
+    # prefill: the prompt in one fixed-shape forward
+    cache, last_logits = model_step(cache, prompt)
+    rng, sub = jax.random.split(rng)
+    tok = sample(last_logits, sub)
+    done = jnp.zeros((b,), jnp.bool_)
+    if eos_id is not None:
+        done = tok == eos_id
+
+    def step(carry, _):
+        cache, tok, rng, done = carry
+        cache, logits = model_step(cache, tok[:, None])
+        rng, sub = jax.random.split(rng)
+        nxt = sample(logits, sub)
+        if eos_id is not None:
+            nxt = jnp.where(done, pad_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, rng, done), nxt
+
+    (_, _, _, done), rest = jax.lax.scan(
+        step, (cache, tok, rng, done), length=max_new_tokens - 1
+    )
+    new_tokens = jnp.concatenate(
+        [tok[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
+    )  # [B, max_new_tokens]
+    tokens = jnp.concatenate([prompt, new_tokens], axis=1)
+    if eos_id is None:
+        lengths = jnp.full((b,), total, jnp.int32)
+    else:
+        # a position counts while no EOS appeared strictly before it — the
+        # EOS token itself is counted, post-EOS pad_id fill is not (correct
+        # even when pad_id == eos_id, the GPT-2 convention)
+        is_eos = (new_tokens == eos_id).astype(jnp.int32)
+        seen_before = jnp.cumsum(is_eos, axis=1) - is_eos
+        lengths = p + jnp.sum((seen_before == 0).astype(jnp.int32), axis=1)
+    return tokens, lengths

@@ -41,6 +41,9 @@ class GPT(nn.Module):
     # (models/moe.py) — train under ExpertParallelStrategy to shard experts
     num_experts: int = 0
     moe_every: int = 2
+    # autoregressive serving mode (inference/decode.py): KV caches in the
+    # "cache" collection; positions continue from the cached prefix
+    decode: bool = False
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, train: bool = False) -> jax.Array:
@@ -54,7 +57,20 @@ class GPT(nn.Module):
             self.max_position, self.hidden_size, dtype=self.dtype,
             param_dtype=jnp.float32, name="wpe",
         )
-        x = wte(input_ids) + wpe(jnp.arange(seq, dtype=jnp.int32)[None, :])
+        positions = jnp.arange(seq, dtype=jnp.int32)
+        if self.decode:
+            # position offset rides the cache like the K/V do: a decode step
+            # at cache position t embeds wpe[t], matching the full-sequence
+            # forward exactly. Check BEFORE self.variable creates it: a call
+            # with no pre-existing cache is position 0 and must not advance
+            # (the attention layers' fresh cache_index stays 0 the same way).
+            is_filled = self.has_variable("cache", "position_index")
+            pos_index = self.variable("cache", "position_index",
+                                      lambda: jnp.zeros((), jnp.int32))
+            if is_filled and not self.is_initializing():
+                positions = pos_index.value + positions
+                pos_index.value = pos_index.value + seq
+        x = wte(input_ids) + wpe(positions[None, :])
         x = constrain(x, b, "seq")
         if self.dropout_rate > 0.0:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
@@ -67,6 +83,7 @@ class GPT(nn.Module):
             dropout_rate=self.dropout_rate,
             attn_impl=self.attn_impl,
             causal=True,
+            decode=self.decode,
             remat=self.remat,
             num_experts=self.num_experts,
             moe_every=self.moe_every,

@@ -30,7 +30,17 @@ from tfde_tpu.parallel.axes import batch_axes, constrain
 
 
 class MultiHeadAttention(nn.Module):
-    """Self-attention with dispatchable kernel (ops/attention.attention)."""
+    """Self-attention with dispatchable kernel (ops/attention.attention).
+
+    `decode=True` turns on autoregressive KV caching (the serving path,
+    inference/decode.py): `cached_key`/`cached_value`/`cache_index`
+    variables live in the "cache" collection (flax convention — created at
+    `init` with the full `[B, max_len]` input, so the cache length is the
+    generation budget). A call with S>1 is a *prefill* (writes the whole
+    prompt's K/V at [index, index+S)); S=1 is one decode step. Both use
+    `dynamic_update_slice` with a traced start, so the compiled step serves
+    every position — no per-position recompiles, static shapes throughout
+    (XLA/TPU requirement)."""
 
     num_heads: int
     head_dim: int
@@ -38,6 +48,7 @@ class MultiHeadAttention(nn.Module):
     dropout_rate: float = 0.0
     attn_impl: str = "auto"
     causal: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(
@@ -58,9 +69,22 @@ class MultiHeadAttention(nn.Module):
         v = proj(name="value")(x)
         # [B, S, H, D]: heads carry the tensor-parallel shard.
         q, k, v = (constrain(t, b, "seq", "tensor") for t in (q, k, v))
-        y = attn_lib.attention(
-            q, k, v, mask=mask, causal=self.causal, impl=self.attn_impl
-        )
+        if self.decode:
+            if mask is not None:
+                raise NotImplementedError(
+                    "decode mode builds its own cache-position mask; "
+                    "explicit masks are not supported"
+                )
+            if not self.causal:
+                raise ValueError(
+                    "decode=True requires causal attention (autoregressive "
+                    "generation is a causal-LM capability)"
+                )
+            y = self._decode_attention(q, k, v, b)
+        else:
+            y = attn_lib.attention(
+                q, k, v, mask=mask, causal=self.causal, impl=self.attn_impl
+            )
         y = constrain(y, b, "seq", "tensor")
         y = nn.DenseGeneral(
             features=x.shape[-1],
@@ -73,6 +97,55 @@ class MultiHeadAttention(nn.Module):
         if self.dropout_rate > 0.0:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return y
+
+    def _decode_attention(self, q, k, v, batch) -> jax.Array:
+        """Write this call's K/V into the cache, attend q over the filled
+        prefix. The validity mask `j <= index + i` covers prefill (full
+        causal triangle over the prompt) and single-step decode (attend
+        everything written so far) in one expression.
+
+        Contract: the caller must not advance `cache_index` past the cache
+        budget — `index` is traced, so an overflow cannot raise here, and a
+        predicated write would put a full-cache copy on the bandwidth-bound
+        decode hot path (dynamic_update_slice would clamp the start and
+        overwrite the last entries instead). inference/decode.generate sizes
+        the cache to prompt + max_new_tokens exactly and can never overflow;
+        direct drivers of this layer own the same invariant."""
+        is_filled = self.has_variable("cache", "cached_key")
+        cached_key = self.variable("cache", "cached_key", jnp.zeros,
+                                   k.shape, k.dtype)
+        cached_value = self.variable("cache", "cached_value", jnp.zeros,
+                                     v.shape, v.dtype)
+        cache_index = self.variable("cache", "cache_index",
+                                    lambda: jnp.zeros((), jnp.int32))
+        if not is_filled:
+            # init pass: variables were just created from this call's shapes
+            # (the [B, max_len] budget input) — plain causal attention.
+            return attn_lib.attention(q, k, v, causal=True, impl="reference")
+        sq = q.shape[1]
+        max_len = cached_key.value.shape[1]
+        if sq > max_len:
+            raise ValueError(
+                f"input length {sq} exceeds the cache budget {max_len}; "
+                f"re-init the cache with a larger max_len"
+            )
+        idx = cache_index.value
+        k_all = jax.lax.dynamic_update_slice(
+            cached_key.value, k.astype(cached_key.value.dtype), (0, idx, 0, 0)
+        )
+        v_all = jax.lax.dynamic_update_slice(
+            cached_value.value, v.astype(cached_value.value.dtype),
+            (0, idx, 0, 0)
+        )
+        cached_key.value = constrain(k_all, batch, None, "tensor")
+        cached_value.value = constrain(v_all, batch, None, "tensor")
+        cache_index.value = idx + sq
+        # [1, 1, Sq, max_len]: query (global position idx+i) sees kv j<=idx+i
+        pos_q = idx + jnp.arange(sq, dtype=jnp.int32)
+        valid = jnp.arange(max_len, dtype=jnp.int32)[None, :] <= pos_q[:, None]
+        return attn_lib.attention(
+            q, k_all, v_all, mask=valid[None, None], impl="reference"
+        )
 
 
 class Mlp(nn.Module):
@@ -112,6 +185,7 @@ class TransformerBlock(nn.Module):
     dropout_rate: float = 0.0
     attn_impl: str = "auto"
     causal: bool = False
+    decode: bool = False
     norm_style: str = "pre"  # 'pre' | 'post'
     num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
     experts_per_token: int = 2
@@ -133,6 +207,7 @@ class TransformerBlock(nn.Module):
             dropout_rate=self.dropout_rate,
             attn_impl=self.attn_impl,
             causal=self.causal,
+            decode=self.decode,
             name="attn",
         )
         if self.num_experts > 0:
@@ -196,6 +271,7 @@ class Encoder(nn.Module):
     dropout_rate: float = 0.0
     attn_impl: str = "auto"
     causal: bool = False
+    decode: bool = False
     norm_style: str = "pre"
     remat: Any = False
     num_experts: int = 0   # > 0: MoE MLP in every `moe_every`-th block
@@ -216,6 +292,12 @@ class Encoder(nn.Module):
 
         policy = remat_policy(self.remat)
         if policy is not None:
+            if self.decode:
+                raise ValueError(
+                    "decode=True does not compose with remat: the KV-cache "
+                    "mutation inside jax.checkpoint is unsupported (and "
+                    "pointless — decode is inference, there is no backward)"
+                )
             body = nn.remat(body, policy=policy)
         for i in range(self.depth):
             is_moe = (
@@ -229,6 +311,7 @@ class Encoder(nn.Module):
                 dropout_rate=self.dropout_rate,
                 attn_impl=self.attn_impl,
                 causal=self.causal,
+                decode=self.decode,
                 norm_style=self.norm_style,
                 num_experts=self.num_experts if is_moe else 0,
                 experts_per_token=self.experts_per_token,

@@ -51,29 +51,37 @@ def _forward(state: TrainState, params, images, train: bool, dropout_rng=None):
     return logits, state.batch_stats, jnp.zeros((), jnp.float32)
 
 
+def _classification_loss(state: TrainState, params, batch, rng):
+    """The default objective (tf2_mnist_distributed.py:81-83 semantics) in
+    loss_fn form — the single definition behind both `train_step` and the
+    grad-accum path, so they cannot drift."""
+    images, labels = batch
+    logits, new_stats, aux = _forward(
+        state, params, images, train=True, dropout_rng=rng
+    )
+    loss = losses.sparse_categorical_crossentropy(logits, labels) + aux
+    return loss, {
+        "accuracy": metrics_lib.accuracy(logits, labels),
+        "batch_stats": new_stats,
+    }
+
+
 def train_step(
     state: TrainState, batch: Tuple[jax.Array, jax.Array], rng: jax.Array
 ) -> Tuple[TrainState, dict]:
     """One SGD step. batch = (images, int labels); returns (state, metrics)."""
-    images, labels = batch
     step_rng = jax.random.fold_in(rng, state.step)
 
     def loss_fn(params):
-        logits, new_stats, aux = _forward(
-            state, params, images, train=True, dropout_rng=step_rng
-        )
-        loss = losses.sparse_categorical_crossentropy(logits, labels) + aux
-        return loss, (logits, new_stats)
+        return _classification_loss(state, params, batch, step_rng)
 
-    (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+    (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
         state.params
     )
+    metrics = dict(metrics)
+    new_stats = metrics.pop("batch_stats", state.batch_stats)
     new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
-    m = {
-        "loss": loss,
-        "accuracy": metrics_lib.accuracy(logits, labels),
-    }
-    return new_state, m
+    return new_state, {"loss": loss, **metrics}
 
 
 def eval_step(
@@ -167,8 +175,16 @@ def _with_mesh(fn, mesh):
     return wrapped
 
 
-def make_train_step(strategy: Strategy, state: TrainState, donate: bool = True):
-    """Compile train_step with the strategy's shardings pinned."""
+def make_train_step(strategy: Strategy, state: TrainState, donate: bool = True,
+                    grad_accum: int = 1):
+    """Compile train_step with the strategy's shardings pinned. `grad_accum`
+    splits the batch into that many sequential microbatches per update (see
+    make_custom_train_step)."""
+    if grad_accum != 1:
+        return make_custom_train_step(
+            strategy, state, _classification_loss, donate=donate,
+            grad_accum=grad_accum,
+        )
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
     return jax.jit(
@@ -184,6 +200,7 @@ def make_custom_train_step(
     state: TrainState,
     loss_fn: Callable[[TrainState, Any, Any, jax.Array], Tuple[jax.Array, dict]],
     donate: bool = True,
+    grad_accum: int = 1,
 ):
     """Compile a train step with a user loss over an arbitrary batch pytree.
 
@@ -197,22 +214,115 @@ def make_custom_train_step(
     Models with BatchNorm return updated stats under the reserved metrics key
     ``"batch_stats"``. Every batch leaf must be [global_batch, ...]; each is
     sharded over the mesh's data axes.
+
+    `grad_accum=A` splits the global batch into A sequential microbatches
+    inside the SAME compiled step (`lax.scan`), averaging gradients before
+    the single optimizer update — activation memory drops ~A-fold while the
+    update matches the full-batch step exactly (BatchNorm stats chain
+    through the microbatches in order). For losses normalized by a
+    data-dependent denominator (e.g. masked-LM CE over the masked-position
+    count), a uniform average of microbatch gradients would be a
+    mean-of-means; return that denominator under the reserved metrics key
+    ``"grad_weight"`` and the accumulation weights each microbatch by it
+    (gradients, loss, and metrics), restoring the exact full-batch update.
+    The standard route to reference-scale global batches on few chips.
     """
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
-    def step(state: TrainState, batch, rng):
-        step_rng = jax.random.fold_in(rng, state.step)
-
+    def micro_grads(state: TrainState, batch, rng):
         def wrapped(params):
-            return loss_fn(state, params, batch, step_rng)
+            return loss_fn(state, params, batch, rng)
 
         (loss, metrics), grads = jax.value_and_grad(wrapped, has_aux=True)(
             state.params
         )
         metrics = dict(metrics)
         new_stats = metrics.pop("batch_stats", state.batch_stats)
-        new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
+        weight = metrics.pop("grad_weight", None)
+        return grads, loss, metrics, new_stats, weight
+
+    def step(state: TrainState, batch, rng):
+        step_rng = jax.random.fold_in(rng, state.step)
+        if grad_accum == 1:
+            grads, loss, metrics, new_stats, _ = micro_grads(
+                state, batch, step_rng
+            )
+            new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
+            return new_state, {"loss": loss, **metrics}
+
+        b = axes_lib.batch_axes()
+        from tfde_tpu.parallel.sharding import data_axes as _data_axes
+
+        d_shards = 1
+        for a in _data_axes(strategy.mesh):
+            d_shards *= strategy.mesh.shape[a]
+
+        def split(x):
+            n = x.shape[0]
+            if n % (grad_accum * d_shards):
+                raise ValueError(
+                    f"global batch {n} not divisible by grad_accum="
+                    f"{grad_accum} x {d_shards} data shards"
+                )
+            m = n // (grad_accum * d_shards)
+            # device-major split: microbatch i takes the i-th sub-chunk of
+            # every device's local shard, so the [B] -> [A, B/A] reshape is
+            # local to each device (a microbatch-major reshape would cut
+            # across shard boundaries and force SPMD to replicate the batch
+            # — "involuntary full rematerialization"). Microbatch membership
+            # is exchangeable; the accumulated gradient is identical.
+            x = x.reshape(d_shards, grad_accum, m, *x.shape[1:])
+            x = jnp.swapaxes(x, 0, 1)
+            x = x.reshape(grad_accum, d_shards * m, *x.shape[3:])
+            # microbatches keep the data sharding on their own batch dim
+            return axes_lib.constrain(x, None, b)
+
+        micro = jax.tree_util.tree_map(split, batch)
+        first = jax.tree_util.tree_map(lambda x: x[0], micro)
+        rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
+
+        def as_weight(w):
+            return (jnp.ones((), jnp.float32) if w is None
+                    else jnp.asarray(w, jnp.float32))
+
+        # microbatch 0 eagerly — its (grads, loss, metrics) fix the carry
+        # structure for the scan over microbatches 1..A-1
+        grads, loss, metrics, stats, w = micro_grads(
+            state, first, jax.random.fold_in(step_rng, 0)
+        )
+        w0 = as_weight(w)
+        grads = jax.tree_util.tree_map(lambda g: g * w0, grads)
+        loss = loss * w0
+        metrics = jax.tree_util.tree_map(lambda m: m * w0, metrics)
+
+        def body(carry, inp):
+            grads_sum, loss_sum, metrics_sum, wsum, stats = carry
+            i, mb = inp
+            st = state.replace(batch_stats=stats)
+            g, l, m, stats, w = micro_grads(
+                st, mb, jax.random.fold_in(step_rng, i)
+            )
+            wi = as_weight(w)
+            return (
+                jax.tree_util.tree_map(lambda a, b: a + b * wi, grads_sum, g),
+                loss_sum + l * wi,
+                jax.tree_util.tree_map(lambda a, b: a + b * wi, metrics_sum, m),
+                wsum + wi,
+                stats,
+            ), None
+
+        idx = jnp.arange(1, grad_accum)
+        (grads, loss, metrics, wsum, stats), _ = jax.lax.scan(
+            body, (grads, loss, metrics, w0, stats), (idx, rest)
+        )
+        inv = 1.0 / wsum
+        grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        loss = loss * inv
+        metrics = jax.tree_util.tree_map(lambda m: m * inv, metrics)
+        new_state = state.apply_gradients(grads, new_batch_stats=stats)
         return new_state, {"loss": loss, **metrics}
 
     def batch_shardings(batch):
