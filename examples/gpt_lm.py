@@ -84,6 +84,9 @@ def main(argv=None):
                              "not with --pipeline: PipelinedLM is a "
                              "training-schedule model, export weights to "
                              "GPT for serving)")
+    parser.add_argument("--beams", type=int, default=0, metavar="K",
+                        help="with --generate: beam-search decode with K "
+                             "beams (inference/beam.py) instead of sampling")
     parser.add_argument("--tiny", action="store_true")
     parser.add_argument("--remat", nargs="?", const="full", default=False,
                         choices=["full", "dots"])
@@ -107,6 +110,11 @@ def main(argv=None):
         raise ValueError(
             "--tensor requires --pipeline (3D dp x pp x tp); for TP without "
             "pipelining use TensorParallelStrategy via a custom entrypoint"
+        )
+    if args.beams > 0 and args.generate <= 0:
+        raise ValueError(
+            "--beams selects the decode mode for --generate; pass "
+            "--generate N to produce output"
         )
     if args.generate > 0 and args.pipeline > 1:
         # fail before training, not after: the post-training generate call
@@ -214,16 +222,29 @@ def main(argv=None):
             log.info("step %d: %s (%.2f steps/s)", step + 1, vals, sps)
 
     if args.generate > 0:
-        from tfde_tpu.inference.decode import generate
-
         prompt = tokens[:2, : min(16, args.seq_len)]
-        out, lengths = generate(
-            model, state.params, prompt,
-            max_new_tokens=args.generate,
-            temperature=0.8, top_k=40, rng=jax.random.key(2),
-        )
-        for row, n in zip(np.asarray(out), np.asarray(lengths)):
-            log.info("generated: %s", row[: int(n)].tolist())
+        if args.beams > 0:
+            from tfde_tpu.inference.beam import beam_search
+
+            out, scores, lengths = beam_search(
+                model, state.params, prompt,
+                max_new_tokens=args.generate, num_beams=args.beams,
+            )
+            for row, score, n in zip(
+                np.asarray(out[:, 0]), np.asarray(scores[:, 0]),
+                np.asarray(lengths[:, 0]),
+            ):
+                log.info("beam best (%.3f): %s", score, row[: int(n)].tolist())
+        else:
+            from tfde_tpu.inference.decode import generate
+
+            out, lengths = generate(
+                model, state.params, prompt,
+                max_new_tokens=args.generate,
+                temperature=0.8, top_k=40, rng=jax.random.key(2),
+            )
+            for row, n in zip(np.asarray(out), np.asarray(lengths)):
+                log.info("generated: %s", row[: int(n)].tolist())
     return state, metrics
 
 

@@ -1,0 +1,123 @@
+"""Beam-search tests (inference/beam.py): greedy equivalence at K=1,
+exhaustive optimality on a tiny vocab, EOS freezing, ordering invariants.
+
+Oracle strategy (SURVEY.md §4): with num_beams == vocab and two generated
+tokens, the search is exhaustive over step-1 prefixes, so the best beam must
+equal the argmax over ALL vocab^2 continuations scored by the uncached full
+forward — beam search checked against brute force, the decode analog of the
+TP==DP numerics tests."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.inference.beam import beam_search
+from tfde_tpu.inference.decode import generate
+from tfde_tpu.models.gpt import GPT
+
+
+@pytest.fixture(scope="module")
+def nano_lm():
+    """vocab small enough to brute-force continuations."""
+    m = GPT(vocab_size=7, hidden_size=16, depth=2, num_heads=2, mlp_dim=32,
+            max_position=16, dtype=jnp.float32)
+    params = m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+def _joint_logprob(model, params, prompt_row, continuation):
+    """Sum of log p(token_t | prefix) over the continuation, full forward."""
+    toks = list(np.asarray(prompt_row))
+    total = 0.0
+    for tok in continuation:
+        logits = model.apply(
+            {"params": params}, jnp.asarray([toks], jnp.int32)
+        )
+        logp = jax.nn.log_softmax(logits[0, -1].astype(jnp.float32))
+        total += float(logp[tok])
+        toks.append(int(tok))
+    return total
+
+
+def test_beam1_equals_greedy(nano_lm, rng):
+    model, params = nano_lm
+    prompt = jnp.asarray(rng.integers(0, 7, (2, 3)), jnp.int32)
+    greedy, _ = generate(model, params, prompt, max_new_tokens=5)
+    beams, scores, lengths = beam_search(
+        model, params, prompt, max_new_tokens=5, num_beams=1,
+        length_penalty=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(beams[:, 0]), np.asarray(greedy))
+    np.testing.assert_array_equal(np.asarray(lengths[:, 0]), [8, 8])
+
+
+def test_beam_exhaustive_optimality(nano_lm, rng):
+    """num_beams == vocab + 2 steps = exhaustive: the winner must be the
+    brute-force argmax over all 49 continuations, and its reported score
+    must equal the full-forward joint log-prob."""
+    model, params = nano_lm
+    prompt = jnp.asarray(rng.integers(0, 7, (1, 3)), jnp.int32)
+    beams, scores, _ = beam_search(
+        model, params, prompt, max_new_tokens=2, num_beams=7,
+        length_penalty=0.0,
+    )
+    best = tuple(np.asarray(beams)[0, 0, 3:])
+    best_score = float(scores[0, 0])
+
+    all_scores = {
+        cont: _joint_logprob(model, params, np.asarray(prompt)[0], cont)
+        for cont in itertools.product(range(7), repeat=2)
+    }
+    oracle = max(all_scores, key=all_scores.get)
+    assert best == oracle
+    np.testing.assert_allclose(best_score, all_scores[oracle], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_beams_sorted_and_distinct(nano_lm, rng):
+    model, params = nano_lm
+    prompt = jnp.asarray(rng.integers(0, 7, (2, 3)), jnp.int32)
+    beams, scores, _ = beam_search(
+        model, params, prompt, max_new_tokens=4, num_beams=4,
+        length_penalty=0.0,
+    )
+    s = np.asarray(scores)
+    assert (np.diff(s, axis=1) <= 1e-6).all(), "beams not sorted best-first"
+    for row in np.asarray(beams):
+        assert len({tuple(x) for x in row}) == 4, "duplicate beams"
+
+
+def test_beam_eos_freezes_and_pads(nano_lm, rng):
+    """Force EOS = the greedy first token: the best beam should finish at
+    length prompt+1 and carry pads after it."""
+    model, params = nano_lm
+    prompt = jnp.asarray(rng.integers(0, 7, (1, 3)), jnp.int32)
+    free, _, _ = beam_search(model, params, prompt, max_new_tokens=4,
+                             num_beams=3, length_penalty=0.0)
+    eos = int(np.asarray(free)[0, 0, 3])
+    beams, scores, lengths = beam_search(
+        model, params, prompt, max_new_tokens=4, num_beams=3,
+        length_penalty=0.0, eos_id=eos, pad_id=0,
+    )
+    rows = np.asarray(beams)[0]
+    lens = np.asarray(lengths)[0]
+    finished = [i for i in range(3) if eos in rows[i, 3:]]
+    assert finished, "no beam finished despite EOS being the greedy token"
+    for i in finished:
+        e = list(rows[i, 3:]).index(eos)
+        assert lens[i] == 3 + e + 1
+        assert (rows[i, 3 + e + 1:] == 0).all()
+
+
+def test_beam_rejects_bad_args(nano_lm):
+    model, params = nano_lm
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match="num_beams"):
+        beam_search(model, params, prompt, max_new_tokens=2, num_beams=0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        beam_search(model, params, prompt, max_new_tokens=0)
+    with pytest.raises(ValueError, match="max_position"):
+        beam_search(model, params, prompt, max_new_tokens=20)

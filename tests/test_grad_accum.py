@@ -150,6 +150,34 @@ def test_grad_accum_weighted_matches_masked_loss(rng):
     assert "grad_weight" not in out[1][1] and "grad_weight" not in out[2][1]
 
 
+def test_grad_accum_all_zero_weights_is_noop_not_nan(rng):
+    """Every microbatch weightless (an all-IGNORE MLM batch): the update
+    must be a clean zero-gradient step, not 0 * inf = NaN params."""
+    from tfde_tpu.ops.losses import masked_lm_loss
+
+    def loss_fn(state, params, batch, rng_):
+        tokens, labels = batch
+        logits = state.apply_fn({"params": params}, tokens, train=True,
+                                rngs={"dropout": rng_})
+        loss, acc = masked_lm_loss(logits, labels)
+        n = jnp.sum((labels != -100).astype(jnp.float32))
+        return loss, {"mlm_accuracy": acc, "grad_weight": n}
+
+    strategy = MirroredStrategy()
+    tokens = rng.integers(0, 97, (16, 16)).astype(np.int32)
+    labels = np.full((16, 16), -100, np.int32)  # zero targets everywhere
+    state, _ = init_state(
+        gpt_tiny_test(), optax.sgd(1e-2), strategy,
+        np.zeros((16, 16), np.int32),
+    )
+    before = jax.tree_util.tree_map(np.asarray, state.params)
+    step = make_custom_train_step(strategy, state, loss_fn, donate=False,
+                                  grad_accum=2)
+    state, metrics = step(state, (tokens, labels), jax.random.key(0))
+    assert np.isfinite(float(metrics["loss"]))
+    _leaves_allclose(before, state.params, rtol=0, atol=0)
+
+
 def test_grad_accum_rejects_indivisible_batch(rng):
     strategy = MirroredStrategy()
     state, _ = init_state(

@@ -2,6 +2,7 @@
 LMs (decode.generate), the capability the reference's SavedModel export
 story implies for servable models (SURVEY.md §2a #12)."""
 
+from tfde_tpu.inference.beam import beam_search
 from tfde_tpu.inference.decode import generate, init_cache, sample_logits
 
-__all__ = ["generate", "init_cache", "sample_logits"]
+__all__ = ["beam_search", "generate", "init_cache", "sample_logits"]

@@ -1,0 +1,156 @@
+"""Beam-search decoding over the KV-cache decode path (inference/decode.py).
+
+The deterministic serving mode next to sampling-based `generate`: maintain
+the `num_beams` highest joint-log-prob continuations per batch row, extending
+all of them one token per step through the same cached decode program.
+
+TPU-native shape discipline: beams ride the batch dim (the model sees
+[B*K, 1] tokens), the whole search is one jitted program (prefill +
+`lax.scan`), and every step's beam reorder is a `jnp.take` gather of the
+cache along the batch axis — a bandwidth cost that buys static shapes and
+zero recompiles, the right trade on XLA.
+
+Algorithm (the "K live beams" variant): every step scores all K*V
+single-token extensions per row and keeps the top K. A beam that has
+emitted `eos_id` is *finished*: it extends only with `pad_id` at zero
+additional cost, so its joint score is frozen and it keeps competing for a
+slot — equivalent to a finished-hypothesis set of size <= K without the
+dynamic bookkeeping. Final ranking divides the joint log-prob by
+`length ** length_penalty` (0.0 = no normalization; ~0.6 is the usual
+translation-decoding setting).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tfde_tpu.inference.decode import (
+    _decode_clone,
+    init_cache,
+    validate_budget,
+)
+
+_NEG = -1e9  # additive "impossible" — finite, so fp arithmetic stays clean
+
+
+def _gather_beams(tree, idx: jax.Array, batch: int, beams: int):
+    """Reorder the beam-major batch dim ([B*K, ...]) of every leaf by
+    per-row beam indices idx [B, K]."""
+    flat = idx + (jnp.arange(batch)[:, None] * beams)  # [B, K] global rows
+
+    def take(x):
+        if x.ndim == 0:
+            return x  # scalar counters (cache_index/position_index) are
+            # beam-invariant — every beam is at the same decode position
+        return jnp.take(x, flat.reshape(-1), axis=0)
+
+    return jax.tree.map(take, tree)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "num_beams",
+                     "length_penalty", "eos_id", "pad_id"),
+)
+def beam_search(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    num_beams: int = 4,
+    length_penalty: float = 0.6,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+):
+    """[B, P] int32 prompt -> (tokens [B, K, P + max_new_tokens],
+    scores [B, K], lengths [B, K]), beams sorted best-first by
+    length-normalized joint log-prob. `tokens[:, 0]` is the decode result.
+    """
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    b, p = prompt.shape
+    k = num_beams
+    total = validate_budget(model, p, max_new_tokens)
+    decode_model = _decode_clone(model)
+    prompt = prompt.astype(jnp.int32)
+
+    def model_step(cache, tokens):
+        logits, mutated = decode_model.apply(
+            {"params": params, "cache": cache}, tokens, train=False,
+            mutable=["cache"],
+        )
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        return mutated["cache"], logp  # [rows, V]
+
+    # Prefill on [B*K, P]: all K beams of a row share the prompt, so the
+    # cache starts correctly beam-expanded (a [B, P] prefill + tile of the
+    # cache pytree would save K-1x prefill compute at the cost of knowing
+    # the cache layout here; prefill is one forward — simplicity wins).
+    cache = init_cache(model, b * k, total)
+    expanded = jnp.repeat(prompt, k, axis=0)
+    cache, logp = model_step(cache, expanded)  # logp [B*K, V]
+    vocab = logp.shape[-1]
+
+    # First step: the K beams are still identical, so pick the top-K tokens
+    # of each ROW (not of K copies) to seed distinct beams.
+    row_logp = logp.reshape(b, k, vocab)[:, 0]  # [B, V]
+    scores, first_tok = jax.lax.top_k(row_logp, k)  # [B, K]
+    live_tok = first_tok.reshape(-1)  # beam-major [B*K]
+    seqs = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+    seqs = seqs.at[:, :, 0].set(first_tok)
+    finished = (
+        (first_tok == eos_id) if eos_id is not None
+        else jnp.zeros((b, k), jnp.bool_)
+    )
+
+    def step(carry, t):
+        cache, seqs, scores, live_tok, finished = carry
+        cache, logp = model_step(cache, live_tok[:, None])  # [B*K, V]
+        logp = logp.reshape(b, k, vocab)
+        if eos_id is not None:
+            # finished beams extend only with pad at zero cost: their joint
+            # score freezes while they keep competing for a top-K slot
+            pad_only = jnp.full((vocab,), _NEG).at[pad_id].set(0.0)
+            logp = jnp.where(finished[:, :, None], pad_only[None, None], logp)
+        cand = scores[:, :, None] + logp  # [B, K, V]
+        scores, flat_idx = jax.lax.top_k(cand.reshape(b, k * vocab), k)
+        beam_idx = flat_idx // vocab  # [B, K] source beam
+        tok = (flat_idx % vocab).astype(jnp.int32)
+        cache = _gather_beams(cache, beam_idx, b, k)
+        seqs = jnp.take_along_axis(seqs, beam_idx[:, :, None], axis=1)
+        seqs = seqs.at[:, :, t].set(tok)
+        if eos_id is not None:
+            finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+            finished = finished | (tok == eos_id)
+        return (cache, seqs, scores, tok.reshape(-1), finished), None
+
+    if max_new_tokens > 1:
+        (cache, seqs, scores, live_tok, finished), _ = jax.lax.scan(
+            step, (cache, seqs, scores, live_tok, finished),
+            jnp.arange(1, max_new_tokens),
+        )
+
+    # generated length per beam: count through the first EOS, pad after
+    if eos_id is None:
+        lengths = jnp.full((b, k), max_new_tokens, jnp.int32)
+    else:
+        is_eos = (seqs == eos_id).astype(jnp.int32)
+        seen_before = jnp.cumsum(is_eos, axis=-1) - is_eos
+        alive = (seen_before == 0).astype(jnp.int32)
+        lengths = jnp.sum(alive, axis=-1)
+        seqs = jnp.where(seen_before == 0, seqs, pad_id)
+
+    norm = jnp.asarray(lengths, jnp.float32) ** length_penalty
+    final = scores / jnp.maximum(norm, 1.0)
+    order = jnp.argsort(-final, axis=-1)  # best first
+    seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+    final = jnp.take_along_axis(final, order, axis=1)
+    lengths = jnp.take_along_axis(lengths, order, axis=1)
+    tokens = jnp.concatenate(
+        [jnp.broadcast_to(prompt[:, None], (b, k, p)), seqs], axis=-1
+    )
+    return tokens, final, p + lengths

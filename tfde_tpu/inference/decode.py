@@ -53,6 +53,21 @@ def _decode_clone(model):
     return model.clone(**kw)
 
 
+def validate_budget(model, prompt_len: int, max_new_tokens: int) -> int:
+    """Shared generate/beam_search argument check; returns the total cache
+    budget prompt_len + max_new_tokens."""
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    total = prompt_len + max_new_tokens
+    max_pos = getattr(model, "max_position", None)
+    if max_pos is not None and total > max_pos:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) = "
+            f"{total} exceeds the model's max_position {max_pos}"
+        )
+    return total
+
+
 def init_cache(model, batch_size: int, max_len: int):
     """Zero-filled "cache" collection for `model.clone(decode=True)` sized to
     a [batch_size, max_len] generation budget.
@@ -141,18 +156,10 @@ def generate(
     max_len] mask on the attention hot path for a capability batching
     usually handles upstream).
     """
-    if max_new_tokens < 1:
-        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if rng is None:
         rng = jax.random.key(0)
     b, p = prompt.shape
-    total = p + max_new_tokens
-    max_pos = getattr(model, "max_position", None)
-    if max_pos is not None and total > max_pos:
-        raise ValueError(
-            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) = {total} "
-            f"exceeds the model's max_position {max_pos}"
-        )
+    total = validate_budget(model, p, max_new_tokens)
     decode_model = _decode_clone(model)
     cache = init_cache(model, b, total)
     prompt = prompt.astype(jnp.int32)

@@ -318,7 +318,10 @@ def make_custom_train_step(
         (grads, loss, metrics, wsum, stats), _ = jax.lax.scan(
             body, (grads, loss, metrics, w0, stats), (idx, rest)
         )
-        inv = 1.0 / wsum
+        # wsum == 0 (every microbatch weightless, e.g. an all-IGNORE MLM
+        # batch) must yield the accum=1 behavior — a clean zero-gradient
+        # update — not 0 * inf = NaN params; any positive wsum divides exactly
+        inv = 1.0 / jnp.where(wsum > 0, wsum, 1.0)
         grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
         loss = loss * inv
         metrics = jax.tree_util.tree_map(lambda m: m * inv, metrics)
