@@ -87,6 +87,11 @@ def main(argv=None):
     parser.add_argument("--beams", type=int, default=0, metavar="K",
                         help="with --generate: beam-search decode with K "
                              "beams (inference/beam.py) instead of sampling")
+    parser.add_argument("--export-generate", type=str, default=None,
+                        metavar="DIR",
+                        help="with --generate: also export the whole decode "
+                             "loop as a StableHLO serving artifact "
+                             "(export/generative.py) under DIR")
     parser.add_argument("--tiny", action="store_true")
     parser.add_argument("--remat", nargs="?", const="full", default=False,
                         choices=["full", "dots"])
@@ -115,6 +120,17 @@ def main(argv=None):
         raise ValueError(
             "--beams selects the decode mode for --generate; pass "
             "--generate N to produce output"
+        )
+    if args.export_generate and args.generate <= 0:
+        raise ValueError(
+            "--export-generate sizes the artifact from --generate; pass "
+            "--generate N to export"
+        )
+    if args.export_generate and args.beams > 0:
+        raise ValueError(
+            "--export-generate exports the sampling decode loop; exporting "
+            "beam search is not supported yet — drop --beams to export, or "
+            "drop --export-generate to beam-decode in process"
         )
     if args.generate > 0 and args.pipeline > 1:
         # fail before training, not after: the post-training generate call
@@ -245,6 +261,15 @@ def main(argv=None):
             )
             for row, n in zip(np.asarray(out), np.asarray(lengths)):
                 log.info("generated: %s", row[: int(n)].tolist())
+        if args.export_generate:
+            from tfde_tpu.export.generative import export_generate
+
+            d = export_generate(
+                model, state.params, args.export_generate,
+                prompt_len=prompt.shape[1], max_new_tokens=args.generate,
+                batch_size=prompt.shape[0], temperature=0.8, top_k=40,
+            )
+            log.info("generative serving artifact: %s", d)
     return state, metrics
 
 

@@ -1,3 +1,7 @@
 """Serving export — the SavedModel/FinalExporter capability (SURVEY.md §3.4)."""
 
+from tfde_tpu.export.generative import (  # noqa: F401
+    export_generate,
+    load_generate,
+)
 from tfde_tpu.export.serving import export_serving, load_serving, FinalExporter  # noqa: F401

@@ -1,0 +1,133 @@
+"""Generative serving export: freeze a causal LM's ENTIRE decode loop.
+
+Where export/serving.py freezes a single forward pass (the reference's
+SavedModel signature, mnist_keras:151-162), a token model's servable unit is
+the generation program — prefill, KV-cache decode scan, and sampling
+(inference/decode.generate). Because that whole loop is one jitted XLA
+program, it exports exactly like a forward pass: one StableHLO artifact,
+loadable and callable with no model code, deterministic given (prompt, seed).
+
+Artifact layout mirrors the classifier export:
+
+    <dir>/<timestamp>/
+      signature.json    prompt/output spec + the burned-in sampling config
+      params.npz        final params, host-gathered
+      model.stablehlo   jax.export serialization of generate(), cpu+tpu
+
+The sampling configuration (temperature/top_k/top_p/eos) is part of the
+compiled program — a deployment picks it at export time, the way it picks
+the signature shape. The `seed` argument stays runtime: one artifact serves
+any number of sampled continuations.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from tfde_tpu.export.serving import _load_artifact, _write_artifact
+from tfde_tpu.inference.decode import generate, validate_budget
+
+log = logging.getLogger(__name__)
+
+
+def export_generate(
+    model,
+    params,
+    directory: str,
+    prompt_len: int,
+    max_new_tokens: int,
+    batch_size: int = 1,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    platforms: Tuple[str, ...] = ("cpu", "tpu"),
+) -> str:
+    """Write a generative serving artifact; returns the timestamped dir.
+
+    The exported entry point is `(prompt [B, P] int32, seed [] int32) ->
+    (tokens [B, P + N] int32, lengths [B] int32)` with B/P/N fixed at
+    export (XLA static shapes; export one artifact per serving bucket)."""
+    validate_budget(model, prompt_len, max_new_tokens)
+    host_params = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)), params
+    )
+
+    def serve(prompt, seed):
+        return generate(
+            model, host_params, prompt, max_new_tokens,
+            rng=jax.random.key(seed), temperature=temperature, top_k=top_k,
+            top_p=top_p, eos_id=eos_id, pad_id=pad_id,
+        )
+
+    prompt_arg = jax.ShapeDtypeStruct((batch_size, prompt_len), jnp.int32)
+    seed_arg = jax.ShapeDtypeStruct((), jnp.int32)
+    exported = jax_export.export(jax.jit(serve), platforms=platforms)(
+        prompt_arg, seed_arg
+    )
+    out_dir = _write_artifact(
+        directory, exported, host_params,
+        {
+            "kind": "generate",
+            "inputs": {
+                "prompt": {"shape": [batch_size, prompt_len],
+                           "dtype": "int32"},
+                "seed": {"shape": [], "dtype": "int32"},
+            },
+            # the entry point returns a (tokens, lengths) TUPLE — schema
+            # consumers must expect both arrays
+            "outputs": {
+                "tokens": {"shape": [batch_size,
+                                     prompt_len + max_new_tokens],
+                           "dtype": "int32"},
+                "lengths": {"shape": [batch_size], "dtype": "int32"},
+            },
+            "max_new_tokens": max_new_tokens,
+            "sampling": {
+                "temperature": temperature,
+                "top_k": top_k,
+                "top_p": top_p,
+                "eos_id": eos_id,
+                "pad_id": pad_id,
+            },
+            "platforms": list(platforms),
+            "framework": "tfde_tpu",
+        },
+    )
+    log.info("generative artifact exported -> %s", out_dir)
+    return out_dir
+
+
+class GenerativeModel:
+    """Loaded artifact; `generate(prompt, seed)` -> (tokens, lengths)."""
+
+    def __init__(self, exported, signature: dict, params: dict):
+        self._exported = exported
+        self.signature = signature
+        self.params = params
+
+    def generate(self, prompt: np.ndarray, seed: int = 0):
+        toks, lengths = self._exported.call(
+            np.asarray(prompt, np.int32), np.int32(seed)
+        )
+        return np.asarray(toks), np.asarray(lengths)
+
+
+def load_generate(export_dir: str) -> GenerativeModel:
+    """Load a generative artifact (timestamped dir, or the parent resolving
+    the newest). Local paths and remote URLs both work (utils/fs)."""
+    exported, signature, params = _load_artifact(export_dir)
+    if signature.get("kind") != "generate":
+        raise ValueError(
+            f"{export_dir} is not a generative artifact "
+            f"(kind={signature.get('kind')!r}); use export.serving."
+            f"load_serving for forward-pass artifacts"
+        )
+    return GenerativeModel(exported, signature, params)

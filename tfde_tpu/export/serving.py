@@ -64,6 +64,42 @@ def _unflatten_params(flat: dict) -> dict:
     return tree
 
 
+def _write_artifact(directory: str, exported, host_vars, signature: dict) -> str:
+    """Shared artifact writer: timestamped dir + model.stablehlo +
+    params.npz + signature.json (export_serving and export_generate)."""
+    stamp = datetime.datetime.now().strftime("%Y%m%d%H%M%S")
+    out_dir = fs.join(directory, stamp)
+    fs.makedirs(out_dir, exist_ok=True)
+    with fs.fs_open(fs.join(out_dir, "model.stablehlo"), "wb") as f:
+        f.write(exported.serialize())
+    buf = io.BytesIO()
+    np.savez(buf, **_flatten_params(host_vars))
+    with fs.fs_open(fs.join(out_dir, "params.npz"), "wb") as f:
+        f.write(buf.getvalue())
+    with fs.fs_open(fs.join(out_dir, "signature.json"), "w") as f:
+        json.dump(signature, f, indent=2)
+    return out_dir
+
+
+def _load_artifact(export_dir: str):
+    """Shared loader: resolve the newest timestamped subdir, read
+    (exported, signature, params)."""
+    entries = sorted(
+        d for d in fs.listdir(export_dir)
+        if fs.isdir(fs.join(export_dir, d)) and d.isdigit()
+    )
+    if entries and not fs.exists(fs.join(export_dir, "signature.json")):
+        export_dir = fs.join(export_dir, entries[-1])
+    with fs.fs_open(fs.join(export_dir, "signature.json"), "r") as f:
+        signature = json.load(f)
+    with fs.fs_open(fs.join(export_dir, "model.stablehlo"), "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with fs.fs_open(fs.join(export_dir, "params.npz"), "rb") as f:
+        z = np.load(io.BytesIO(f.read()))
+    params = _unflatten_params({k: z[k] for k in z.files})
+    return exported, signature, params
+
+
 def export_serving(
     apply_fn: Callable,
     variables: dict,
@@ -79,10 +115,6 @@ def export_serving(
     symbolic batch dim, e.g. (None, 784) — the reference's serving
     placeholder shape (mnist_keras:159).
     """
-    stamp = datetime.datetime.now().strftime("%Y%m%d%H%M%S")
-    out_dir = fs.join(directory, stamp)
-    fs.makedirs(out_dir, exist_ok=True)
-
     host_vars = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), variables)
 
     def serve(x):
@@ -97,30 +129,20 @@ def export_serving(
     arg = jax.ShapeDtypeStruct(tuple(dims), input_dtype)
 
     exported = jax_export.export(jax.jit(serve), platforms=platforms)(arg)
-    with fs.fs_open(fs.join(out_dir, "model.stablehlo"), "wb") as f:
-        f.write(exported.serialize())
-
-    buf = io.BytesIO()
-    np.savez(buf, **_flatten_params(host_vars))
-    with fs.fs_open(fs.join(out_dir, "params.npz"), "wb") as f:
-        f.write(buf.getvalue())
-
     out_shape = jax.eval_shape(serve, arg)
-    with fs.fs_open(fs.join(out_dir, "signature.json"), "w") as f:
-        json.dump(
-            {
-                "input": {"shape": list(input_shape), "dtype": str(np.dtype(input_dtype))},
-                "output": {
-                    "shape": [int(d) if isinstance(d, int) else None for d in out_shape.shape],
-                    "dtype": str(out_shape.dtype),
-                },
-                "apply_softmax": apply_softmax,
-                "platforms": list(platforms),
-                "framework": "tfde_tpu",
+    out_dir = _write_artifact(
+        directory, exported, host_vars,
+        {
+            "input": {"shape": list(input_shape), "dtype": str(np.dtype(input_dtype))},
+            "output": {
+                "shape": [int(d) if isinstance(d, int) else None for d in out_shape.shape],
+                "dtype": str(out_shape.dtype),
             },
-            f,
-            indent=2,
-        )
+            "apply_softmax": apply_softmax,
+            "platforms": list(platforms),
+            "framework": "tfde_tpu",
+        },
+    )
     log.info("serving artifact exported -> %s", out_dir)
     return out_dir
 
@@ -141,20 +163,7 @@ def load_serving(export_dir: str) -> ServingModel:
     """Load a serving artifact from its timestamped directory (or the parent,
     resolving the newest timestamp — FinalExporter keeps history). Works on
     local paths and remote URLs (gs://, memory://)."""
-    entries = sorted(
-        d for d in fs.listdir(export_dir)
-        if fs.isdir(fs.join(export_dir, d)) and d.isdigit()
-    )
-    if entries and not fs.exists(fs.join(export_dir, "signature.json")):
-        export_dir = fs.join(export_dir, entries[-1])
-    with fs.fs_open(fs.join(export_dir, "signature.json"), "r") as f:
-        signature = json.load(f)
-    with fs.fs_open(fs.join(export_dir, "model.stablehlo"), "rb") as f:
-        exported = jax_export.deserialize(f.read())
-    with fs.fs_open(fs.join(export_dir, "params.npz"), "rb") as f:
-        z = np.load(io.BytesIO(f.read()))
-    params = _unflatten_params({k: z[k] for k in z.files})
-    return ServingModel(exported, signature, params)
+    return ServingModel(*_load_artifact(export_dir))
 
 
 class FinalExporter:
