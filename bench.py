@@ -617,6 +617,59 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
     return out
 
 
+def _bench_decode(clock: _Clock, smoke: bool) -> dict:
+    """Serving-side decode throughput: GPT-2-small KV-cache generation
+    (inference/decode.py) — tokens/sec at batch 8, prompt 128. The decode
+    regime is HBM-bandwidth-bound (every step streams the full weights +
+    cache for one token per row), so this measures a different ceiling than
+    the training MFU configs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.gpt import GPT, GPT2Small
+
+    if smoke:
+        batch, prompt_len, new = 2, 16, 8
+        model = GPT(vocab_size=512, hidden_size=64, depth=2, num_heads=2,
+                    mlp_dim=128, max_position=64, dtype=jnp.float32)
+    else:
+        batch, prompt_len, new = 8, 128, 128
+        model = GPT2Small(max_position=prompt_len + new, dropout_rate=0.0)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((batch, prompt_len + new), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, model.vocab_size, (batch, prompt_len)), jnp.int32
+    )
+
+    def run(reps):
+        toks = None
+        for i in range(reps):
+            toks, _ = generate(model, params, prompt, max_new_tokens=new,
+                               rng=jax.random.key(i), temperature=1.0,
+                               top_k=40)
+        return toks
+
+    # compile + warm
+    clock.fetch_scalar(run(1)[0, -1].astype(jnp.float32))
+    reps, window, gap, _ = clock.timed(
+        lambda r: run(r), lambda t: t[0, -1].astype(jnp.float32),
+        0.05 if smoke else 2.0, start_reps=1, max_reps=200,
+    )
+    per_call = window / reps
+    return {
+        "decode_batch": batch,
+        "decode_prompt_len": prompt_len,
+        "decode_new_tokens": new,
+        "decode_tokens_per_sec": round(batch * new / per_call, 1),
+        "decode_ms_per_token": round(per_call / new * 1e3, 3),
+        "decode_calls_timed": reps,
+    }
+
+
 def run_mode() -> None:
     import jax
 
@@ -667,6 +720,7 @@ def run_mode() -> None:
                                            prefix="bert32")),
         ("gpt_long", lambda: _bench_gpt_long(clock, strategy, n_chips, peak,
                                              smoke)),
+        ("decode", lambda: _bench_decode(clock, smoke)),
     ]
 
     def emit(partial: bool) -> None:

@@ -239,6 +239,9 @@ def main(argv=None):
 
     if args.generate > 0:
         prompt = tokens[:2, : min(16, args.seq_len)]
+        # one sampling config for the in-process decode AND the export, so
+        # the artifact reproduces exactly what was just logged
+        sampling = dict(temperature=0.8, top_k=40)
         if args.beams > 0:
             from tfde_tpu.inference.beam import beam_search
 
@@ -257,7 +260,7 @@ def main(argv=None):
             out, lengths = generate(
                 model, state.params, prompt,
                 max_new_tokens=args.generate,
-                temperature=0.8, top_k=40, rng=jax.random.key(2),
+                rng=jax.random.key(2), **sampling,
             )
             for row, n in zip(np.asarray(out), np.asarray(lengths)):
                 log.info("generated: %s", row[: int(n)].tolist())
@@ -267,7 +270,7 @@ def main(argv=None):
             d = export_generate(
                 model, state.params, args.export_generate,
                 prompt_len=prompt.shape[1], max_new_tokens=args.generate,
-                batch_size=prompt.shape[0], temperature=0.8, top_k=40,
+                batch_size=prompt.shape[0], **sampling,
             )
             log.info("generative serving artifact: %s", d)
     return state, metrics

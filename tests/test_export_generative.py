@@ -65,3 +65,16 @@ def test_load_generate_rejects_classifier_artifact(tmp_path, tiny_lm):
     )
     with pytest.raises(ValueError, match="not a generative artifact"):
         load_generate(d)
+
+
+def test_load_serving_rejects_generative_artifact(tmp_path, tiny_lm):
+    """The kind check is bidirectional: pointing the classifier loader at a
+    generative artifact must fail with guidance, not an arity error at the
+    first predict()."""
+    from tfde_tpu.export.serving import load_serving
+
+    model, params = tiny_lm
+    d = export_generate(model, params, str(tmp_path), prompt_len=4,
+                        max_new_tokens=2)
+    with pytest.raises(ValueError, match="load_generate"):
+        load_serving(d)

@@ -163,7 +163,14 @@ def load_serving(export_dir: str) -> ServingModel:
     """Load a serving artifact from its timestamped directory (or the parent,
     resolving the newest timestamp — FinalExporter keeps history). Works on
     local paths and remote URLs (gs://, memory://)."""
-    return ServingModel(*_load_artifact(export_dir))
+    exported, signature, params = _load_artifact(export_dir)
+    if signature.get("kind") == "generate":
+        raise ValueError(
+            f"{export_dir} is a generative artifact (2-argument "
+            f"(prompt, seed) entry point); use export.generative."
+            f"load_generate"
+        )
+    return ServingModel(exported, signature, params)
 
 
 class FinalExporter:
