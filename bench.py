@@ -645,27 +645,44 @@ def _bench_decode(clock: _Clock, smoke: bool) -> dict:
         rng.integers(0, model.vocab_size, (batch, prompt_len)), jnp.int32
     )
 
-    def run(reps):
-        toks = None
-        for i in range(reps):
-            toks, _ = generate(model, params, prompt, max_new_tokens=new,
-                               rng=jax.random.key(i), temperature=1.0,
-                               top_k=40)
-        return toks
+    def make_run(n_new):
+        def run(reps):
+            toks = None
+            for i in range(reps):
+                toks, _ = generate(model, params, prompt, max_new_tokens=n_new,
+                                   rng=jax.random.key(i), temperature=1.0,
+                                   top_k=40)
+            return toks
+        return run
 
-    # compile + warm
-    clock.fetch_scalar(run(1)[0, -1].astype(jnp.float32))
-    reps, window, gap, _ = clock.timed(
-        lambda r: run(r), lambda t: t[0, -1].astype(jnp.float32),
-        0.05 if smoke else 2.0, start_reps=1, max_reps=200,
-    )
-    per_call = window / reps
+    def time_call(n_new):
+        run = make_run(n_new)
+        clock.fetch_scalar(run(1)[0, -1].astype(jnp.float32))  # compile+warm
+        reps, window, _, _ = clock.timed(
+            run, lambda t: t[0, -1].astype(jnp.float32),
+            0.05 if smoke else 2.0, start_reps=1, max_reps=200,
+        )
+        return window / reps, reps
+
+    # The full call includes the prompt prefill; an N=1 baseline isolates
+    # it (prefill + a single sample), so the difference over new-1 tokens
+    # is the pure per-token decode cost — the HBM-bandwidth figure.
+    per_call, reps = time_call(new)
+    prefill_call, _ = time_call(1)
+    decode_ms = (per_call - prefill_call) / max(new - 1, 1) * 1e3
     return {
         "decode_batch": batch,
         "decode_prompt_len": prompt_len,
         "decode_new_tokens": new,
-        "decode_tokens_per_sec": round(batch * new / per_call, 1),
-        "decode_ms_per_token": round(per_call / new * 1e3, 3),
+        # whole-call generation throughput (prefill amortized over the call)
+        "decode_gen_tokens_per_sec": round(batch * new / per_call, 1),
+        "decode_call_ms": round(per_call * 1e3, 2),
+        "decode_prefill_ms": round(prefill_call * 1e3, 2),
+        # decode-only rate: prefill subtracted via the N=1 baseline
+        "decode_ms_per_token": round(max(decode_ms, 0.0), 3),
+        "decode_tokens_per_sec": round(
+            batch * (new - 1) / max(per_call - prefill_call, 1e-9), 1
+        ) if new > 1 else None,
         "decode_calls_timed": reps,
     }
 

@@ -9,3 +9,9 @@ with a deterministic synthetic fallback for hermetic (zero-egress) runs.
 
 from tfde_tpu.data.pipeline import Dataset, AutoShardPolicy  # noqa: F401
 from tfde_tpu.data.device import device_prefetch  # noqa: F401
+from tfde_tpu.data.tfrecord import (  # noqa: F401
+    TFRecordWriter,
+    read_tfrecord,
+    tfrecord_dataset,
+    write_tfrecord,
+)

@@ -1,0 +1,140 @@
+"""TFRecord file IO — the reference ecosystem's on-disk record format.
+
+The reference's data layer rides tf.data, whose serialized-example format is
+TFRecord: `<len u64le><masked-crc32c(len) u32le><data><masked-crc32c(data)>`
+per record. The observability layer already hand-encodes this framing for
+TensorBoard event files (observability/tensorboard.py — event files ARE
+TFRecord files of Event protos); this module is the general reader/writer
+over the same 13 lines of wire format, so datasets serialized by any
+TensorFlow pipeline can feed this framework and vice versa.
+
+Host-side by design (SURVEY.md §2b "tf.data C++ engine"): record IO is
+sequential byte work for the host; parsed numpy batches go to the device
+through the normal `data/pipeline.Dataset` path. All paths route through
+`utils/fs`, so `gs://`/`memory://` URLs work like local files.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Callable, Iterable, Iterator, Optional, Sequence, Union
+
+from tfde_tpu.observability.tensorboard import _masked_crc, _tfrecord
+from tfde_tpu.utils import fs
+
+
+class TFRecordWriter:
+    """Write length-prefixed, crc-framed records to one file.
+
+    Buffers in memory and writes on flush/close — object stores (the
+    remote-working-dir contract, utils/fs) have no append, so the whole
+    object is (re)written, same trade as the remote event writer.
+    """
+
+    def __init__(self, path: str):
+        self._path = path
+        self._buf = io.BytesIO()
+        self._closed = False
+
+    def write(self, record: bytes) -> None:
+        if self._closed:
+            raise ValueError(f"writer for {self._path} is closed")
+        if not isinstance(record, (bytes, bytearray, memoryview)):
+            # bytes(10) would silently write ten NUL bytes with valid CRCs
+            raise TypeError(
+                f"record must be bytes-like, got {type(record).__name__}"
+            )
+        self._buf.write(_tfrecord(bytes(record)))
+
+    def flush(self) -> None:
+        with fs.fs_open(self._path, "wb") as f:
+            f.write(self._buf.getvalue())
+
+    def close(self) -> None:
+        if not self._closed:
+            self.flush()
+            self._closed = True
+
+    def __enter__(self) -> "TFRecordWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def write_tfrecord(path: str, records: Iterable[bytes]) -> int:
+    """Write all `records` to `path`; returns the record count."""
+    n = 0
+    with TFRecordWriter(path) as w:
+        for r in records:
+            w.write(r)
+            n += 1
+    return n
+
+
+def read_tfrecord(
+    path: str, verify_crc: bool = True
+) -> Iterator[bytes]:
+    """Yield each record's payload bytes from a TFRecord file, streaming —
+    peak memory is O(record), not O(file).
+
+    `verify_crc=True` (default) checks both the length and data CRCs and
+    raises ValueError on corruption — truncated tails and bit flips fail
+    loudly with the byte offset, never yield garbage.
+    """
+    with fs.fs_open(path, "rb") as f:
+        off = 0
+        while True:
+            framing = f.read(12)
+            if not framing:
+                return  # clean EOF on a record boundary
+            if len(framing) < 12:
+                raise ValueError(
+                    f"{path}: truncated record header at byte {off} "
+                    f"({len(framing)} trailing bytes)"
+                )
+            header = framing[:8]
+            (length,) = struct.unpack("<Q", header)
+            (len_crc,) = struct.unpack("<I", framing[8:])
+            if verify_crc and _masked_crc(header) != len_crc:
+                raise ValueError(f"{path}: length crc mismatch at byte {off}")
+            body = f.read(length + 4)
+            if len(body) < length + 4:
+                raise ValueError(
+                    f"{path}: truncated record body at byte {off} "
+                    f"(need {length + 4} bytes, have {len(body)})"
+                )
+            data = body[:length]
+            (data_crc,) = struct.unpack("<I", body[length:])
+            if verify_crc and _masked_crc(data) != data_crc:
+                raise ValueError(f"{path}: data crc mismatch at byte {off}")
+            yield data
+            off += 12 + length + 4
+
+
+def tfrecord_dataset(
+    paths: Union[str, Sequence[str]],
+    parse_fn: Optional[Callable[[bytes], object]] = None,
+):
+    """data/pipeline.Dataset over the records of one or more TFRecord files
+    (files read in order, records in file order — apply `.shuffle()` on top,
+    the tf.data convention). `parse_fn` maps payload bytes to the element
+    (e.g. a numpy tuple); identity when None.
+
+    Lazy like every pipeline node: files are opened and parsed per
+    iteration, so a multi-GB corpus never materializes in host RAM and a
+    consumer that takes two batches pays for two batches."""
+    from tfde_tpu.data.pipeline import Dataset
+
+    if isinstance(paths, str):
+        paths = [paths]
+    paths = list(paths)
+
+    def it(epoch=0):
+        for p in paths:
+            for rec in read_tfrecord(p):
+                el = parse_fn(rec) if parse_fn is not None else rec
+                yield el if isinstance(el, tuple) else (el,)
+
+    return Dataset(it, None)
