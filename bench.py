@@ -669,8 +669,8 @@ def _bench_decode(clock: _Clock, smoke: bool) -> dict:
     # is the pure per-token decode cost — the HBM-bandwidth figure.
     per_call, reps = time_call(new)
     prefill_call, _ = time_call(1)
-    decode_ms = (per_call - prefill_call) / max(new - 1, 1) * 1e3
-    return {
+    delta = per_call - prefill_call
+    out = {
         "decode_batch": batch,
         "decode_prompt_len": prompt_len,
         "decode_new_tokens": new,
@@ -678,13 +678,20 @@ def _bench_decode(clock: _Clock, smoke: bool) -> dict:
         "decode_gen_tokens_per_sec": round(batch * new / per_call, 1),
         "decode_call_ms": round(per_call * 1e3, 2),
         "decode_prefill_ms": round(prefill_call * 1e3, 2),
-        # decode-only rate: prefill subtracted via the N=1 baseline
-        "decode_ms_per_token": round(max(decode_ms, 0.0), 3),
-        "decode_tokens_per_sec": round(
-            batch * (new - 1) / max(per_call - prefill_call, 1e-9), 1
-        ) if new > 1 else None,
         "decode_calls_timed": reps,
     }
+    # decode-only rate: prefill subtracted via the N=1 baseline. A delta
+    # within noise of zero is an invalid measurement — report it as such,
+    # never a clamped absurdity (the trust rule every config follows).
+    if new > 1 and delta > 0.05 * per_call:
+        out["decode_ms_per_token"] = round(delta / (new - 1) * 1e3, 3)
+        out["decode_tokens_per_sec"] = round(batch * (new - 1) / delta, 1)
+    else:
+        out["decode_error"] = (
+            "prefill baseline >= full call within noise; decode-only rate "
+            "unmeasurable at this config"
+        )
+    return out
 
 
 def run_mode() -> None:

@@ -189,3 +189,69 @@ def test_generate_rejects_zero_new_tokens(tiny_lm):
     with pytest.raises(ValueError, match="max_new_tokens"):
         generate(model, params, jnp.zeros((1, 4), jnp.int32),
                  max_new_tokens=0)
+
+
+def test_ragged_matches_solo_rows(tiny_lm, rng):
+    """The ragged-batch oracle: each row of a right-padded variable-length
+    batch must generate exactly what a solo generate() on the unpadded row
+    produces (teacher-forcing through the prompt tail keeps the cache
+    padding-free, so the math per row is identical)."""
+    from tfde_tpu.inference.decode import generate_ragged
+
+    model, params = tiny_lm
+    lengths = [3, 7, 5]
+    p_max, n_new = max(lengths), 6
+    prompt = np.zeros((3, p_max), np.int32)
+    rows = [rng.integers(0, 97, (l,)).astype(np.int32) for l in lengths]
+    for i, r in enumerate(rows):
+        prompt[i, : len(r)] = r
+    out, out_lengths = generate_ragged(
+        model, params, jnp.asarray(prompt), lengths, max_new_tokens=n_new
+    )
+    out = np.asarray(out)
+    np.testing.assert_array_equal(np.asarray(out_lengths),
+                                  [l + n_new for l in lengths])
+    for i, (r, l) in enumerate(zip(rows, lengths)):
+        solo, _ = generate(model, params, jnp.asarray(r[None]),
+                           max_new_tokens=n_new)
+        np.testing.assert_array_equal(out[i, : l + n_new],
+                                      np.asarray(solo)[0])
+        assert (out[i, l + n_new:] == 0).all()  # pad beyond the row's end
+
+
+def test_ragged_eos_per_row(tiny_lm, rng):
+    """EOS stops one row's generation without touching the others."""
+    from tfde_tpu.inference.decode import generate_ragged
+
+    model, params = tiny_lm
+    lengths = [4, 6]
+    prompt = np.zeros((2, 6), np.int32)
+    rows = [rng.integers(0, 97, (l,)).astype(np.int32) for l in lengths]
+    for i, r in enumerate(rows):
+        prompt[i, : len(r)] = r
+    free, _ = generate_ragged(model, params, jnp.asarray(prompt), lengths,
+                              max_new_tokens=5)
+    eos = int(np.asarray(free)[0, 4])  # row 0's first generated token
+    out, out_lengths = generate_ragged(
+        model, params, jnp.asarray(prompt), lengths, max_new_tokens=5,
+        eos_id=eos, pad_id=0,
+    )
+    out = np.asarray(out)
+    assert int(out_lengths[0]) == 5  # prompt 4 + the EOS token
+    assert (out[0, 5:] == 0).all()
+    # row 1 runs its full budget unless it also sampled the eos token
+    assert int(out_lengths[1]) >= 7
+
+
+def test_ragged_validates_inputs(tiny_lm):
+    from tfde_tpu.inference.decode import generate_ragged
+
+    model, params = tiny_lm
+    prompt = jnp.zeros((2, 6), jnp.int32)
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        generate_ragged(model, params, prompt, [3], max_new_tokens=2)
+    with pytest.raises(ValueError, match=r"\[1, 6\]"):
+        generate_ragged(model, params, prompt, [3, 9], max_new_tokens=2)
+    with pytest.raises(ValueError, match="prefill_len"):
+        generate_ragged(model, params, prompt, [3, 5], max_new_tokens=2,
+                        prefill_len=4)

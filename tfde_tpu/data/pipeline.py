@@ -35,7 +35,7 @@ import dataclasses
 import enum
 import queue
 import threading
-from typing import Any, Callable, Iterator, Optional, Sequence, Tuple
+from typing import Any, Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -96,18 +96,6 @@ class Dataset:
                 yield tuple(a[i] for a in arrays)
 
         return Dataset(it, n, fast=_FastPath(lambda: arrays, n))
-
-    @staticmethod
-    def from_sequence(elements: Sequence[Any]) -> "Dataset":
-        """Source over a materialized python sequence (e.g. parsed TFRecord
-        payloads, data/tfrecord.tfrecord_dataset). Non-tuple elements wrap
-        as 1-tuples so map/batch compose."""
-        elems = [e if isinstance(e, tuple) else (e,) for e in elements]
-
-        def it(epoch=0):
-            yield from elems
-
-        return Dataset(it, len(elems))
 
     # -- transformations -----------------------------------------------------
     def map(self, fn: Callable[..., Any]) -> "Dataset":

@@ -3,6 +3,12 @@ LMs (decode.generate), the capability the reference's SavedModel export
 story implies for servable models (SURVEY.md §2a #12)."""
 
 from tfde_tpu.inference.beam import beam_search
-from tfde_tpu.inference.decode import generate, init_cache, sample_logits
+from tfde_tpu.inference.decode import (
+    generate,
+    generate_ragged,
+    init_cache,
+    sample_logits,
+)
 
-__all__ = ["beam_search", "generate", "init_cache", "sample_logits"]
+__all__ = ["beam_search", "generate", "generate_ragged", "init_cache",
+           "sample_logits"]

@@ -209,3 +209,145 @@ def generate(
         seen_before = jnp.cumsum(is_eos, axis=1) - is_eos
         lengths = p + jnp.sum((seen_before == 0).astype(jnp.int32), axis=1)
     return tokens, lengths
+
+
+def generate_ragged(
+    model,
+    params,
+    prompt: jax.Array,
+    prompt_lengths,
+    max_new_tokens: int,
+    rng: Optional[jax.Array] = None,
+    prefill_len: Optional[int] = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+):
+    """`generate` for a batch of prompts with DIFFERENT lengths.
+
+    `prompt` is [B, Pmax] RIGHT-padded; `prompt_lengths` [B] gives each
+    row's real length. Returns (tokens [B, Pmax + max_new_tokens],
+    lengths [B]) with row r's continuation starting at slot
+    `prompt_lengths[r]`. The batch is decoded by *teacher-forcing through
+    the prompt tail*: prefill covers the shortest `prefill_len` slots
+    (default: min(prompt_lengths)), then every further slot is one decode
+    step whose input is the row's own prompt token while the row is still
+    inside its prompt and the sampled continuation after. The cache
+    therefore never contains padding — positions and attention per row are
+    identical to the solo run, with no per-row masks on the attention hot
+    path. Under greedy decoding (temperature=0, the default) each row's
+    output is EXACTLY what a solo `generate` on the unpadded row produces;
+    with temperature>0 the per-token distributions match but the sampled
+    draws differ (rows share one rng split per slot, and a row's k-th
+    generated token lands on a different split than the solo run's k-th).
+
+    Trade: the prompt tail beyond `prefill_len` is consumed one token per
+    step instead of in one prefill forward. Bucket wildly-varying lengths
+    upstream if that tail dominates.
+    """
+    import numpy as np
+
+    lengths_np = np.asarray(prompt_lengths, np.int32)
+    b, p_max = prompt.shape
+    if lengths_np.shape != (b,):
+        raise ValueError(
+            f"prompt_lengths must be [batch]={b}, got {lengths_np.shape}"
+        )
+    if lengths_np.min() < 1 or lengths_np.max() > p_max:
+        raise ValueError(
+            f"prompt_lengths must lie in [1, {p_max}], got "
+            f"[{lengths_np.min()}, {lengths_np.max()}]"
+        )
+    if prefill_len is None:
+        prefill_len = int(lengths_np.min())
+    if not 1 <= prefill_len <= lengths_np.min():
+        raise ValueError(
+            f"prefill_len={prefill_len} must lie in [1, min(prompt_lengths)="
+            f"{lengths_np.min()}] — prefilling past a row's prompt would "
+            f"feed its padding into the cache"
+        )
+    if rng is None:
+        rng = jax.random.key(0)
+    return _generate_ragged(
+        model, params, prompt.astype(jnp.int32), jnp.asarray(lengths_np),
+        max_new_tokens, rng, prefill_len, temperature, top_k, top_p,
+        eos_id, pad_id,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "prefill_len", "temperature",
+                     "top_k", "top_p", "eos_id", "pad_id"),
+)
+def _generate_ragged(model, params, prompt, prompt_lengths, max_new_tokens,
+                     rng, prefill_len, temperature, top_k, top_p, eos_id,
+                     pad_id):
+    b, p_max = prompt.shape
+    total = validate_budget(model, p_max, max_new_tokens)
+    decode_model = _decode_clone(model)
+    cache = init_cache(model, b, total)
+    sample = functools.partial(sample_logits, temperature=temperature,
+                               top_k=top_k, top_p=top_p)
+
+    def model_step(cache, tokens):
+        logits, mutated = decode_model.apply(
+            {"params": params, "cache": cache}, tokens, train=False,
+            mutable=["cache"],
+        )
+        return mutated["cache"], logits[:, -1].astype(jnp.float32)
+
+    # seq holds the final assembly; prompt slots are already right, the
+    # rest starts as pad and is written slot by slot
+    seq = jnp.concatenate(
+        [
+            jnp.where(
+                jnp.arange(p_max)[None, :] < prompt_lengths[:, None],
+                prompt, pad_id,
+            ),
+            jnp.full((b, max_new_tokens), pad_id, jnp.int32),
+        ],
+        axis=1,
+    )
+    cache, logits = model_step(cache, prompt[:, :prefill_len])
+
+    def fill_slot(t, logits, rng, gen_count, done, seq):
+        """Sample slot t's token (prompt token while inside the prompt,
+        sampled continuation after) and write it into seq."""
+        rng, sub = jax.random.split(rng)
+        sampled = sample(logits, sub)
+        in_prompt = t < prompt_lengths  # [B]
+        can_gen = (~in_prompt) & (~done) & (gen_count < max_new_tokens)
+        prompt_tok = jax.lax.dynamic_slice_in_dim(seq, t, 1, axis=1)[:, 0]
+        tok = jnp.where(in_prompt, prompt_tok,
+                        jnp.where(can_gen, sampled, pad_id)).astype(jnp.int32)
+        gen_count = gen_count + can_gen.astype(jnp.int32)
+        if eos_id is not None:
+            done = done | (can_gen & (sampled == eos_id))
+        seq = jax.lax.dynamic_update_slice_in_dim(
+            seq, tok[:, None], t, axis=1
+        )
+        return tok, rng, gen_count, done, seq
+
+    def body(carry, t):
+        cache, logits, rng, gen_count, done, seq = carry
+        tok, rng, gen_count, done, seq = fill_slot(
+            t, logits, rng, gen_count, done, seq
+        )
+        cache, logits = model_step(cache, tok[:, None])
+        return (cache, logits, rng, gen_count, done, seq), None
+
+    gen_count = jnp.zeros((b,), jnp.int32)
+    done = jnp.zeros((b,), jnp.bool_)
+    # scan stops one slot early: the final slot needs no model_step (its
+    # logits would feed nothing — one whole decode forward saved per call)
+    (_, logits, rng, gen_count, done, seq), _ = jax.lax.scan(
+        body, (cache, logits, rng, gen_count, done, seq),
+        jnp.arange(prefill_len, total - 1),
+    )
+    _, _, gen_count, _, seq = fill_slot(
+        jnp.asarray(total - 1, jnp.int32), logits, rng, gen_count, done, seq
+    )
+    return seq, prompt_lengths + gen_count
