@@ -36,6 +36,22 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def _make_model_step(decode_model, params):
+    """One decode forward: (cache, [B, S] tokens) -> (cache', last-position
+    fp32 logits). Shared by generate / generate_ragged (and closed over by
+    beam_search's log-prob variant)."""
+
+    def model_step(cache, tokens):
+        logits, mutated = decode_model.apply(
+            {"params": params, "cache": cache}, tokens, train=False,
+            mutable=["cache"],
+        )
+        return mutated["cache"], logits[:, -1].astype(jnp.float32)
+
+    return model_step
 
 
 def _decode_clone(model):
@@ -163,14 +179,7 @@ def generate(
     decode_model = _decode_clone(model)
     cache = init_cache(model, b, total)
     prompt = prompt.astype(jnp.int32)
-
-    def model_step(cache, tokens):
-        logits, mutated = decode_model.apply(
-            {"params": params, "cache": cache}, tokens, train=False,
-            mutable=["cache"],
-        )
-        return mutated["cache"], logits[:, -1].astype(jnp.float32)
-
+    model_step = _make_model_step(decode_model, params)
     sample = functools.partial(sample_logits, temperature=temperature,
                                top_k=top_k, top_p=top_p)
 
@@ -247,8 +256,6 @@ def generate_ragged(
     step instead of in one prefill forward. Bucket wildly-varying lengths
     upstream if that tail dominates.
     """
-    import numpy as np
-
     lengths_np = np.asarray(prompt_lengths, np.int32)
     b, p_max = prompt.shape
     if lengths_np.shape != (b,):
@@ -291,13 +298,7 @@ def _generate_ragged(model, params, prompt, prompt_lengths, max_new_tokens,
     cache = init_cache(model, b, total)
     sample = functools.partial(sample_logits, temperature=temperature,
                                top_k=top_k, top_p=top_p)
-
-    def model_step(cache, tokens):
-        logits, mutated = decode_model.apply(
-            {"params": params, "cache": cache}, tokens, train=False,
-            mutable=["cache"],
-        )
-        return mutated["cache"], logits[:, -1].astype(jnp.float32)
+    model_step = _make_model_step(decode_model, params)
 
     # seq holds the final assembly; prompt slots are already right, the
     # rest starts as pad and is written slot by slot

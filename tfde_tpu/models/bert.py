@@ -36,6 +36,7 @@ class BertEmbeddings(nn.Module):
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.bfloat16
+    ln_eps: float = 1e-6
 
     def setup(self):
         self.word = nn.Embed(
@@ -50,7 +51,8 @@ class BertEmbeddings(nn.Module):
             self.type_vocab_size, self.hidden_size, dtype=self.dtype,
             param_dtype=jnp.float32, name="token_type",
         )
-        self.ln = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32)
+        self.ln = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
+                               param_dtype=jnp.float32)
         self.dropout = nn.Dropout(self.dropout_rate)
 
     def __call__(
@@ -84,6 +86,7 @@ class Bert(nn.Module):
     attn_impl: str = "auto"
     remat: Any = False  # False | True/'full' | 'dots' (transformer.remat_policy)
     pad_vocab: bool = False
+    ln_eps: float = 1e-6  # BERT checkpoints use 1e-12 (models/convert.py)
 
     @property
     def padded_vocab(self) -> int:
@@ -108,6 +111,7 @@ class Bert(nn.Module):
             type_vocab_size=self.type_vocab_size,
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
+            ln_eps=self.ln_eps,
             name="embeddings",
         )
         x = emb(input_ids, token_type_ids, train=train)
@@ -124,6 +128,7 @@ class Bert(nn.Module):
             dropout_rate=self.dropout_rate,
             attn_impl=self.attn_impl,
             norm_style="post",
+            ln_eps=self.ln_eps,
             remat=self.remat,
             name="encoder",
         )(x, mask=mask, train=train)
@@ -135,7 +140,8 @@ class Bert(nn.Module):
         )(x)
         h = nn.gelu(h)
         h = nn.LayerNorm(
-            dtype=jnp.float32, param_dtype=jnp.float32, name="mlm_ln"
+            epsilon=self.ln_eps, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="mlm_ln",
         )(h)
         logits = emb.word.attend(h.astype(self.dtype))
         bias = self.param(

@@ -1,0 +1,215 @@
+"""Pretrained-checkpoint conversion: HuggingFace transformers -> this
+framework's param trees.
+
+The migration story for users arriving with trained models: GPT-2 and BERT
+checkpoints in the `transformers` torch format load directly into
+models/gpt.GPT and models/bert.Bert, verified by logit matching
+(tests/test_convert.py builds tiny HF models and asserts our forward
+reproduces theirs). Conversion is pure reshaping on host numpy:
+
+- GPT-2 stores fused-projection Conv1D weights as [in, out] — no
+  transpose; the [H, 3H] c_attn splits into q/k/v and reshapes to the
+  Megatron-friendly [in, heads, head_dim] kernels our DenseGeneral uses.
+- BERT uses torch.nn.Linear ([out, in]) — transposed, then reshaped the
+  same way.
+- LM heads are weight-tied in both (our `Embed.attend` convention), so no
+  separate head tensor exists or is needed; BERT's prediction bias maps to
+  `mlm_bias`.
+
+Known approximation: our Mlp uses the tanh-approximate gelu (flax
+default), which IS GPT-2's `gelu_new` exactly, but differs from BERT's
+exact `gelu` by ~1e-3 in activations — far below bf16 noise on TPU, and
+the logit-match test bounds it.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy().astype(np.float32)
+
+
+def gpt2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers GPT2LMHeadModel (or GPT2Model).
+
+    `dtype` overrides the activation dtype (default: the model family's
+    bf16; pass jnp.float32 for exact-match verification on CPU)."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    heads = cfg.n_head
+    hidden = cfg.n_embd
+    hd = hidden // heads
+    mlp_dim = cfg.n_inner if cfg.n_inner is not None else 4 * hidden
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.n_layer,
+        num_heads=heads,
+        mlp_dim=mlp_dim,
+        max_position=cfg.n_positions,
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        ln_eps=cfg.layer_norm_epsilon,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+
+    params = {
+        "wte": {"embedding": sd[f"{pre}wte.weight"]},
+        "wpe": {"embedding": sd[f"{pre}wpe.weight"]},
+        "decoder": {
+            "ln_final": {
+                "scale": sd[f"{pre}ln_f.weight"],
+                "bias": sd[f"{pre}ln_f.bias"],
+            },
+        },
+    }
+    for i in range(cfg.n_layer):
+        h = f"{pre}h.{i}."
+        # Conv1D weight layout is [in, out] already
+        c_attn_w = sd[h + "attn.c_attn.weight"]  # [H, 3H]
+        c_attn_b = sd[h + "attn.c_attn.bias"]    # [3H]
+        qw, kw, vw = np.split(c_attn_w, 3, axis=1)
+        qb, kb, vb = np.split(c_attn_b, 3)
+        params["decoder"][f"block_{i}"] = {
+            "ln_attn": {"scale": sd[h + "ln_1.weight"],
+                        "bias": sd[h + "ln_1.bias"]},
+            "ln_mlp": {"scale": sd[h + "ln_2.weight"],
+                       "bias": sd[h + "ln_2.bias"]},
+            "attn": {
+                "query": {"kernel": qw.reshape(hidden, heads, hd),
+                          "bias": qb.reshape(heads, hd)},
+                "key": {"kernel": kw.reshape(hidden, heads, hd),
+                        "bias": kb.reshape(heads, hd)},
+                "value": {"kernel": vw.reshape(hidden, heads, hd),
+                          "bias": vb.reshape(heads, hd)},
+                "out": {
+                    "kernel": sd[h + "attn.c_proj.weight"].reshape(
+                        heads, hd, hidden
+                    ),
+                    "bias": sd[h + "attn.c_proj.bias"],
+                },
+            },
+            "mlp": {
+                "fc1": {"kernel": sd[h + "mlp.c_fc.weight"],
+                        "bias": sd[h + "mlp.c_fc.bias"]},
+                "fc2": {"kernel": sd[h + "mlp.c_proj.weight"],
+                        "bias": sd[h + "mlp.c_proj.bias"]},
+            },
+        }
+    return model, params
+
+
+def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(Bert, params) from a transformers BertForMaskedLM (or BertModel —
+    then the MLM head params initialize to the identity transform)."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.bert import Bert
+
+    cfg = hf_model.config
+    heads = cfg.num_attention_heads
+    hidden = cfg.hidden_size
+    hd = hidden // heads
+    model = Bert(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.num_hidden_layers,
+        num_heads=heads,
+        mlp_dim=cfg.intermediate_size,
+        max_position=cfg.max_position_embeddings,
+        dropout_rate=0.0,
+        pad_vocab=False,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        ln_eps=cfg.layer_norm_eps,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+
+    def lin_kernel(name, shape):
+        # torch.nn.Linear stores [out, in]; our kernels are in-major
+        return sd[name].T.reshape(shape)
+
+    params = {
+        "embeddings": {
+            "word": {"embedding": sd[f"{pre}embeddings.word_embeddings.weight"]},
+            "position": {
+                "embedding": sd[f"{pre}embeddings.position_embeddings.weight"]
+            },
+            "token_type": {
+                "embedding": sd[f"{pre}embeddings.token_type_embeddings.weight"]
+            },
+            "ln": {"scale": sd[f"{pre}embeddings.LayerNorm.weight"],
+                   "bias": sd[f"{pre}embeddings.LayerNorm.bias"]},
+        },
+        "encoder": {},
+    }
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}encoder.layer.{i}."
+        params["encoder"][f"block_{i}"] = {
+            "attn": {
+                "query": {
+                    "kernel": lin_kernel(h + "attention.self.query.weight",
+                                         (hidden, heads, hd)),
+                    "bias": sd[h + "attention.self.query.bias"].reshape(
+                        heads, hd),
+                },
+                "key": {
+                    "kernel": lin_kernel(h + "attention.self.key.weight",
+                                         (hidden, heads, hd)),
+                    "bias": sd[h + "attention.self.key.bias"].reshape(
+                        heads, hd),
+                },
+                "value": {
+                    "kernel": lin_kernel(h + "attention.self.value.weight",
+                                         (hidden, heads, hd)),
+                    "bias": sd[h + "attention.self.value.bias"].reshape(
+                        heads, hd),
+                },
+                "out": {
+                    "kernel": lin_kernel(h + "attention.output.dense.weight",
+                                         (heads, hd, hidden)),
+                    "bias": sd[h + "attention.output.dense.bias"],
+                },
+            },
+            "ln_attn": {
+                "scale": sd[h + "attention.output.LayerNorm.weight"],
+                "bias": sd[h + "attention.output.LayerNorm.bias"],
+            },
+            "mlp": {
+                "fc1": {"kernel": lin_kernel(h + "intermediate.dense.weight",
+                                             (hidden, cfg.intermediate_size)),
+                        "bias": sd[h + "intermediate.dense.bias"]},
+                "fc2": {"kernel": lin_kernel(h + "output.dense.weight",
+                                             (cfg.intermediate_size, hidden)),
+                        "bias": sd[h + "output.dense.bias"]},
+            },
+            "ln_mlp": {"scale": sd[h + "output.LayerNorm.weight"],
+                       "bias": sd[h + "output.LayerNorm.bias"]},
+        }
+    if "cls.predictions.transform.dense.weight" in sd:
+        params["mlm_dense"] = {
+            "kernel": sd["cls.predictions.transform.dense.weight"].T,
+            "bias": sd["cls.predictions.transform.dense.bias"],
+        }
+        params["mlm_ln"] = {
+            "scale": sd["cls.predictions.transform.LayerNorm.weight"],
+            "bias": sd["cls.predictions.transform.LayerNorm.bias"],
+        }
+        params["mlm_bias"] = sd["cls.predictions.bias"]
+    else:
+        # bare BertModel: identity transform + zero bias keeps the MLM head
+        # well-defined (logits = embeddings . hidden)
+        params["mlm_dense"] = {"kernel": np.eye(hidden, dtype=np.float32),
+                               "bias": np.zeros(hidden, np.float32)}
+        params["mlm_ln"] = {"scale": np.ones(hidden, np.float32),
+                            "bias": np.zeros(hidden, np.float32)}
+        params["mlm_bias"] = np.zeros(cfg.vocab_size, np.float32)
+    return model, params

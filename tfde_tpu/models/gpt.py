@@ -44,6 +44,7 @@ class GPT(nn.Module):
     # autoregressive serving mode (inference/decode.py): KV caches in the
     # "cache" collection; positions continue from the cached prefix
     decode: bool = False
+    ln_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5 (models/convert.py)
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, train: bool = False) -> jax.Array:
@@ -84,6 +85,7 @@ class GPT(nn.Module):
             attn_impl=self.attn_impl,
             causal=True,
             decode=self.decode,
+            ln_eps=self.ln_eps,
             remat=self.remat,
             num_experts=self.num_experts,
             moe_every=self.moe_every,

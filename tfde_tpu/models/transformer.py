@@ -187,6 +187,7 @@ class TransformerBlock(nn.Module):
     causal: bool = False
     decode: bool = False
     norm_style: str = "pre"  # 'pre' | 'post'
+    ln_eps: float = 1e-6  # checkpoint fidelity: GPT-2 1e-5, BERT 1e-12
     num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
     experts_per_token: int = 2
 
@@ -198,7 +199,8 @@ class TransformerBlock(nn.Module):
         train: bool = False,
     ) -> jax.Array:
         ln = functools.partial(
-            nn.LayerNorm, dtype=jnp.float32, param_dtype=jnp.float32
+            nn.LayerNorm, epsilon=self.ln_eps, dtype=jnp.float32,
+            param_dtype=jnp.float32,
         )
         attn = MultiHeadAttention(
             num_heads=self.num_heads,
@@ -273,6 +275,7 @@ class Encoder(nn.Module):
     causal: bool = False
     decode: bool = False
     norm_style: str = "pre"
+    ln_eps: float = 1e-6
     remat: Any = False
     num_experts: int = 0   # > 0: MoE MLP in every `moe_every`-th block
     experts_per_token: int = 2
@@ -313,6 +316,7 @@ class Encoder(nn.Module):
                 causal=self.causal,
                 decode=self.decode,
                 norm_style=self.norm_style,
+                ln_eps=self.ln_eps,
                 num_experts=self.num_experts if is_moe else 0,
                 experts_per_token=self.experts_per_token,
                 name=f"block_{i}",
@@ -321,5 +325,6 @@ class Encoder(nn.Module):
         if self.norm_style == "post":
             return x  # post-LN blocks already end normalized
         return nn.LayerNorm(
-            dtype=jnp.float32, param_dtype=jnp.float32, name="ln_final"
+            epsilon=self.ln_eps, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="ln_final",
         )(x)
