@@ -92,6 +92,9 @@ def main(argv=None):
                         help="with --generate: also export the whole decode "
                              "loop as a StableHLO serving artifact "
                              "(export/generative.py) under DIR")
+    parser.add_argument("--rope", action="store_true",
+                        help="rotary position embeddings instead of the "
+                             "learned GPT-2 table (ops/rotary.py)")
     parser.add_argument("--tiny", action="store_true")
     parser.add_argument("--remat", nargs="?", const="full", default=False,
                         choices=["full", "dots"])
@@ -105,6 +108,11 @@ def main(argv=None):
     info = bootstrap()
     global_batch = args.batch_size * max(info.num_processes, 1)
 
+    if args.rope and args.pipeline > 1:
+        raise ValueError(
+            "--rope applies to the GPT decoder; PipelinedLM keeps its "
+            "learned positions (drop --pipeline to use rotary)"
+        )
     if args.pipeline > 1 and args.seq_parallel > 1:
         raise ValueError("--pipeline and --seq-parallel don't compose yet")
     if args.moe > 1 and (args.pipeline > 1 or args.seq_parallel > 1):
@@ -160,6 +168,8 @@ def main(argv=None):
             )
     else:
         moe = {"num_experts": args.moe} if args.moe > 1 else {}
+        if args.rope:
+            moe["position"] = "rope"
         model = (
             gpt_tiny_test(remat=args.remat, **moe) if args.tiny
             else GPT2Small(remat=args.remat, **moe)

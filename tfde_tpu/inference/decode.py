@@ -71,12 +71,18 @@ def _decode_clone(model):
 
 def validate_budget(model, prompt_len: int, max_new_tokens: int) -> int:
     """Shared generate/beam_search argument check; returns the total cache
-    budget prompt_len + max_new_tokens."""
+    budget prompt_len + max_new_tokens.
+
+    The max_position cap applies only to learned-position models (their wpe
+    table physically ends there); rotary models have no table and may
+    extrapolate past their training length — the cache budget is then
+    bounded only by memory."""
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     total = prompt_len + max_new_tokens
     max_pos = getattr(model, "max_position", None)
-    if max_pos is not None and total > max_pos:
+    if (max_pos is not None and total > max_pos
+            and getattr(model, "position", "learned") != "rope"):
         raise ValueError(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) = "
             f"{total} exceeds the model's max_position {max_pos}"

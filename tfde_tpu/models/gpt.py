@@ -45,6 +45,11 @@ class GPT(nn.Module):
     # "cache" collection; positions continue from the cached prefix
     decode: bool = False
     ln_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5 (models/convert.py)
+    # 'learned' = GPT-2 absolute wpe table; 'rope' = rotary q/k rotation
+    # (ops/rotary.py) — no position table, relative-position attention,
+    # better length extrapolation
+    position: str = "learned"
+    rope_theta: float = 10_000.0
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, train: bool = False) -> jax.Array:
@@ -54,24 +59,32 @@ class GPT(nn.Module):
             self.vocab_size, self.hidden_size, dtype=self.dtype,
             param_dtype=jnp.float32, name="wte",
         )
+        if self.position not in ("learned", "rope"):
+            raise ValueError(
+                f"position must be 'learned' or 'rope', got {self.position!r}"
+            )
+        use_wpe = self.position == "learned"
         wpe = nn.Embed(
             self.max_position, self.hidden_size, dtype=self.dtype,
             param_dtype=jnp.float32, name="wpe",
-        )
-        positions = jnp.arange(seq, dtype=jnp.int32)
-        if self.decode:
-            # position offset rides the cache like the K/V do: a decode step
-            # at cache position t embeds wpe[t], matching the full-sequence
-            # forward exactly. Check BEFORE self.variable creates it: a call
-            # with no pre-existing cache is position 0 and must not advance
-            # (the attention layers' fresh cache_index stays 0 the same way).
-            is_filled = self.has_variable("cache", "position_index")
-            pos_index = self.variable("cache", "position_index",
-                                      lambda: jnp.zeros((), jnp.int32))
-            if is_filled and not self.is_initializing():
-                positions = pos_index.value + positions
-                pos_index.value = pos_index.value + seq
-        x = wte(input_ids) + wpe(positions[None, :])
+        ) if use_wpe else None
+        x = wte(input_ids)
+        if use_wpe:
+            positions = jnp.arange(seq, dtype=jnp.int32)
+            if self.decode:
+                # position offset rides the cache like the K/V do: a decode
+                # step at cache position t embeds wpe[t], matching the full-
+                # sequence forward exactly. Check BEFORE self.variable
+                # creates it: a call with no pre-existing cache is position 0
+                # and must not advance (the attention layers' fresh
+                # cache_index stays 0 the same way).
+                is_filled = self.has_variable("cache", "position_index")
+                pos_index = self.variable("cache", "position_index",
+                                          lambda: jnp.zeros((), jnp.int32))
+                if is_filled and not self.is_initializing():
+                    positions = pos_index.value + positions
+                    pos_index.value = pos_index.value + seq
+            x = x + wpe(positions[None, :])
         x = constrain(x, b, "seq")
         if self.dropout_rate > 0.0:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
@@ -85,6 +98,8 @@ class GPT(nn.Module):
             attn_impl=self.attn_impl,
             causal=True,
             decode=self.decode,
+            rope=self.position == "rope",
+            rope_theta=self.rope_theta,
             ln_eps=self.ln_eps,
             remat=self.remat,
             num_experts=self.num_experts,

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from tfde_tpu.ops import attention as attn_lib
+from tfde_tpu.ops.rotary import apply_rotary
 from tfde_tpu.parallel.axes import batch_axes, constrain
 
 
@@ -49,6 +50,8 @@ class MultiHeadAttention(nn.Module):
     attn_impl: str = "auto"
     causal: bool = False
     decode: bool = False
+    rope: bool = False  # rotary q/k rotation (ops/rotary.py) inside the layer
+    rope_theta: float = 10_000.0
 
     @nn.compact
     def __call__(
@@ -67,6 +70,8 @@ class MultiHeadAttention(nn.Module):
         q = proj(name="query")(x)
         k = proj(name="key")(x)
         v = proj(name="value")(x)
+        if self.rope and not self.decode:
+            q, k = self._rotate(q, k, jnp.zeros((), jnp.int32))
         # [B, S, H, D]: heads carry the tensor-parallel shard.
         q, k, v = (constrain(t, b, "seq", "tensor") for t in (q, k, v))
         if self.decode:
@@ -98,6 +103,17 @@ class MultiHeadAttention(nn.Module):
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return y
 
+    def _rotate(self, q, k, start):
+        """Rotary q/k rotation at absolute positions start + [0, S) — the
+        ONE rotation site for the train forward and both decode paths. A
+        cached key's rotation is fixed at write time, so each call rotates
+        only its own tokens."""
+        if not self.rope:
+            return q, k
+        pos = start + jnp.arange(q.shape[1], dtype=jnp.int32)
+        return (apply_rotary(q, pos, self.rope_theta),
+                apply_rotary(k, pos, self.rope_theta))
+
     def _decode_attention(self, q, k, v, batch) -> jax.Array:
         """Write this call's K/V into the cache, attend q over the filled
         prefix. The validity mask `j <= index + i` covers prefill (full
@@ -118,9 +134,11 @@ class MultiHeadAttention(nn.Module):
                                      v.shape, v.dtype)
         cache_index = self.variable("cache", "cache_index",
                                     lambda: jnp.zeros((), jnp.int32))
+
         if not is_filled:
             # init pass: variables were just created from this call's shapes
             # (the [B, max_len] budget input) — plain causal attention.
+            q, k = self._rotate(q, k, jnp.zeros((), jnp.int32))
             return attn_lib.attention(q, k, v, causal=True, impl="reference")
         sq = q.shape[1]
         max_len = cached_key.value.shape[1]
@@ -130,6 +148,7 @@ class MultiHeadAttention(nn.Module):
                 f"re-init the cache with a larger max_len"
             )
         idx = cache_index.value
+        q, k = self._rotate(q, k, idx)
         k_all = jax.lax.dynamic_update_slice(
             cached_key.value, k.astype(cached_key.value.dtype), (0, idx, 0, 0)
         )
@@ -186,6 +205,8 @@ class TransformerBlock(nn.Module):
     attn_impl: str = "auto"
     causal: bool = False
     decode: bool = False
+    rope: bool = False
+    rope_theta: float = 10_000.0
     norm_style: str = "pre"  # 'pre' | 'post'
     ln_eps: float = 1e-6  # checkpoint fidelity: GPT-2 1e-5, BERT 1e-12
     num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
@@ -210,6 +231,8 @@ class TransformerBlock(nn.Module):
             attn_impl=self.attn_impl,
             causal=self.causal,
             decode=self.decode,
+            rope=self.rope,
+            rope_theta=self.rope_theta,
             name="attn",
         )
         if self.num_experts > 0:
@@ -274,6 +297,8 @@ class Encoder(nn.Module):
     attn_impl: str = "auto"
     causal: bool = False
     decode: bool = False
+    rope: bool = False
+    rope_theta: float = 10_000.0
     norm_style: str = "pre"
     ln_eps: float = 1e-6
     remat: Any = False
@@ -315,6 +340,8 @@ class Encoder(nn.Module):
                 attn_impl=self.attn_impl,
                 causal=self.causal,
                 decode=self.decode,
+                rope=self.rope,
+                rope_theta=self.rope_theta,
                 norm_style=self.norm_style,
                 ln_eps=self.ln_eps,
                 num_experts=self.num_experts if is_moe else 0,

@@ -1,0 +1,52 @@
+"""Rotary position embeddings (RoFormer/RoPE) — the positional scheme of
+the modern decoder families (LLaMA/GPT-NeoX lineage).
+
+Instead of adding learned absolute positions to the embedding stream
+(models/gpt.py `wpe`), RoPE rotates each (even, odd) feature pair of the
+query/key heads by an angle proportional to the token's absolute position;
+the q.k dot product then depends only on RELATIVE position — better length
+extrapolation, no learned position table, and a natural fit for the KV
+cache (a cached key's rotation never changes, so decode steps rotate only
+the new token; models/transformer.py passes the cache offset as
+`positions`).
+
+TPU shape notes: operates on [B, S, H, D] with D even, as two half-feature
+blocks (the GPT-NeoX/LLaMA "rotate_half" convention — contiguous halves
+vectorize on the VPU; the interleaved original is a permutation of the
+same math). Everything is elementwise over S, so XLA partitions it
+transparently under any mesh, including the 'seq' ring."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rotary_angles(positions: jax.Array, dim: int,
+                  theta: float = 10_000.0) -> tuple:
+    """(cos, sin) [..., dim/2] for integer `positions` [...]."""
+    if dim % 2:
+        raise ValueError(f"rotary head_dim must be even, got {dim}")
+    freqs = theta ** (
+        -jnp.arange(0, dim, 2, dtype=jnp.float32) / dim
+    )  # [dim/2]
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # [..., dim/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rotary(x: jax.Array, positions: jax.Array,
+                 theta: float = 10_000.0) -> jax.Array:
+    """Rotate [B, S, H, D] by per-token angles; `positions` is [S] or
+    [B, S] absolute token positions. fp32 trig, result in x.dtype."""
+    d = x.shape[-1]
+    cos, sin = rotary_angles(positions, d, theta)  # [..., S, d/2]
+    # broadcast to [B, S, 1, d/2] over heads
+    if cos.ndim == 2:  # [S, d/2] -> [1, S, 1, d/2]
+        cos, sin = cos[None, :, None], sin[None, :, None]
+    else:  # [B, S, d/2] -> [B, S, 1, d/2]
+        cos, sin = cos[:, :, None], sin[:, :, None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
