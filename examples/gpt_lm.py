@@ -92,6 +92,10 @@ def main(argv=None):
                         help="with --generate: also export the whole decode "
                              "loop as a StableHLO serving artifact "
                              "(export/generative.py) under DIR")
+    parser.add_argument("--kv-heads", type=int, default=0,
+                        help="grouped-query attention: KV heads per layer "
+                             "(0 = classic MHA); the KV cache shrinks by "
+                             "heads/kv-heads")
     parser.add_argument("--rope", action="store_true",
                         help="rotary position embeddings instead of the "
                              "learned GPT-2 table (ops/rotary.py)")
@@ -112,6 +116,11 @@ def main(argv=None):
         raise ValueError(
             "--rope applies to the GPT decoder; PipelinedLM keeps its "
             "learned positions (drop --pipeline to use rotary)"
+        )
+    if args.kv_heads > 0 and args.pipeline > 1:
+        raise ValueError(
+            "--kv-heads applies to the GPT decoder; PipelinedLM keeps "
+            "classic MHA (drop --pipeline to use GQA)"
         )
     if args.pipeline > 1 and args.seq_parallel > 1:
         raise ValueError("--pipeline and --seq-parallel don't compose yet")
@@ -167,12 +176,14 @@ def main(argv=None):
                 remat=args.remat,
             )
     else:
-        moe = {"num_experts": args.moe} if args.moe > 1 else {}
+        model_kw = {"num_experts": args.moe} if args.moe > 1 else {}
         if args.rope:
-            moe["position"] = "rope"
+            model_kw["position"] = "rope"
+        if args.kv_heads > 0:
+            model_kw["num_kv_heads"] = args.kv_heads
         model = (
-            gpt_tiny_test(remat=args.remat, **moe) if args.tiny
-            else GPT2Small(remat=args.remat, **moe)
+            gpt_tiny_test(remat=args.remat, **model_kw) if args.tiny
+            else GPT2Small(remat=args.remat, **model_kw)
         )
     if args.seq_len % max(args.seq_parallel, 1) != 0:
         raise ValueError("--seq-len must divide evenly by --seq-parallel")

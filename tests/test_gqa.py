@@ -1,0 +1,119 @@
+"""Grouped-query attention (models/transformer.py num_kv_heads): cache
+shrinkage, decode-oracle equivalence, degenerate-case equality, training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.inference.decode import generate, init_cache
+from tfde_tpu.models.gpt import GPT
+from tfde_tpu.models.transformer import MultiHeadAttention
+
+
+def _gqa_lm(kv_heads, **kw):
+    return GPT(vocab_size=83, hidden_size=32, depth=2, num_heads=4,
+               mlp_dim=64, max_position=64, dtype=jnp.float32,
+               num_kv_heads=kv_heads, **kw)
+
+
+def test_kv_param_and_cache_shrink(rng):
+    """KV projections and the decode cache carry kv_heads, not num_heads —
+    the memory/bandwidth saving that motivates GQA."""
+    m = _gqa_lm(1)
+    params = m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    attn = params["decoder"]["block_0"]["attn"]
+    assert attn["query"]["kernel"].shape == (32, 4, 8)
+    assert attn["key"]["kernel"].shape == (32, 1, 8)
+    assert attn["value"]["kernel"].shape == (32, 1, 8)
+    cache = init_cache(m, 2, 16)
+    ck = cache["decoder"]["block_0"]["attn"]["cached_key"]
+    assert ck.shape == (2, 16, 1, 8)
+
+
+def test_mqa_decode_matches_full_forward(rng):
+    """Multi-query (kv=1) cached generation must equal the uncached
+    full-forward rollout — the expansion happens identically either way."""
+    m = _gqa_lm(1)
+    params = m.init(jax.random.key(1), jnp.zeros((2, 8), jnp.int32))["params"]
+    prompt = jnp.asarray(rng.integers(0, 83, (2, 5)), jnp.int32)
+    out, _ = generate(m, params, prompt, max_new_tokens=7)
+    toks = np.asarray(prompt, np.int32)
+    for _ in range(7):
+        logits = m.apply({"params": params}, jnp.asarray(toks))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1), np.int32)
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), toks)
+
+
+def test_gqa2_rope_decode_matches_full_forward(rng):
+    """GQA composes with RoPE through the cache (rotation applies to the
+    kv_heads-shaped keys before the write)."""
+    m = _gqa_lm(2, position="rope")
+    params = m.init(jax.random.key(2), jnp.zeros((2, 8), jnp.int32))["params"]
+    prompt = jnp.asarray(rng.integers(0, 83, (1, 4)), jnp.int32)
+    out, _ = generate(m, params, prompt, max_new_tokens=6)
+    toks = np.asarray(prompt, np.int32)
+    for _ in range(6):
+        logits = m.apply({"params": params}, jnp.asarray(toks))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1), np.int32)
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), toks)
+
+
+def test_full_kv_heads_equals_mha(rng):
+    """num_kv_heads == num_heads is exactly classic MHA (same params, same
+    math) — the degenerate-case identity."""
+    x = jnp.asarray(rng.standard_normal((2, 6, 32)), jnp.float32)
+    mha = MultiHeadAttention(num_heads=4, head_dim=8, dtype=jnp.float32,
+                             causal=True)
+    gqa = MultiHeadAttention(num_heads=4, head_dim=8, dtype=jnp.float32,
+                             causal=True, num_kv_heads=4)
+    params = mha.init(jax.random.key(0), x)["params"]
+    np.testing.assert_allclose(
+        np.asarray(mha.apply({"params": params}, x)),
+        np.asarray(gqa.apply({"params": params}, x)),
+        atol=0,
+    )
+
+
+def test_gqa_heads_share_kv(rng):
+    """With kv=1 every query head attends the same K/V: perturbing the one
+    KV head changes all query heads' outputs."""
+    x = jnp.asarray(rng.standard_normal((1, 4, 32)), jnp.float32)
+    m = MultiHeadAttention(num_heads=4, head_dim=8, dtype=jnp.float32,
+                           causal=True, num_kv_heads=1)
+    params = m.init(jax.random.key(0), x)["params"]
+    base = np.asarray(m.apply({"params": params}, x))
+    import flax
+
+    p2 = flax.core.unfreeze(jax.tree_util.tree_map(lambda a: a, params))
+    p2["value"]["kernel"] = params["value"]["kernel"] + 1.0
+    out = np.asarray(m.apply({"params": p2}, x))
+    assert not np.allclose(base, out)
+
+
+def test_gqa_trains(rng):
+    import optax
+
+    from tfde_tpu.models.gpt import next_token_loss
+    from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
+    from tfde_tpu.training.step import init_state, make_custom_train_step
+
+    strategy = MultiWorkerMirroredStrategy()
+    m = _gqa_lm(2)
+    tokens = rng.integers(0, 83, (16, 16)).astype(np.int32)
+    state, _ = init_state(m, optax.adamw(3e-3), strategy,
+                          np.zeros((16, 16), np.int32))
+    step = make_custom_train_step(strategy, state, next_token_loss,
+                                  donate=False)
+    state, m0 = step(state, (tokens,), jax.random.key(0))
+    for _ in range(8):
+        state, met = step(state, (tokens,), jax.random.key(0))
+    assert float(met["loss"]) < float(m0["loss"])
+
+
+def test_invalid_kv_heads_rejected():
+    m = _gqa_lm(3)  # 3 does not divide 4
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))

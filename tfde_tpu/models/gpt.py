@@ -50,6 +50,9 @@ class GPT(nn.Module):
     # better length extrapolation
     position: str = "learned"
     rope_theta: float = 10_000.0
+    # grouped-query attention: KV heads per layer (None = num_heads); the
+    # KV cache shrinks by num_heads/num_kv_heads — the serving memory knob
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, train: bool = False) -> jax.Array:
@@ -63,13 +66,12 @@ class GPT(nn.Module):
             raise ValueError(
                 f"position must be 'learned' or 'rope', got {self.position!r}"
             )
-        use_wpe = self.position == "learned"
-        wpe = nn.Embed(
-            self.max_position, self.hidden_size, dtype=self.dtype,
-            param_dtype=jnp.float32, name="wpe",
-        ) if use_wpe else None
         x = wte(input_ids)
-        if use_wpe:
+        if self.position == "learned":
+            wpe = nn.Embed(
+                self.max_position, self.hidden_size, dtype=self.dtype,
+                param_dtype=jnp.float32, name="wpe",
+            )
             positions = jnp.arange(seq, dtype=jnp.int32)
             if self.decode:
                 # position offset rides the cache like the K/V do: a decode
@@ -100,6 +102,7 @@ class GPT(nn.Module):
             decode=self.decode,
             rope=self.position == "rope",
             rope_theta=self.rope_theta,
+            num_kv_heads=self.num_kv_heads,
             ln_eps=self.ln_eps,
             remat=self.remat,
             num_experts=self.num_experts,

@@ -52,6 +52,15 @@ class MultiHeadAttention(nn.Module):
     decode: bool = False
     rope: bool = False  # rotary q/k rotation (ops/rotary.py) inside the layer
     rope_theta: float = 10_000.0
+    # grouped-query attention: K/V carry this many heads (must divide
+    # num_heads); each KV head serves num_heads/num_kv_heads query heads.
+    # None = classic MHA. The KV cache and its decode bandwidth shrink by
+    # the same factor — the reason every modern serving stack uses GQA.
+    num_kv_heads: Optional[int] = None
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
 
     @nn.compact
     def __call__(
@@ -60,16 +69,20 @@ class MultiHeadAttention(nn.Module):
         mask: Optional[jax.Array] = None,
         train: bool = False,
     ) -> jax.Array:
+        if self.num_heads % self.kv_heads:
+            raise ValueError(
+                f"num_kv_heads={self.kv_heads} must divide "
+                f"num_heads={self.num_heads}"
+            )
         b = batch_axes()
         proj = functools.partial(
             nn.DenseGeneral,
-            features=(self.num_heads, self.head_dim),
             dtype=self.dtype,
             param_dtype=jnp.float32,
         )
-        q = proj(name="query")(x)
-        k = proj(name="key")(x)
-        v = proj(name="value")(x)
+        q = proj(features=(self.num_heads, self.head_dim), name="query")(x)
+        k = proj(features=(self.kv_heads, self.head_dim), name="key")(x)
+        v = proj(features=(self.kv_heads, self.head_dim), name="value")(x)
         if self.rope and not self.decode:
             q, k = self._rotate(q, k, jnp.zeros((), jnp.int32))
         # [B, S, H, D]: heads carry the tensor-parallel shard.
@@ -86,6 +99,12 @@ class MultiHeadAttention(nn.Module):
                     "generation is a causal-LM capability)"
                 )
             y = self._decode_attention(q, k, v, b)
+        elif self.kv_heads != self.num_heads:
+            # grouped einsum path: K/V stay kv_heads-shaped end to end.
+            # (flash/ring dispatch is MHA-only today; GQA long-context via
+            # those kernels would first expand K/V, forfeiting the saving)
+            y = attn_lib.grouped_attention(q, k, v, mask=mask,
+                                           causal=self.causal)
         else:
             y = attn_lib.attention(
                 q, k, v, mask=mask, causal=self.causal, impl=self.attn_impl
@@ -139,7 +158,7 @@ class MultiHeadAttention(nn.Module):
             # init pass: variables were just created from this call's shapes
             # (the [B, max_len] budget input) — plain causal attention.
             q, k = self._rotate(q, k, jnp.zeros((), jnp.int32))
-            return attn_lib.attention(q, k, v, causal=True, impl="reference")
+            return attn_lib.grouped_attention(q, k, v, causal=True)
         sq = q.shape[1]
         max_len = cached_key.value.shape[1]
         if sq > max_len:
@@ -162,9 +181,11 @@ class MultiHeadAttention(nn.Module):
         # [1, 1, Sq, max_len]: query (global position idx+i) sees kv j<=idx+i
         pos_q = idx + jnp.arange(sq, dtype=jnp.int32)
         valid = jnp.arange(max_len, dtype=jnp.int32)[None, :] <= pos_q[:, None]
-        return attn_lib.attention(
-            q, k_all, v_all, mask=valid[None, None], impl="reference"
-        )
+        # grouped_attention == reference_attention at kv_heads == num_heads;
+        # with GQA the kv_heads-shaped cache feeds the einsum directly (no
+        # expanded copy on the bandwidth-bound decode path)
+        return attn_lib.grouped_attention(q, k_all, v_all,
+                                          mask=valid[None, None])
 
 
 class Mlp(nn.Module):
@@ -207,6 +228,7 @@ class TransformerBlock(nn.Module):
     decode: bool = False
     rope: bool = False
     rope_theta: float = 10_000.0
+    num_kv_heads: Optional[int] = None  # GQA (MultiHeadAttention)
     norm_style: str = "pre"  # 'pre' | 'post'
     ln_eps: float = 1e-6  # checkpoint fidelity: GPT-2 1e-5, BERT 1e-12
     num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
@@ -233,6 +255,7 @@ class TransformerBlock(nn.Module):
             decode=self.decode,
             rope=self.rope,
             rope_theta=self.rope_theta,
+            num_kv_heads=self.num_kv_heads,
             name="attn",
         )
         if self.num_experts > 0:
@@ -299,6 +322,7 @@ class Encoder(nn.Module):
     decode: bool = False
     rope: bool = False
     rope_theta: float = 10_000.0
+    num_kv_heads: Optional[int] = None
     norm_style: str = "pre"
     ln_eps: float = 1e-6
     remat: Any = False
@@ -342,6 +366,7 @@ class Encoder(nn.Module):
                 decode=self.decode,
                 rope=self.rope,
                 rope_theta=self.rope_theta,
+                num_kv_heads=self.num_kv_heads,
                 norm_style=self.norm_style,
                 ln_eps=self.ln_eps,
                 num_experts=self.num_experts if is_moe else 0,

@@ -65,6 +65,60 @@ def reference_attention(
     return out.astype(q.dtype)
 
 
+def grouped_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Grouped-query attention: q [B,Sq,H,D] against k/v [B,Sk,Kv,D] with
+    H = Kv * groups — each KV head serves a contiguous group of query heads.
+
+    The einsums index the KV head directly (`bqkgd,bskd->bkgqs`), so the
+    [B,Sk,H,D] expansion a repeat-then-attend formulation would write/read
+    through HBM never exists — the point of GQA is exactly that bandwidth
+    saving, largest on the decode path where K/V is the whole cache.
+
+    mask: broadcastable to [B, H, Sq, Sk] (or with a size-1 head dim);
+    True = attend, matching reference_attention.
+    """
+    b, sq, h, d = q.shape
+    kv = k.shape[2]
+    if h % kv:
+        raise ValueError(f"query heads {h} must be a multiple of kv heads {kv}")
+    g = h // kv
+    sk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    qg = q.reshape(b, sq, kv, g, d)
+    logits = jnp.einsum(
+        "bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        mask = cm if mask is None else jnp.logical_and(mask, cm)
+    if mask is not None:
+        if mask.ndim == 2:  # [Sq, Sk]
+            mask = mask[None, None, None]
+        elif mask.ndim == 4:  # [B|1, H|1, Sq, Sk]
+            if mask.shape[1] == h:
+                mask = mask.reshape(mask.shape[0], kv, g, *mask.shape[2:])
+            else:
+                mask = mask[:, :, None]  # size-1 head dim broadcasts
+        else:
+            raise ValueError(
+                f"mask must be [Sq,Sk] or [B,H,Sq,Sk]-broadcastable, got "
+                f"ndim={mask.ndim}"
+            )
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bkgqs,bskd->bqkgd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
 def _seq_parallel_active() -> bool:
     mesh = axes_lib.current_mesh()
     return mesh is not None and "seq" in mesh.axis_names and mesh.shape["seq"] > 1
