@@ -645,18 +645,18 @@ def _bench_decode(clock: _Clock, smoke: bool) -> dict:
         rng.integers(0, model.vocab_size, (batch, prompt_len)), jnp.int32
     )
 
-    def make_run(n_new):
+    def make_run(mdl, prms, n_new):
         def run(reps):
             toks = None
             for i in range(reps):
-                toks, _ = generate(model, params, prompt, max_new_tokens=n_new,
+                toks, _ = generate(mdl, prms, prompt, max_new_tokens=n_new,
                                    rng=jax.random.key(i), temperature=1.0,
                                    top_k=40)
             return toks
         return run
 
-    def time_call(n_new):
-        run = make_run(n_new)
+    def time_call(mdl, prms, n_new):
+        run = make_run(mdl, prms, n_new)
         clock.fetch_scalar(run(1)[0, -1].astype(jnp.float32))  # compile+warm
         reps, window, _, _ = clock.timed(
             run, lambda t: t[0, -1].astype(jnp.float32),
@@ -667,8 +667,8 @@ def _bench_decode(clock: _Clock, smoke: bool) -> dict:
     # The full call includes the prompt prefill; an N=1 baseline isolates
     # it (prefill + a single sample), so the difference over new-1 tokens
     # is the pure per-token decode cost — the HBM-bandwidth figure.
-    per_call, reps = time_call(new)
-    prefill_call, _ = time_call(1)
+    per_call, reps = time_call(model, params, new)
+    prefill_call, _ = time_call(model, params, 1)
     delta = per_call - prefill_call
     out = {
         "decode_batch": batch,
@@ -691,6 +691,27 @@ def _bench_decode(clock: _Clock, smoke: bool) -> dict:
             "prefill baseline >= full call within noise; decode-only rate "
             "unmeasurable at this config"
         )
+
+    if not smoke:
+        # GQA twin (4 KV heads instead of 12): the serving memory/bandwidth
+        # knob — same dims, random init (throughput only, quality N/A).
+        # Own try/except: a failure here must not discard the classic
+        # decode numbers already measured above.
+        try:
+            gqa = GPT2Small(max_position=prompt_len + new, dropout_rate=0.0,
+                            num_kv_heads=4)
+            gparams = gqa.init(
+                jax.random.key(0),
+                jnp.zeros((batch, prompt_len + new), jnp.int32),
+            )["params"]
+            g_call, _ = time_call(gqa, gparams, new)
+            out["decode_gqa_kv_heads"] = 4
+            out["decode_gqa_gen_tokens_per_sec"] = round(
+                batch * new / g_call, 1
+            )
+            out["decode_gqa_speedup"] = round(per_call / g_call, 3)
+        except Exception as e:
+            out["decode_gqa_error"] = f"{type(e).__name__}: {e}"[:300]
     return out
 
 

@@ -117,3 +117,33 @@ def test_invalid_kv_heads_rejected():
     m = _gqa_lm(3)  # 3 does not divide 4
     with pytest.raises(ValueError, match="num_kv_heads"):
         m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    m = _gqa_lm(-4)  # 4 % -4 == 0 in Python; the sign check must catch it
+    with pytest.raises(ValueError, match="positive"):
+        m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def test_gqa_refuses_seq_parallel_ring(rng):
+    """GQA routes to the grouped einsum, which would materialize the
+    O(S^2) logits the 'seq' ring exists to avoid — refused loudly."""
+    import optax
+
+    from tfde_tpu.parallel.strategies import SequenceParallelStrategy
+    from tfde_tpu.training.step import init_state, make_custom_train_step
+
+    from tfde_tpu.models.gpt import next_token_loss
+
+    strategy = SequenceParallelStrategy(data=2)
+    m = _gqa_lm(2)
+    state, _ = init_state(m, optax.sgd(1e-2), strategy,
+                          np.zeros((4, 16), np.int32))
+    step = make_custom_train_step(strategy, state, next_token_loss,
+                                  donate=False)
+    tokens = rng.integers(0, 83, (4, 16)).astype(np.int32)
+    with pytest.raises(NotImplementedError, match="seq"):
+        step(state, (tokens,), jax.random.key(0))
+
+
+def test_gqa_refuses_explicit_flash():
+    m = _gqa_lm(2, attn_impl="flash")
+    with pytest.raises(NotImplementedError, match="attn_impl"):
+        m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))

@@ -69,9 +69,10 @@ class MultiHeadAttention(nn.Module):
         mask: Optional[jax.Array] = None,
         train: bool = False,
     ) -> jax.Array:
-        if self.num_heads % self.kv_heads:
+        if self.kv_heads <= 0 or self.num_heads % self.kv_heads:
+            # (12 % -4 == 0 in Python — the sign check is load-bearing)
             raise ValueError(
-                f"num_kv_heads={self.kv_heads} must divide "
+                f"num_kv_heads={self.kv_heads} must be positive and divide "
                 f"num_heads={self.num_heads}"
             )
         b = batch_axes()
@@ -101,8 +102,21 @@ class MultiHeadAttention(nn.Module):
             y = self._decode_attention(q, k, v, b)
         elif self.kv_heads != self.num_heads:
             # grouped einsum path: K/V stay kv_heads-shaped end to end.
-            # (flash/ring dispatch is MHA-only today; GQA long-context via
-            # those kernels would first expand K/V, forfeiting the saving)
+            # flash/ring dispatch is MHA-only today — refuse the combos
+            # loudly instead of silently falling off the O(S) memory path
+            if attn_lib._seq_parallel_active():
+                raise NotImplementedError(
+                    "GQA does not compose with the 'seq' ring yet: the "
+                    "grouped einsum would materialize the O(S^2) logits the "
+                    "seq axis exists to avoid — use num_kv_heads=None "
+                    "(classic MHA) under SequenceParallelStrategy"
+                )
+            if self.attn_impl not in ("auto", "reference"):
+                raise NotImplementedError(
+                    f"attn_impl={self.attn_impl!r} does not support GQA; "
+                    f"use 'auto'/'reference' (the grouped einsum) or "
+                    f"num_kv_heads=None"
+                )
             y = attn_lib.grouped_attention(q, k, v, mask=mask,
                                            causal=self.causal)
         else:

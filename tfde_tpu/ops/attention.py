@@ -44,25 +44,13 @@ def reference_attention(
 
     mask: broadcastable to [B, H, Sq, Sk]; True/1 = attend. Additive -inf
     masking in fp32 keeps bf16 inputs numerically safe.
+
+    The numerics oracle every other kernel is tested against. Internally
+    the degenerate (groups == 1) case of `grouped_attention` — ONE
+    scale/mask/fp32-softmax implementation, so the oracle and the GQA
+    decode path cannot drift.
     """
-    *_, sq, _, d = q.shape
-    sk = k.shape[1]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    # [B,Sq,H,D] x [B,Sk,H,D] -> [B,H,Sq,Sk]; accumulate in fp32.
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
-        mask = cm if mask is None else jnp.logical_and(mask, cm)
-    if mask is not None:
-        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-    weights = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum(
-        "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
-    return out.astype(q.dtype)
+    return grouped_attention(q, k, v, mask=mask, causal=causal)
 
 
 def grouped_attention(
