@@ -705,11 +705,23 @@ def _bench_decode(clock: _Clock, smoke: bool) -> dict:
                 jnp.zeros((batch, prompt_len + new), jnp.int32),
             )["params"]
             g_call, _ = time_call(gqa, gparams, new)
+            g_prefill, _ = time_call(gqa, gparams, 1)
+            g_delta = g_call - g_prefill
             out["decode_gqa_kv_heads"] = 4
             out["decode_gqa_gen_tokens_per_sec"] = round(
                 batch * new / g_call, 1
             )
-            out["decode_gqa_speedup"] = round(per_call / g_call, 3)
+            # decode-only vs decode-only: the full call is prefill-diluted,
+            # which would understate the KV-bandwidth effect this measures
+            if new > 1 and delta > 0.05 * per_call and g_delta > 0:
+                out["decode_gqa_tokens_per_sec"] = round(
+                    batch * (new - 1) / g_delta, 1
+                )
+                out["decode_gqa_speedup"] = round(delta / g_delta, 3)
+            else:
+                out["decode_gqa_error"] = (
+                    "decode-only delta unmeasurable for the GQA twin"
+                )
         except Exception as e:
             out["decode_gqa_error"] = f"{type(e).__name__}: {e}"[:300]
     return out

@@ -13,7 +13,11 @@ import pytest
 transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
 
-from tfde_tpu.models.convert import bert_from_hf, gpt2_from_hf  # noqa: E402
+from tfde_tpu.models.convert import (  # noqa: E402
+    bert_from_hf,
+    gpt2_from_hf,
+    llama_from_hf,
+)
 
 
 @pytest.fixture(scope="module")
@@ -77,12 +81,53 @@ def test_bert_logits_match(hf_bert, rng):
     np.testing.assert_allclose(ours, ref, rtol=5e-3, atol=5e-3)
 
 
-def test_param_trees_are_complete(hf_gpt2, hf_bert):
+@pytest.fixture(scope="module")
+def hf_llama():
+    cfg = transformers.LlamaConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, attention_dropout=0.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    m = transformers.LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_llama_logits_match(hf_llama, rng):
+    """LLaMA = RoPE + GQA + RMSNorm + SwiGLU + bias-free + untied head —
+    one converted forward checks all five against transformers."""
+    model, params = llama_from_hf(hf_llama, dtype=jnp.float32)
+    assert model.position == "rope" and model.num_kv_heads == 2
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_llama(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_converted_generates_like_hf(hf_llama, rng):
+    from tfde_tpu.inference.decode import generate
+
+    model, params = llama_from_hf(hf_llama, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_llama.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama):
     """Converted trees must match the models' own init structure exactly —
     a missing/extra leaf means a silently unconverted weight."""
     for hf, conv, sample in (
         (hf_gpt2, gpt2_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_bert, bert_from_hf, jnp.zeros((1, 8), jnp.int32)),
+        (hf_llama, llama_from_hf, jnp.zeros((1, 8), jnp.int32)),
     ):
         model, params = conv(hf, dtype=jnp.float32)
         ref = model.init(jax.random.key(0), sample)["params"]

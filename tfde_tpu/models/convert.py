@@ -107,6 +107,88 @@ def gpt2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def llama_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers LlamaForCausalLM — the LLaMA
+    family maps onto GPT(position='rope', num_kv_heads=..., norm='rms',
+    mlp_act='swiglu', use_bias=False): rotary rotate-half, grouped-query
+    K/V, RMSNorm (scale only), gated-silu MLP, bias-free projections, and
+    an untied lm_head unless the checkpoint ties it."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    if getattr(cfg, "rope_scaling", None):
+        raise NotImplementedError(
+            f"rope_scaling={cfg.rope_scaling!r} is not supported "
+            f"(Llama-3.x frequency scaling); converting would produce "
+            f"silently wrong logits — only plain rope_theta checkpoints "
+            f"convert today"
+        )
+    if getattr(cfg, "attention_bias", False) or getattr(cfg, "mlp_bias", False):
+        raise NotImplementedError(
+            "checkpoints with attention_bias/mlp_bias are not supported by "
+            "this converter (the bias tensors would be silently dropped)"
+        )
+    heads = cfg.num_attention_heads
+    hidden = cfg.hidden_size
+    hd = hidden // heads
+    kv = cfg.num_key_value_heads
+    tied = bool(getattr(cfg, "tie_word_embeddings", False))
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.num_hidden_layers,
+        num_heads=heads,
+        mlp_dim=cfg.intermediate_size,
+        max_position=cfg.max_position_embeddings,
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        position="rope",
+        rope_theta=float(cfg.rope_theta),
+        num_kv_heads=kv,
+        norm="rms",
+        mlp_act="swiglu",
+        use_bias=False,
+        tie_embeddings=tied,
+        ln_eps=cfg.rms_norm_eps,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    params = {
+        "wte": {"embedding": sd[f"{pre}embed_tokens.weight"]},
+        "decoder": {
+            "ln_final": {"scale": sd[f"{pre}norm.weight"]},
+        },
+    }
+    if not tied:
+        params["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}layers.{i}."
+        params["decoder"][f"block_{i}"] = {
+            "ln_attn": {"scale": sd[h + "input_layernorm.weight"]},
+            "ln_mlp": {"scale": sd[h + "post_attention_layernorm.weight"]},
+            "attn": {
+                # torch Linear [out, in] -> in-major kernels
+                "query": {"kernel": sd[h + "self_attn.q_proj.weight"].T
+                          .reshape(hidden, heads, hd)},
+                "key": {"kernel": sd[h + "self_attn.k_proj.weight"].T
+                        .reshape(hidden, kv, hd)},
+                "value": {"kernel": sd[h + "self_attn.v_proj.weight"].T
+                          .reshape(hidden, kv, hd)},
+                "out": {"kernel": sd[h + "self_attn.o_proj.weight"].T
+                        .reshape(heads, hd, hidden)},
+            },
+            "mlp": {
+                "gate": {"kernel": sd[h + "mlp.gate_proj.weight"].T},
+                "fc1": {"kernel": sd[h + "mlp.up_proj.weight"].T},
+                "fc2": {"kernel": sd[h + "mlp.down_proj.weight"].T},
+            },
+        }
+    return model, params
+
+
 def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(Bert, params) from a transformers BertForMaskedLM (or BertModel —
     then the MLM head params initialize to the identity transform)."""

@@ -53,6 +53,12 @@ class GPT(nn.Module):
     # grouped-query attention: KV heads per layer (None = num_heads); the
     # KV cache shrinks by num_heads/num_kv_heads — the serving memory knob
     num_kv_heads: Optional[int] = None
+    norm: str = "layer"      # 'layer' | 'rms' (LLaMA)
+    mlp_act: str = "gelu"    # 'gelu' | 'swiglu' (LLaMA)
+    use_bias: bool = True    # False: LLaMA bias-free projections
+    # True (GPT-2): LM head = wte^T via Embed.attend; False (LLaMA):
+    # separate bias-free lm_head Dense
+    tie_embeddings: bool = True
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, train: bool = False) -> jax.Array:
@@ -103,13 +109,22 @@ class GPT(nn.Module):
             rope=self.position == "rope",
             rope_theta=self.rope_theta,
             num_kv_heads=self.num_kv_heads,
+            norm=self.norm,
+            mlp_act=self.mlp_act,
+            use_bias=self.use_bias,
             ln_eps=self.ln_eps,
             remat=self.remat,
             num_experts=self.num_experts,
             moe_every=self.moe_every,
             name="decoder",
         )(x, train=train)
-        logits = wte.attend(x.astype(self.dtype)).astype(jnp.float32)
+        if self.tie_embeddings:
+            logits = wte.attend(x.astype(self.dtype)).astype(jnp.float32)
+        else:
+            logits = nn.Dense(
+                self.vocab_size, use_bias=False, dtype=self.dtype,
+                param_dtype=jnp.float32, name="lm_head",
+            )(x.astype(self.dtype)).astype(jnp.float32)
         return constrain(logits, b, "seq", "tensor")
 
 

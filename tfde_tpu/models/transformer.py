@@ -57,6 +57,7 @@ class MultiHeadAttention(nn.Module):
     # None = classic MHA. The KV cache and its decode bandwidth shrink by
     # the same factor — the reason every modern serving stack uses GQA.
     num_kv_heads: Optional[int] = None
+    use_bias: bool = True  # False: the LLaMA bias-free projections
 
     @property
     def kv_heads(self) -> int:
@@ -80,6 +81,7 @@ class MultiHeadAttention(nn.Module):
             nn.DenseGeneral,
             dtype=self.dtype,
             param_dtype=jnp.float32,
+            use_bias=self.use_bias,
         )
         q = proj(features=(self.num_heads, self.head_dim), name="query")(x)
         k = proj(features=(self.kv_heads, self.head_dim), name="key")(x)
@@ -129,6 +131,7 @@ class MultiHeadAttention(nn.Module):
             axis=(-2, -1),
             dtype=self.dtype,
             param_dtype=jnp.float32,
+            use_bias=self.use_bias,
             name="out",
         )(y)
         y = constrain(y, b, "seq")
@@ -203,23 +206,35 @@ class MultiHeadAttention(nn.Module):
 
 
 class Mlp(nn.Module):
-    """fc1 -> gelu -> fc2; hidden dim carries the tensor-parallel shard."""
+    """fc1 -> act -> fc2; hidden dim carries the tensor-parallel shard.
+
+    act='swiglu' (the LLaMA family): a parallel `gate` projection gates the
+    up-projection with silu — gate and fc1 are both column-sharded under
+    TP, so the elementwise product needs no extra collective."""
 
     mlp_dim: int
     dtype: jnp.dtype = jnp.bfloat16
     dropout_rate: float = 0.0
+    act: str = "gelu"  # 'gelu' (tanh approx, == GPT-2 gelu_new) | 'swiglu'
+    use_bias: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         b = batch_axes()
-        h = nn.Dense(
-            self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32, name="fc1"
-        )(x)
-        h = nn.gelu(h)
+        dense = functools.partial(
+            nn.Dense, dtype=self.dtype, param_dtype=jnp.float32,
+            use_bias=self.use_bias,
+        )
+        h = dense(self.mlp_dim, name="fc1")(x)
+        if self.act == "gelu":
+            h = nn.gelu(h)
+        elif self.act == "swiglu":
+            gate = dense(self.mlp_dim, name="gate")(x)
+            h = nn.silu(gate) * h
+        else:
+            raise ValueError(f"act must be 'gelu' or 'swiglu', got {self.act!r}")
         h = constrain(h, b, "seq", "tensor")
-        h = nn.Dense(
-            x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32, name="fc2"
-        )(h)
+        h = dense(x.shape[-1], name="fc2")(h)
         h = constrain(h, b, "seq")
         if self.dropout_rate > 0.0:
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
@@ -244,6 +259,9 @@ class TransformerBlock(nn.Module):
     rope_theta: float = 10_000.0
     num_kv_heads: Optional[int] = None  # GQA (MultiHeadAttention)
     norm_style: str = "pre"  # 'pre' | 'post'
+    norm: str = "layer"  # 'layer' | 'rms' (LLaMA: scale-only, no bias)
+    mlp_act: str = "gelu"  # Mlp.act
+    use_bias: bool = True
     ln_eps: float = 1e-6  # checkpoint fidelity: GPT-2 1e-5, BERT 1e-12
     num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
     experts_per_token: int = 2
@@ -255,9 +273,11 @@ class TransformerBlock(nn.Module):
         mask: Optional[jax.Array] = None,
         train: bool = False,
     ) -> jax.Array:
+        if self.norm not in ("layer", "rms"):
+            raise ValueError(f"norm must be 'layer' or 'rms', got {self.norm!r}")
         ln = functools.partial(
-            nn.LayerNorm, epsilon=self.ln_eps, dtype=jnp.float32,
-            param_dtype=jnp.float32,
+            nn.RMSNorm if self.norm == "rms" else nn.LayerNorm,
+            epsilon=self.ln_eps, dtype=jnp.float32, param_dtype=jnp.float32,
         )
         attn = MultiHeadAttention(
             num_heads=self.num_heads,
@@ -270,9 +290,16 @@ class TransformerBlock(nn.Module):
             rope=self.rope,
             rope_theta=self.rope_theta,
             num_kv_heads=self.num_kv_heads,
+            use_bias=self.use_bias,
             name="attn",
         )
         if self.num_experts > 0:
+            if self.mlp_act != "gelu" or not self.use_bias:
+                raise NotImplementedError(
+                    "MoE expert MLPs are gelu+bias today; num_experts > 0 "
+                    "with mlp_act/use_bias overrides would silently build a "
+                    "different architecture than requested"
+                )
             from tfde_tpu.models.moe import MoEMlp
 
             mlp = MoEMlp(
@@ -288,6 +315,8 @@ class TransformerBlock(nn.Module):
                 mlp_dim=self.mlp_dim,
                 dtype=self.dtype,
                 dropout_rate=self.dropout_rate,
+                act=self.mlp_act,
+                use_bias=self.use_bias,
                 name="mlp",
             )
         if self.norm_style == "pre":
@@ -338,6 +367,9 @@ class Encoder(nn.Module):
     rope_theta: float = 10_000.0
     num_kv_heads: Optional[int] = None
     norm_style: str = "pre"
+    norm: str = "layer"
+    mlp_act: str = "gelu"
+    use_bias: bool = True
     ln_eps: float = 1e-6
     remat: Any = False
     num_experts: int = 0   # > 0: MoE MLP in every `moe_every`-th block
@@ -382,6 +414,9 @@ class Encoder(nn.Module):
                 rope_theta=self.rope_theta,
                 num_kv_heads=self.num_kv_heads,
                 norm_style=self.norm_style,
+                norm=self.norm,
+                mlp_act=self.mlp_act,
+                use_bias=self.use_bias,
                 ln_eps=self.ln_eps,
                 num_experts=self.num_experts if is_moe else 0,
                 experts_per_token=self.experts_per_token,
@@ -390,7 +425,8 @@ class Encoder(nn.Module):
             x = body(block, x)
         if self.norm_style == "post":
             return x  # post-LN blocks already end normalized
-        return nn.LayerNorm(
+        norm_cls = nn.RMSNorm if self.norm == "rms" else nn.LayerNorm
+        return norm_cls(
             epsilon=self.ln_eps, dtype=jnp.float32, param_dtype=jnp.float32,
             name="ln_final",
         )(x)

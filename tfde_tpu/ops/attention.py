@@ -88,6 +88,8 @@ def grouped_attention(
     if mask is not None:
         if mask.ndim == 2:  # [Sq, Sk]
             mask = mask[None, None, None]
+        elif mask.ndim == 3:  # [B|1, Sq, Sk]
+            mask = mask[:, None, None]
         elif mask.ndim == 4:  # [B|1, H|1, Sq, Sk]
             if mask.shape[1] == h:
                 mask = mask.reshape(mask.shape[0], kv, g, *mask.shape[2:])
@@ -95,8 +97,8 @@ def grouped_attention(
                 mask = mask[:, :, None]  # size-1 head dim broadcasts
         else:
             raise ValueError(
-                f"mask must be [Sq,Sk] or [B,H,Sq,Sk]-broadcastable, got "
-                f"ndim={mask.ndim}"
+                f"mask must be broadcastable to [B,H,Sq,Sk] "
+                f"(ndim 2/3/4), got ndim={mask.ndim}"
             )
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     weights = jax.nn.softmax(logits, axis=-1)
