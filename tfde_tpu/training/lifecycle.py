@@ -38,6 +38,8 @@ from tfde_tpu.observability.tensorboard import SummaryWriter
 from tfde_tpu.parallel.strategies import Strategy, MultiWorkerMirroredStrategy
 from tfde_tpu.training.step import (
     init_state,
+    make_custom_eval_step,
+    make_custom_train_step,
     make_train_step,
     make_eval_step,
     pad_batch_for_mesh,
@@ -101,17 +103,34 @@ class Estimator:
         strategy: Optional[Strategy] = None,
         config: Optional[RunConfig] = None,
         eval_strategy: Optional[Strategy] = None,
+        loss_fn=None,
+        eval_fn=None,
+        grad_accum: int = 1,
     ):
         """eval_strategy: evaluate under a *different* strategy than training
         — the reference's `DistributeConfig(train_distribute=
         ParameterServerStrategy, eval_distribute=MirroredStrategy)`
         (mnist_keras_distributed.py:241-243). Defaults to the training
         strategy. At eval time the train state is device_put onto the eval
-        strategy's shardings and eval_step compiles on its mesh."""
+        strategy's shardings and eval_step compiles on its mesh.
+
+        loss_fn: a custom objective `(state, params, batch, rng) ->
+        (loss, metrics)` (training/step.py make_custom_train_step) — the
+        reference's hand-written model_fn path riding the FULL Estimator
+        lifecycle (checkpoints/resume, summaries, eval cadence) instead of
+        a hand-rolled loop. Token models (MLM, causal LM) go through here.
+        eval_fn: its eval twin `(state, params, batch) -> {metric:
+        per-batch mean}` (+ optional reserved "weight"); required for
+        evaluate()/train_and_evaluate() when loss_fn is set — eval must be
+        deterministic, which the rng-taking loss_fn cannot promise.
+        grad_accum: sequential microbatches per update (step.py)."""
         self.model = model
         self.tx = optimizer
         self.strategy = strategy or MultiWorkerMirroredStrategy()
         self.eval_strategy = eval_strategy
+        self.loss_fn = loss_fn
+        self.eval_fn = eval_fn
+        self.grad_accum = grad_accum
         self.config = config or RunConfig()
         self._state: Optional[TrainState] = None
         self._ckpt: Optional[CheckpointManager] = None
@@ -147,9 +166,11 @@ class Estimator:
 
     def _ensure_state(self, sample_batch) -> TrainState:
         if self._state is None:
-            sample = jnp.zeros(
-                np.asarray(sample_batch[0]).shape, np.asarray(sample_batch[0]).dtype
-            )
+            # the model's sample input is the FIRST LEAF of the batch pytree
+            # (tuple position 0; for dict batches, the first key in sorted
+            # order) — the init contract for custom batch structures
+            leaf = jax.tree_util.tree_leaves(sample_batch)[0]
+            sample = jnp.zeros(np.asarray(leaf).shape, np.asarray(leaf).dtype)
             self._state, _ = init_state(
                 self.model, self.tx, self.strategy, sample, seed=self.config.seed
             )
@@ -198,7 +219,15 @@ class Estimator:
             log.info("global step %d >= max_steps %d; nothing to do", start_step, max_steps)
             return state
         if self._train_step is None:
-            self._train_step = make_train_step(self.strategy, state)
+            if self.loss_fn is not None:
+                self._train_step = make_custom_train_step(
+                    self.strategy, state, self.loss_fn,
+                    grad_accum=self.grad_accum,
+                )
+            else:
+                self._train_step = make_train_step(
+                    self.strategy, state, grad_accum=self.grad_accum
+                )
 
         rng = jax.random.key(cfg.seed + 1)
         writer = self._writer()
@@ -268,13 +297,32 @@ class Estimator:
             from tfde_tpu.training.step import _state_shardings
 
             state = jax.device_put(state, _state_shardings(strat, state))
+        custom = self.loss_fn is not None or self.eval_fn is not None
+        if custom and self.eval_fn is None:
+            raise RuntimeError(
+                "evaluate() on a custom-loss Estimator needs eval_fn: the "
+                "training loss_fn takes an rng (dropout) and cannot promise "
+                "a deterministic eval — pass eval_fn=(state, params, batch) "
+                "-> {metric: batch mean}"
+            )
         if self._eval_step is None:
-            self._eval_step = make_eval_step(strat, state)
+            if custom:
+                self._eval_step = make_custom_eval_step(
+                    strat, state, self.eval_fn
+                )
+            else:
+                self._eval_step = make_eval_step(strat, state)
         totals = None
         n = 0
-        divisor = strat.batch_divisor
-        padded = (pad_batch_for_mesh(b, divisor) for b in input_fn())
-        feed = device_prefetch(padded, strat.mesh)
+        if custom:
+            # custom batches are arbitrary pytrees: no (images, labels)
+            # padding protocol — feed them as produced (drop_remainder
+            # batching upstream keeps shapes static)
+            feed = device_prefetch(iter(input_fn()), strat.mesh)
+        else:
+            divisor = strat.batch_divisor
+            padded = (pad_batch_for_mesh(b, divisor) for b in input_fn())
+            feed = device_prefetch(padded, strat.mesh)
         for batch in feed:
             if steps is not None and n >= steps:
                 break
@@ -283,13 +331,26 @@ class Estimator:
             totals = m if totals is None else jax.tree_util.tree_map(jnp.add, totals, m)
             n += 1
         if totals is None:
+            if custom:
+                log.warning("evaluate[%s]: input_fn produced no batches", name)
+                return {}
             return {"loss": float("nan"), "accuracy": float("nan")}
         totals = jax.device_get(totals)
-        weight = max(float(totals["weight"]), 1.0)
-        results = {
-            "loss": float(totals["loss_sum"]) / weight,
-            "accuracy": float(totals["correct_sum"]) / weight,
-        }
+        if custom:
+            # user weights are arbitrary positive reals — divide by the true
+            # sum (clamping would silently deflate fractional weights);
+            # weight <= 0 means nothing was measured
+            weight = float(totals["weight"])
+            results = {
+                k: (float(v) / weight if weight > 0 else float("nan"))
+                for k, v in totals.items() if k != "weight"
+            }
+        else:
+            weight = max(float(totals["weight"]), 1.0)
+            results = {
+                "loss": float(totals["loss_sum"]) / weight,
+                "accuracy": float(totals["correct_sum"]) / weight,
+            }
         step = int(jax.device_get(state.step))
         w = self._writer(name)
         if w is not None:
@@ -454,6 +515,13 @@ def train_and_evaluate(
       single-process only (a multi-process evaluator is a dedicated job
       running `continuous_eval`, like the reference's evaluator cluster).
     """
+    if estimator.loss_fn is not None and estimator.eval_fn is None:
+        # evaluate() would raise this hours in, after the training budget
+        # is spent — the promise of an eval makes the check an entry check
+        raise RuntimeError(
+            "train_and_evaluate on a custom-loss Estimator needs eval_fn "
+            "(the rng-taking loss_fn cannot promise a deterministic eval)"
+        )
     if eval_mode not in ("inline", "from_checkpoint"):
         raise ValueError(f"unknown eval_mode {eval_mode!r}")
     if eval_mode == "from_checkpoint":
@@ -508,6 +576,8 @@ def _train_with_continuous_eval(
         estimator.tx,
         strategy=estimator.eval_strategy or estimator.strategy,
         config=cfg,
+        loss_fn=estimator.loss_fn,
+        eval_fn=estimator.eval_fn,
     )
     stop = threading.Event()
     box: dict = {}

@@ -345,6 +345,49 @@ def make_custom_train_step(
     return run
 
 
+def make_custom_eval_step(
+    strategy: Strategy,
+    state: TrainState,
+    eval_fn: Callable[[TrainState, Any, Any], dict],
+):
+    """Compile a weighted-metrics eval step for a user metric fn — the eval
+    twin of make_custom_train_step (the Estimator's custom-objective path).
+
+    `eval_fn(state, params, batch) -> {metric: per-batch mean}`; an optional
+    reserved key ``"weight"`` carries the batch's aggregation weight (e.g.
+    the masked-position count for MLM metrics; defaults to the batch size).
+    The returned step emits weighted SUMS plus the weight, so the caller
+    accumulates on device and divides once after the pass — the same
+    one-fetch protocol as the classification eval_step."""
+    shardings = _state_shardings(strategy, state)
+    batch_sh = strategy.batch_sharding()
+
+    def step(state: TrainState, batch):
+        metrics = dict(eval_fn(state, state.params, batch))
+        weight = metrics.pop("weight", None)
+        if weight is None:
+            leaf = jax.tree_util.tree_leaves(batch)[0]
+            weight = jnp.asarray(float(leaf.shape[0]), jnp.float32)
+        weight = jnp.asarray(weight, jnp.float32)
+        out = {k: jnp.asarray(v, jnp.float32) * weight
+               for k, v in metrics.items()}
+        out["weight"] = weight
+        return out
+
+    jitted = jax.jit(
+        _with_mesh(step, strategy.mesh),
+        in_shardings=(shardings, None),
+    )
+
+    def run(state: TrainState, batch):
+        batch = jax.device_put(
+            batch, jax.tree_util.tree_map(lambda _: batch_sh, batch)
+        )
+        return jitted(state, batch)
+
+    return run
+
+
 def make_eval_step(strategy: Strategy, state: TrainState):
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
