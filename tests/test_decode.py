@@ -165,6 +165,22 @@ def test_generate_under_data_mesh(tiny_lm, rng):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_generate_under_tensor_mesh(tiny_lm, rng):
+    """Generation traced inside a dp x tp mesh: the decode path's cache
+    constraints carry the 'tensor' axis (heads sharded) and the result must
+    equal the meshless run."""
+    from tfde_tpu.parallel.axes import use_axes
+    from tfde_tpu.runtime.mesh import make_mesh
+
+    model, params = tiny_lm  # 4 heads: tensor=2 shards them
+    mesh = make_mesh({"data": 2, "tensor": 2}, jax.devices()[:4])
+    prompt = jnp.asarray(rng.integers(0, 97, (4, 4)), jnp.int32)
+    with use_axes(mesh):
+        out, _ = generate(model, params, prompt, max_new_tokens=4)
+    ref, _ = generate(model, params, prompt, max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_decode_refuses_remat():
     m = gpt_tiny_test(remat=True).clone(decode=True)
     with pytest.raises(ValueError, match="remat"):

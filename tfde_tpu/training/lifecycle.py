@@ -288,6 +288,16 @@ class Estimator:
         name: str = "eval",
     ) -> dict:
         """Weighted full-dataset metrics (EvalSpec steps=None semantics)."""
+        custom = self.loss_fn is not None or self.eval_fn is not None
+        if custom and self.eval_fn is None:
+            # decidable from configuration alone — fire before the batch
+            # draw / init / checkpoint restore below, not after
+            raise RuntimeError(
+                "evaluate() on a custom-loss Estimator needs eval_fn: the "
+                "training loss_fn takes an rng (dropout) and cannot promise "
+                "a deterministic eval — pass eval_fn=(state, params, batch) "
+                "-> {metric: batch mean}"
+            )
         state = self._state_for_inference(input_fn, "evaluate()")
         strat = self.eval_strategy or self.strategy
         if self.eval_strategy is not None:
@@ -297,14 +307,6 @@ class Estimator:
             from tfde_tpu.training.step import _state_shardings
 
             state = jax.device_put(state, _state_shardings(strat, state))
-        custom = self.loss_fn is not None or self.eval_fn is not None
-        if custom and self.eval_fn is None:
-            raise RuntimeError(
-                "evaluate() on a custom-loss Estimator needs eval_fn: the "
-                "training loss_fn takes an rng (dropout) and cannot promise "
-                "a deterministic eval — pass eval_fn=(state, params, batch) "
-                "-> {metric: batch mean}"
-            )
         if self._eval_step is None:
             if custom:
                 self._eval_step = make_custom_eval_step(
