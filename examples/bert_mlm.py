@@ -113,7 +113,9 @@ def main(argv=None):
         warmup_steps=min(args.warmup_steps, max(args.max_steps - 1, 1)),
         decay_steps=args.max_steps,
     )
-    tx = optax.adamw(schedule, weight_decay=0.01)
+    from tfde_tpu.training.optimizers import adamw as masked_adamw
+
+    tx = masked_adamw(schedule, weight_decay=0.01)
 
     strategy = MultiWorkerMirroredStrategy()
     sample = np.zeros((global_batch, args.seq_len), np.int32)

@@ -102,7 +102,9 @@ def main(argv=None):
         warmup_steps=min(args.warmup_steps, max(args.max_steps - 1, 1)),
         decay_steps=args.max_steps,
     )
-    tx = optax.adamw(schedule, weight_decay=args.weight_decay)
+    from tfde_tpu.training.optimizers import adamw as masked_adamw
+
+    tx = masked_adamw(schedule, weight_decay=args.weight_decay)
 
     num_classes = 10 if args.tiny else 1000
     if args.tiny:

@@ -1,0 +1,57 @@
+"""Weight-decay masking (training/optimizers.py): decay must touch kernels
+and embeddings only — never biases or norm scales."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tfde_tpu.models.gpt import gpt_tiny_test
+from tfde_tpu.training.optimizers import adamw, decay_mask
+
+
+def test_mask_excludes_biases_and_scales():
+    m = gpt_tiny_test()
+    params = m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    mask = decay_mask(params)
+    flat = {
+        jax.tree_util.keystr(p): v
+        for p, v in jax.tree_util.tree_flatten_with_path(mask)[0]
+    }
+    for path, decayed in flat.items():
+        if path.endswith("['bias']") or path.endswith("['scale']"):
+            assert not decayed, path
+        elif path.endswith("['kernel']") or path.endswith("['embedding']"):
+            assert decayed, path
+
+
+def test_masked_decay_leaves_biases_untouched_by_decay():
+    """With zero gradients, masked adamw must not move biases/scales at
+    all, while unmasked optax.adamw shrinks every leaf."""
+    m = gpt_tiny_test()
+    params = m.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def one_step(tx):
+        state = tx.init(params)
+        updates, _ = tx.update(zeros, state, params)
+        return optax.apply_updates(params, updates)
+
+    ours = one_step(adamw(1e-2, weight_decay=0.1))
+    plain = one_step(optax.adamw(1e-2, weight_decay=0.1))
+
+    ln = params["decoder"]["ln_final"]
+    np.testing.assert_array_equal(
+        np.asarray(ours["decoder"]["ln_final"]["scale"]),
+        np.asarray(ln["scale"]),
+    )
+    assert not np.allclose(
+        np.asarray(plain["decoder"]["ln_final"]["scale"]),
+        np.asarray(ln["scale"]),
+    )
+    # kernels still decay under the masked variant
+    k0 = params["decoder"]["block_0"]["mlp"]["fc1"]["kernel"]
+    assert not np.allclose(
+        np.asarray(ours["decoder"]["block_0"]["mlp"]["fc1"]["kernel"]),
+        np.asarray(k0),
+    )

@@ -1,0 +1,45 @@
+"""Optimizer helpers — the transformer-training conventions optax leaves
+to the user.
+
+`adamw(...)` here is optax.adamw with the standard decay mask: weight decay
+applies to matmul kernels and embeddings only — biases and normalization
+scales are excluded (the BERT/GPT-2 convention; decaying a LayerNorm scale
+toward zero fights the normalization itself). The mask is derived from the
+param tree: any leaf whose path ends in 'bias' or whose name is a norm
+scale ('scale') is excluded, plus any rank-<2 leaf as a conservative
+fallback (a rank-1 tensor in a transformer is a bias/scale/norm by
+construction; kernels and embeddings are rank >= 2)."""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+
+def decay_mask(params) -> object:
+    """Pytree of bools: True where weight decay applies."""
+
+    def keep(path, leaf) -> bool:
+        names = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+        if names and names[-1] in ("bias", "scale"):
+            return False
+        return jax.numpy.ndim(leaf) >= 2
+
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    leaves = [keep(p, l) for p, l in flat[0]]
+    return jax.tree_util.tree_unflatten(flat[1], leaves)
+
+
+def adamw(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 1e-4,
+) -> optax.GradientTransformation:
+    """optax.adamw with decay masked off biases/norm scales (see module
+    docstring). Drop-in for the examples' optax.adamw calls."""
+    return optax.adamw(
+        learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+        mask=decay_mask,
+    )
