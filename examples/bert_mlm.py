@@ -46,6 +46,7 @@ from tfde_tpu.observability.tensorboard import SummaryWriter
 from tfde_tpu.ops import losses
 from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
 from tfde_tpu.training.step import init_state, make_custom_train_step
+from tfde_tpu.training.optimizers import adamw as masked_adamw
 
 log = logging.getLogger(__name__)
 
@@ -113,8 +114,6 @@ def main(argv=None):
         warmup_steps=min(args.warmup_steps, max(args.max_steps - 1, 1)),
         decay_steps=args.max_steps,
     )
-    from tfde_tpu.training.optimizers import adamw as masked_adamw
-
     tx = masked_adamw(schedule, weight_decay=0.01)
 
     strategy = MultiWorkerMirroredStrategy()

@@ -52,6 +52,7 @@ from tfde_tpu.parallel.strategies import (
     SequenceParallelStrategy,
 )
 from tfde_tpu.training.step import init_state, make_custom_train_step
+from tfde_tpu.training.optimizers import adamw as masked_adamw
 
 log = logging.getLogger(__name__)
 
@@ -197,8 +198,6 @@ def main(argv=None):
         warmup_steps=min(args.warmup_steps, max(args.max_steps - 1, 1)),
         decay_steps=args.max_steps,
     )
-    from tfde_tpu.training.optimizers import adamw as masked_adamw
-
     tx = masked_adamw(schedule, weight_decay=0.1)
 
     if args.pipeline > 1:

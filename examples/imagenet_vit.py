@@ -34,6 +34,7 @@ from tfde_tpu.data.pipeline import AutoShardPolicy
 from tfde_tpu.models.vit import ViT_B16, vit_tiny_test
 from tfde_tpu.parallel.strategies import FSDPStrategy
 from tfde_tpu.training import Estimator, RunConfig
+from tfde_tpu.training.optimizers import adamw as masked_adamw
 
 
 def make_train_dataset(
@@ -102,8 +103,6 @@ def main(argv=None):
         warmup_steps=min(args.warmup_steps, max(args.max_steps - 1, 1)),
         decay_steps=args.max_steps,
     )
-    from tfde_tpu.training.optimizers import adamw as masked_adamw
-
     tx = masked_adamw(schedule, weight_decay=args.weight_decay)
 
     num_classes = 10 if args.tiny else 1000

@@ -87,7 +87,21 @@ class CheckpointManager:
             else x,
             self._tree(state),
         )
-        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        try:
+            restored = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        except ValueError as e:
+            if "structure" in str(e).lower() or "match" in str(e).lower():
+                raise ValueError(
+                    f"checkpoint step {step} in {self._dir} does not match "
+                    f"the current train state's structure — most commonly "
+                    f"the optimizer configuration changed since it was "
+                    f"written (e.g. a decay mask wraps the opt state). "
+                    f"Resume with the original optimizer, or clear the "
+                    f"checkpoint directory to restart"
+                ) from e
+            raise
         log.info("restored checkpoint step %d from %s", step, self._dir)
         return state.replace(
             step=restored["step"],
