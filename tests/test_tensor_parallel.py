@@ -127,3 +127,45 @@ def test_tp_zero1_composition_shards_opt_state_and_matches_dp():
         ),
         jax.device_get(state.params), jax.device_get(state_d.params),
     )
+
+
+def test_tp_matches_dp_numerics_llama_decoder():
+    """The LLaMA-config decoder (rope + GQA + RMSNorm + swiglu + bias-free)
+    under dp x tp must match pure DP exactly: the 'gate' projection shards
+    column-parallel like fc1 (same ffn shard, so the elementwise gating
+    needs no collective), and the GQA kv heads carry the 'tensor' shard."""
+    from tfde_tpu.models.gpt import GPT, next_token_loss
+    from tfde_tpu.training.step import make_custom_train_step
+
+    def train(strategy):
+        m = GPT(vocab_size=96, hidden_size=32, depth=2, num_heads=4,
+                mlp_dim=64, max_position=32, dtype=jnp.float32,
+                position="rope", num_kv_heads=2, norm="rms",
+                mlp_act="swiglu", use_bias=False, tie_embeddings=False)
+        state, _ = init_state(m, optax.sgd(0.05), strategy,
+                              np.zeros((16, 16), np.int32), seed=0)
+        step = make_custom_train_step(strategy, state, next_token_loss,
+                                      donate=False)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 96, (16, 16)).astype(np.int32)
+        key = jax.random.key(0)
+        for _ in range(3):
+            state, metrics = step(state, (toks,), key)
+        return jax.device_get(state.params), float(metrics["loss"])
+
+    p_dp, loss_dp = train(MultiWorkerMirroredStrategy())
+    # data=4 -> tensor=2: kv_heads=2 divides, so the GQA K/V kernels carry
+    # the 'tensor' shard (at tensor=4 they would silently replicate and the
+    # documented property would go untested)
+    strat_tp = TensorParallelStrategy(data=4)
+    specs = strat_tp.params_spec(p_dp)
+    blk = specs["decoder"]["block_0"]
+    assert blk["mlp"]["gate"]["kernel"] == P(None, "tensor")
+    assert blk["attn"]["key"]["kernel"] == P(None, "tensor", None)
+    assert blk["attn"]["value"]["kernel"] == P(None, "tensor", None)
+    p_tp, loss_tp = train(strat_tp)
+    np.testing.assert_allclose(loss_dp, loss_tp, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+        p_dp, p_tp,
+    )

@@ -176,7 +176,7 @@ def _path_names(path) -> tuple:
     return tuple(names)
 
 
-_TP_COLUMN = ("query", "key", "value", "fc1")   # shard output dim(s)
+_TP_COLUMN = ("query", "key", "value", "fc1", "gate")  # shard output dim(s)
 _TP_ROW = ("out", "fc2")                        # shard input dim(s)
 
 
@@ -216,7 +216,9 @@ class TensorParallelStrategy(Strategy):
     - attention out kernel [heads, head_dim, embed]: row-parallel — the
       contraction dims split, XLA inserts one psum after the projection.
     - mlp fc1 [embed, ffn]: column-parallel; bias follows. fc2 [ffn, embed]:
-      row-parallel -> second psum.
+      row-parallel -> second psum. A swiglu 'gate' [embed, ffn] is
+      column-parallel like fc1 — both outputs carry the same ffn shard, so
+      the elementwise gating needs no collective.
     - everything else (LayerNorms, embeddings, heads, conv stems) replicates.
 
     Combined with the activation constraints the models already carry
