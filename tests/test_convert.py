@@ -146,3 +146,32 @@ def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama):
         ):
             assert np.asarray(b).shape == a.shape, (p1, a.shape,
                                                     np.asarray(b).shape)
+
+
+def test_convert_cli_round_trip(tmp_path, hf_gpt2, rng):
+    """The offline CLI path: save_pretrained dir -> params.npz +
+    model_config.json -> rebuilt model reproduces the HF logits."""
+    import json
+
+    from tfde_tpu.export.serving import _unflatten_params
+    from tfde_tpu.models.convert import _cli
+    from tfde_tpu.models.gpt import GPT
+
+    src = str(tmp_path / "hf")
+    out = str(tmp_path / "converted")
+    hf_gpt2.save_pretrained(src)
+    _cli(["gpt2", src, out])
+
+    z = np.load(f"{out}/params.npz")
+    params = _unflatten_params({k: z[k] for k in z.files})
+    conf = json.load(open(f"{out}/model_config.json"))
+    assert conf["family"] == "gpt2"
+    model = GPT(
+        **{k: v for k, v in conf.items() if k not in ("family", "dtype")},
+        dtype=jnp.float32,
+    )
+    ids = rng.integers(0, 97, (1, 10)).astype(np.int32)
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    with torch.no_grad():
+        ref = hf_gpt2(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)

@@ -64,6 +64,16 @@ def _unflatten_params(flat: dict) -> dict:
     return tree
 
 
+def write_params_npz(path: str, tree) -> None:
+    """One definition of the params.npz convention (flat '/'-joined keys,
+    buffer-then-write for remote fs) — serving/generative artifacts and the
+    conversion CLI all write through here."""
+    buf = io.BytesIO()
+    np.savez(buf, **_flatten_params(tree))
+    with fs.fs_open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
 def _write_artifact(directory: str, exported, host_vars, signature: dict) -> str:
     """Shared artifact writer: timestamped dir + model.stablehlo +
     params.npz + signature.json (export_serving and export_generate)."""
@@ -72,10 +82,7 @@ def _write_artifact(directory: str, exported, host_vars, signature: dict) -> str
     fs.makedirs(out_dir, exist_ok=True)
     with fs.fs_open(fs.join(out_dir, "model.stablehlo"), "wb") as f:
         f.write(exported.serialize())
-    buf = io.BytesIO()
-    np.savez(buf, **_flatten_params(host_vars))
-    with fs.fs_open(fs.join(out_dir, "params.npz"), "wb") as f:
-        f.write(buf.getvalue())
+    write_params_npz(fs.join(out_dir, "params.npz"), host_vars)
     with fs.fs_open(fs.join(out_dir, "signature.json"), "w") as f:
         json.dump(signature, f, indent=2)
     return out_dir

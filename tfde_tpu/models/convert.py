@@ -295,3 +295,73 @@ def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
                             "bias": np.zeros(hidden, np.float32)}
         params["mlm_bias"] = np.zeros(cfg.vocab_size, np.float32)
     return model, params
+
+
+# --------------------------------------------------------------------------
+# CLI: python -m tfde_tpu.models.convert <family> <hf_path> <out_dir>
+# --------------------------------------------------------------------------
+
+_FAMILIES = {
+    "gpt2": ("GPT2LMHeadModel", "gpt2_from_hf"),
+    "bert": ("BertForMaskedLM", "bert_from_hf"),
+    "llama": ("LlamaForCausalLM", "llama_from_hf"),
+}
+
+
+def _cli(argv=None) -> str:
+    """Convert a local HF checkpoint directory into this framework's
+    artifact: <out>/params.npz (flat, the export/serving layout) +
+    <out>/model_config.json (the constructor kwargs to rebuild the model).
+    Returns the output dir. Offline by construction — `hf_path` is a local
+    directory saved with save_pretrained(); nothing is downloaded."""
+    import argparse
+    import dataclasses
+    import json
+
+    parser = argparse.ArgumentParser(
+        description="HF checkpoint -> tfde_tpu params",
+    )
+    parser.add_argument("family", choices=sorted(_FAMILIES))
+    parser.add_argument("hf_path", help="local save_pretrained() directory")
+    parser.add_argument("out_dir")
+    args = parser.parse_args(argv)
+
+    import transformers
+
+    from tfde_tpu.export.serving import write_params_npz
+    from tfde_tpu.utils import fs
+
+    import os
+
+    if not os.path.isdir(args.hf_path):
+        raise SystemExit(
+            f"{args.hf_path!r} is not a directory — pass a local "
+            f"save_pretrained() checkpoint; this CLI never downloads"
+        )
+    cls_name, fn_name = _FAMILIES[args.family]
+    hf = getattr(transformers, cls_name).from_pretrained(
+        args.hf_path, local_files_only=True
+    )
+    hf.eval()
+    model, params = globals()[fn_name](hf)
+
+    fs.makedirs(args.out_dir, exist_ok=True)
+    write_params_npz(fs.join(args.out_dir, "params.npz"), params)
+    # the flax module is a frozen dataclass: its fields ARE the config
+    config = {
+        f.name: getattr(model, f.name)
+        for f in dataclasses.fields(model)
+        if f.name not in ("parent", "name")
+        and isinstance(getattr(model, f.name), (int, float, str, bool,
+                                                type(None)))
+    }
+    config["family"] = args.family
+    config["dtype"] = str(np.dtype(model.dtype))  # derived, never assumed
+    with fs.fs_open(fs.join(args.out_dir, "model_config.json"), "w") as f:
+        json.dump(config, f, indent=2)
+    print(f"converted {args.family} checkpoint -> {args.out_dir}")
+    return args.out_dir
+
+
+if __name__ == "__main__":
+    _cli()
