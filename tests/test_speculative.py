@@ -1,0 +1,111 @@
+"""Speculative decoding (inference/speculative.py): the output must equal
+the target model's plain greedy generate() token for token — regardless of
+draft quality, draft size, or acceptance pattern. Draft quality changes
+only the speed, never the text; these tests pin the text."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.inference.decode import generate
+from tfde_tpu.inference.speculative import generate_speculative
+from tfde_tpu.models.gpt import GPT, gpt_tiny_test
+
+
+@pytest.fixture(scope="module")
+def target():
+    m = gpt_tiny_test()
+    params = m.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+@pytest.fixture(scope="module")
+def draft():
+    """Smaller and differently-initialized: a WRONG draft — proposals will
+    frequently be rejected, exercising the partial-acceptance rewinds."""
+    m = GPT(vocab_size=97, hidden_size=16, depth=1, num_heads=2, mlp_dim=32,
+            max_position=64, dtype=jnp.float32)
+    params = m.init(jax.random.key(9), jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+@pytest.mark.parametrize("num_draft", [1, 2, 4, 8])
+def test_matches_target_greedy_any_draft_size(target, draft, rng, num_draft):
+    model, params = target
+    dmodel, dparams = draft
+    prompt = jnp.asarray(rng.integers(0, 97, (1, 5)), jnp.int32)
+    ref, ref_len = generate(model, params, prompt, max_new_tokens=12)
+    out, out_len = generate_speculative(
+        model, dmodel, params, dparams, prompt, max_new_tokens=12,
+        num_draft=num_draft,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    np.testing.assert_array_equal(np.asarray(out_len), np.asarray(ref_len))
+
+
+def test_perfect_draft_full_acceptance(target, rng):
+    """Draft == target: every proposal accepted, every round commits
+    num_draft+1 tokens — and the text still matches plain greedy."""
+    model, params = target
+    prompt = jnp.asarray(rng.integers(0, 97, (1, 4)), jnp.int32)
+    ref, _ = generate(model, params, prompt, max_new_tokens=10)
+    out, _ = generate_speculative(
+        model, model, params, params, prompt, max_new_tokens=10, num_draft=3
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_eos_matches_generate(target, draft, rng):
+    model, params = target
+    dmodel, dparams = draft
+    prompt = jnp.asarray(rng.integers(0, 97, (1, 4)), jnp.int32)
+    free, _ = generate(model, params, prompt, max_new_tokens=10)
+    eos = int(np.asarray(free)[0, 6])  # third generated token
+    ref, ref_len = generate(model, params, prompt, max_new_tokens=10,
+                            eos_id=eos, pad_id=0)
+    out, out_len = generate_speculative(
+        model, dmodel, params, dparams, prompt, max_new_tokens=10,
+        num_draft=4, eos_id=eos, pad_id=0,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    np.testing.assert_array_equal(np.asarray(out_len), np.asarray(ref_len))
+
+
+def test_rope_gqa_target(draft, rng):
+    """Cache-index surgery works for rope models (no position table, no
+    position_index counter) and GQA caches."""
+    dmodel, dparams = draft
+    m = GPT(vocab_size=97, hidden_size=32, depth=2, num_heads=4, mlp_dim=64,
+            max_position=64, dtype=jnp.float32, position="rope",
+            num_kv_heads=2)
+    params = m.init(jax.random.key(3), jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = jnp.asarray(rng.integers(0, 97, (1, 6)), jnp.int32)
+    ref, _ = generate(m, params, prompt, max_new_tokens=9)
+    out, _ = generate_speculative(
+        m, dmodel, params, dparams, prompt, max_new_tokens=9, num_draft=3
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_single_token_prompt(target, draft):
+    model, params = target
+    dmodel, dparams = draft
+    prompt = jnp.asarray([[7]], jnp.int32)
+    ref, _ = generate(model, params, prompt, max_new_tokens=8)
+    out, _ = generate_speculative(
+        model, dmodel, params, dparams, prompt, max_new_tokens=8, num_draft=2
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_rejects_bad_args(target, draft):
+    model, params = target
+    dmodel, dparams = draft
+    with pytest.raises(ValueError, match="batch 1"):
+        generate_speculative(model, dmodel, params, dparams,
+                             jnp.zeros((2, 4), jnp.int32), max_new_tokens=4)
+    with pytest.raises(ValueError, match="num_draft"):
+        generate_speculative(model, dmodel, params, dparams,
+                             jnp.zeros((1, 4), jnp.int32), max_new_tokens=4,
+                             num_draft=0)

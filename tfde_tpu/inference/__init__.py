@@ -9,6 +9,7 @@ from tfde_tpu.inference.decode import (
     init_cache,
     sample_logits,
 )
+from tfde_tpu.inference.speculative import generate_speculative
 
-__all__ = ["beam_search", "generate", "generate_ragged", "init_cache",
-           "sample_logits"]
+__all__ = ["beam_search", "generate", "generate_ragged",
+           "generate_speculative", "init_cache", "sample_logits"]

@@ -1,0 +1,197 @@
+"""Speculative decoding — draft-model proposal + single-forward verification.
+
+The latency lever for serving a large model: a small DRAFT model proposes
+`num_draft` greedy tokens through its own KV-cache decode; the TARGET model
+scores all of them in ONE forward; the longest prefix where the target's
+greedy choice agrees is accepted, and the target's own choice is committed
+at the first disagreement (or as a bonus token on full acceptance). Every
+round commits between 1 and num_draft+1 tokens for one target forward —
+the target's per-token cost drops with the acceptance rate while the
+output matches the target model's plain greedy generation token for token
+(tests/test_speculative.py asserts it against generate()), up to one
+caveat: the verify forward scores num_draft+1 positions in one GEMM where
+generate() scores one at a time, so a bf16 near-tie between the top-2
+logits can in principle resolve differently; fp32 logits (the repo
+convention — models cast logits to fp32) make this a non-issue in
+practice.
+
+TPU shape discipline:
+- The round and prefill programs are MODULE-LEVEL jits keyed on the
+  (hashable) model configs and static sizes: compiled once per
+  (model pair, num_draft, shapes), reused across calls — a serving loop
+  pays trace+compile on the first request only.
+- Both KV caches are DONATED to the round program: XLA updates them in
+  place instead of copying hundreds of MB of cache per round on the
+  bandwidth-bound path the optimization exists to relieve.
+- Cache rewind is scalar surgery: rejected proposals leave stale K/V in
+  both caches, but the attention validity mask reads only `cache_index`
+  (models/transformer.py), so setting the index counters back makes the
+  stale entries unreachable — no cache copy, no re-prefill.
+- Batch is 1 by design: `cache_index` is shared across rows and per-row
+  acceptance lengths diverge — classic speculative decoding is a latency
+  optimization for single-stream serving (batch throughput is already
+  served by `generate`).
+
+Invariant between rounds: both caches hold K/V for exactly the committed
+text T[0..m) (`m` = the rewound index counters), and `tok` carries the
+last committed token T[m], generated but not yet fed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfde_tpu.inference.decode import (
+    _decode_clone,
+    init_cache,
+    validate_budget,
+)
+
+
+def _set_index_counters(cache, value):
+    """Rewind every layer's cache_index (and the model's position_index)
+    to `value` — fed-token-count surgery after a partial acceptance."""
+
+    def fix(path, leaf):
+        name = str(getattr(path[-1], "key", path[-1]))
+        if name in ("cache_index", "position_index"):
+            return jnp.asarray(value, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _full_step(decode_model, params, cache, tokens):
+    """One decode forward keeping EVERY position's fp32 logits."""
+    logits, mutated = decode_model.apply(
+        {"params": params, "cache": cache}, tokens, train=False,
+        mutable=["cache"],
+    )
+    return mutated["cache"], logits.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("tgt", "drf"),
+                   donate_argnums=(2, 3))
+def _prefill(tgt, drf, tgt_cache, drf_cache, params, dparams, prompt):
+    # both caches ingest the FULL prompt (the round feeds tok_last next,
+    # so each needs K/V for everything before it)
+    tgt_cache, logits = _full_step(tgt, params, tgt_cache, prompt)
+    drf_cache, _ = _full_step(drf, dparams, drf_cache, prompt)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)  # [1]
+    return tgt_cache, drf_cache, first
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tgt", "drf", "num_draft", "pad_id"),
+                   donate_argnums=(2, 3))
+def _spec_round(tgt, drf, tgt_cache, drf_cache, params, dparams, tok_last,
+                num_draft, pad_id):
+    """(caches, round_tokens [num_draft+1] pad-filled, n_new, pending).
+    round_tokens[:n_new] = accepted proposals + the target's token at the
+    first disagreement (== the bonus token on full acceptance)."""
+
+    def draft_body(carry, _):
+        cache, tok = carry
+        cache, logits = _full_step(drf, dparams, cache, tok[:, None])
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (drf_cache, last_prop), props = jax.lax.scan(
+        draft_body, (drf_cache, tok_last), length=num_draft
+    )
+    props = jnp.moveaxis(props, 0, 1)[0]  # [num_draft]
+    # feed the final proposal too: on full acceptance its K/V must be in
+    # the draft cache for the next round
+    drf_cache, _ = _full_step(drf, dparams, drf_cache, last_prop[:, None])
+
+    verify_in = jnp.concatenate([tok_last, props], axis=0)[None, :]
+    tgt_cache, logits = _full_step(tgt, params, tgt_cache, verify_in)
+    targets = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+    # targets[i] = target's greedy choice after verify_in[:, :i+1];
+    # proposal i is correct iff targets[i] == props[i]
+    agree = targets[:num_draft] == props
+    n_acc = jnp.where(
+        jnp.all(agree),
+        num_draft,
+        jnp.argmin(agree),  # index of the first False == True-prefix length
+    ).astype(jnp.int32)
+    pending = targets[n_acc]  # target's own token after the prefix
+    out = jnp.where(
+        jnp.arange(num_draft + 1) < n_acc,
+        jnp.concatenate([props, jnp.array([pad_id], jnp.int32)]),
+        pad_id,
+    ).at[n_acc].set(pending)
+    return tgt_cache, drf_cache, out, n_acc + 1, pending[None]
+
+
+def generate_speculative(
+    model,
+    draft_model,
+    params,
+    draft_params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    num_draft: int = 4,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+):
+    """Greedy generation of the TARGET model, accelerated by the draft.
+
+    prompt is [1, P] int32 (single stream — see module docstring). Returns
+    (tokens [1, P + max_new_tokens], lengths [1]) matching
+    `generate(model, params, prompt, max_new_tokens, eos_id=..., pad_id=...)`
+    with greedy decoding.
+    """
+    b, p = prompt.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative decoding is single-stream (batch 1), got batch "
+            f"{b} — cache_index is shared across rows and per-row "
+            f"acceptance diverges; use generate() for batch throughput"
+        )
+    if num_draft < 1:
+        raise ValueError(f"num_draft must be >= 1, got {num_draft}")
+    total = validate_budget(model, p, max_new_tokens)
+    validate_budget(draft_model, p, max_new_tokens)
+
+    tgt = _decode_clone(model)
+    drf = _decode_clone(draft_model)
+    # every round feeds at most num_draft+1 tokens to each cache before the
+    # rewind, so size for the final round's overshoot
+    cache_len = total + num_draft + 1
+    tgt_cache = init_cache(model, 1, cache_len)
+    drf_cache = init_cache(draft_model, 1, cache_len)
+    prompt = prompt.astype(jnp.int32)
+
+    tgt_cache, drf_cache, tok = _prefill(
+        tgt, drf, tgt_cache, drf_cache, params, draft_params, prompt
+    )
+    out_tokens = [int(tok[0])]
+    committed = p  # tokens whose K/V both caches hold; `tok` is pending
+    done = eos_id is not None and out_tokens[0] == eos_id
+    while len(out_tokens) < max_new_tokens and not done:
+        tgt_cache = _set_index_counters(tgt_cache, committed)
+        drf_cache = _set_index_counters(drf_cache, committed)
+        tgt_cache, drf_cache, round_toks, n_new, tok = _spec_round(
+            tgt, drf, tgt_cache, drf_cache, params, draft_params, tok,
+            num_draft, pad_id,
+        )
+        toks = np.asarray(round_toks)[: int(n_new)].tolist()
+        if eos_id is not None and eos_id in toks:
+            toks = toks[: toks.index(eos_id) + 1]
+            done = True
+        toks = toks[: max_new_tokens - len(out_tokens)]
+        committed += len(toks)  # tok_last + accepted (pending stays unfed)
+        out_tokens.extend(toks)
+        tok = jnp.asarray([out_tokens[-1]], jnp.int32)
+
+    new = np.full((max_new_tokens,), pad_id, np.int64)
+    new[: len(out_tokens)] = out_tokens
+    tokens = np.concatenate([np.asarray(prompt)[0], new]).astype(np.int32)
+    lengths = np.asarray([p + len(out_tokens)], np.int32)
+    return tokens[None], lengths
