@@ -109,3 +109,62 @@ def test_rejects_bad_args(target, draft):
         generate_speculative(model, dmodel, params, dparams,
                              jnp.zeros((1, 4), jnp.int32), max_new_tokens=4,
                              num_draft=0)
+
+
+def test_sampled_mode_matches_target_distribution():
+    """Speculative SAMPLING correctness (the Leviathan theorem): the round's
+    committed token must be distributed as target-model sampling,
+    REGARDLESS of the (wrong) draft. Tiny vocab so every sample carries
+    signal: compare the empirical MARGINAL of the round-produced second
+    token over many seeded runs against the analytic marginal
+    sum_i p_t(t1=i) p_t(t2=j | t1=i). Deterministic: fixed seeds, CPU."""
+    vocab, temp, n = 13, 1.0, 1200
+    model = GPT(vocab_size=vocab, hidden_size=16, depth=1, num_heads=2,
+                mlp_dim=32, max_position=16, dtype=jnp.float32)
+    params = model.init(jax.random.key(2), jnp.zeros((1, 4), jnp.int32))["params"]
+    dmodel = GPT(vocab_size=vocab, hidden_size=8, depth=1, num_heads=1,
+                 mlp_dim=16, max_position=16, dtype=jnp.float32)
+    dparams = dmodel.init(jax.random.key(8), jnp.zeros((1, 4), jnp.int32))["params"]
+    prompt = jnp.asarray([[3, 7]], jnp.int32)
+
+    seconds = []
+    for i in range(n):
+        out, _ = generate_speculative(
+            model, dmodel, params, dparams, prompt, max_new_tokens=2,
+            num_draft=1, temperature=temp, rng=jax.random.key(i),
+        )
+        seconds.append(int(np.asarray(out)[0, 3]))
+
+    # analytic marginal: p(t2=j) = sum_i p(t1=i) p(t2=j | prompt+[i])
+    p1 = np.asarray(jax.nn.softmax(
+        model.apply({"params": params}, prompt)[0, -1] / temp
+    ))
+    ctxs = jnp.concatenate(
+        [jnp.tile(prompt, (vocab, 1)),
+         jnp.arange(vocab, dtype=jnp.int32)[:, None]], axis=1
+    )
+    p2_given = np.asarray(jax.nn.softmax(
+        model.apply({"params": params}, ctxs)[:, -1] / temp, axis=-1
+    ))
+    expected = p1 @ p2_given  # [vocab]
+    empirical = np.bincount(seconds, minlength=vocab) / n
+    tv = 0.5 * np.abs(empirical - expected).sum()
+    assert tv < 0.07, f"total variation {tv:.3f} vs target marginal"
+
+
+def test_sampled_mode_reproducible_and_respects_eos(target, draft):
+    model, params = target
+    dmodel, dparams = draft
+    prompt = jnp.asarray([[5, 9]], jnp.int32)
+    kw = dict(max_new_tokens=8, num_draft=3, temperature=0.7,
+              rng=jax.random.key(11))
+    a, la = generate_speculative(model, dmodel, params, dparams, prompt, **kw)
+    b, lb = generate_speculative(model, dmodel, params, dparams, prompt, **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    eos = int(np.asarray(a)[0, 2])  # first generated token
+    c, lc = generate_speculative(model, dmodel, params, dparams, prompt,
+                                 max_new_tokens=8, num_draft=3,
+                                 temperature=0.7, rng=jax.random.key(11),
+                                 eos_id=eos, pad_id=0)
+    assert int(lc[0]) == 3  # prompt 2 + the EOS token
+    assert (np.asarray(c)[0, 3:] == 0).all()

@@ -1,19 +1,27 @@
 """Speculative decoding — draft-model proposal + single-forward verification.
 
 The latency lever for serving a large model: a small DRAFT model proposes
-`num_draft` greedy tokens through its own KV-cache decode; the TARGET model
-scores all of them in ONE forward; the longest prefix where the target's
-greedy choice agrees is accepted, and the target's own choice is committed
-at the first disagreement (or as a bonus token on full acceptance). Every
-round commits between 1 and num_draft+1 tokens for one target forward —
-the target's per-token cost drops with the acceptance rate while the
-output matches the target model's plain greedy generation token for token
-(tests/test_speculative.py asserts it against generate()), up to one
-caveat: the verify forward scores num_draft+1 positions in one GEMM where
-generate() scores one at a time, so a bf16 near-tie between the top-2
-logits can in principle resolve differently; fp32 logits (the repo
-convention — models cast logits to fp32) make this a non-issue in
-practice.
+`num_draft` tokens through its own KV-cache decode; the TARGET model
+scores all of them in ONE forward. Two modes share the round skeleton:
+
+- temperature == 0 (default): draft proposes greedily; the longest prefix
+  where the target's greedy choice agrees is accepted, plus the target's
+  own choice at the first disagreement (or a bonus token on full
+  acceptance). Output matches plain greedy generate() token for token
+  (tests/test_speculative.py asserts it), up to one caveat: the verify
+  forward scores num_draft+1 positions in one GEMM where generate()
+  scores one at a time, so a bf16 near-tie between the top-2 logits can
+  in principle resolve differently; fp32 logits (the repo convention)
+  make this a non-issue in practice.
+- temperature > 0: speculative SAMPLING (Leviathan et al.) — the draft
+  samples, the target accepts each proposal with min(1, p_t/p_d) and
+  resamples the residual norm(max(0, p_t - p_d)) at the first rejection.
+  Committed tokens are distributed exactly as target-model sampling at
+  that temperature (the marginal-distribution test asserts it); draft
+  quality moves only the speed.
+
+Every round commits between 1 and num_draft+1 tokens for one target
+forward — the target's per-token cost drops with the acceptance rate.
 
 TPU shape discipline:
 - The round and prefill programs are MODULE-LEVEL jits keyed on the
@@ -49,6 +57,7 @@ import numpy as np
 from tfde_tpu.inference.decode import (
     _decode_clone,
     init_cache,
+    sample_logits,
     validate_budget,
 )
 
@@ -64,6 +73,17 @@ def _set_index_counters(cache, value):
         return leaf
 
     return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _assemble_round(props, n_acc, pending, num_draft: int, pad_id: int):
+    """round_tokens [num_draft+1] = accepted proposals, then the pending
+    token at position n_acc, pad after — ONE definition for the greedy and
+    sampled rounds."""
+    return jnp.where(
+        jnp.arange(num_draft + 1) < n_acc,
+        jnp.concatenate([props, jnp.array([pad_id], jnp.int32)]),
+        pad_id,
+    ).at[n_acc].set(pending)
 
 
 def _full_step(decode_model, params, cache, tokens):
@@ -82,8 +102,7 @@ def _prefill(tgt, drf, tgt_cache, drf_cache, params, dparams, prompt):
     # so each needs K/V for everything before it)
     tgt_cache, logits = _full_step(tgt, params, tgt_cache, prompt)
     drf_cache, _ = _full_step(drf, dparams, drf_cache, prompt)
-    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)  # [1]
-    return tgt_cache, drf_cache, first
+    return tgt_cache, drf_cache, logits[:, -1]  # [1, V] target logits
 
 
 @functools.partial(jax.jit,
@@ -121,12 +140,73 @@ def _spec_round(tgt, drf, tgt_cache, drf_cache, params, dparams, tok_last,
         jnp.argmin(agree),  # index of the first False == True-prefix length
     ).astype(jnp.int32)
     pending = targets[n_acc]  # target's own token after the prefix
-    out = jnp.where(
-        jnp.arange(num_draft + 1) < n_acc,
-        jnp.concatenate([props, jnp.array([pad_id], jnp.int32)]),
-        pad_id,
-    ).at[n_acc].set(pending)
+    out = _assemble_round(props, n_acc, pending, num_draft, pad_id)
     return tgt_cache, drf_cache, out, n_acc + 1, pending[None]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tgt", "drf", "num_draft", "pad_id",
+                                    "temperature"),
+                   donate_argnums=(2, 3))
+def _spec_round_sampled(tgt, drf, tgt_cache, drf_cache, params, dparams,
+                        tok_last, rng, num_draft, pad_id, temperature):
+    """The stochastic round (Leviathan et al. speculative SAMPLING):
+
+    the draft SAMPLES d_i ~ p_d; the target accepts d_i with probability
+    min(1, p_t(d_i)/p_d(d_i)) and, at the first rejection, samples the
+    replacement from the residual distribution norm(max(0, p_t - p_d)) —
+    the committed tokens are then distributed EXACTLY as target-model
+    sampling at this temperature (the classic correctness theorem). On
+    full acceptance the bonus token samples from p_t directly."""
+    inv_t = 1.0 / temperature
+
+    def draft_body(carry, rng_i):
+        cache, tok = carry
+        cache, logits = _full_step(drf, dparams, cache, tok[:, None])
+        logp = jax.nn.log_softmax(logits[:, -1] * inv_t, axis=-1)  # [1, V]
+        nxt = jax.random.categorical(rng_i, logp, axis=-1).astype(jnp.int32)
+        return (cache, nxt), (nxt, logp[0])
+
+    rng, *step_rngs = jax.random.split(rng, num_draft + 1)
+    (drf_cache, last_prop), (props, drf_logps) = jax.lax.scan(
+        draft_body, (drf_cache, tok_last), jnp.stack(step_rngs)
+    )
+    props = jnp.moveaxis(props, 0, 1)[0]  # [num_draft]
+    drf_cache, _ = _full_step(drf, dparams, drf_cache, last_prop[:, None])
+
+    verify_in = jnp.concatenate([tok_last, props], axis=0)[None, :]
+    tgt_cache, logits = _full_step(tgt, params, tgt_cache, verify_in)
+    tgt_logps = jax.nn.log_softmax(logits[0] * inv_t, axis=-1)  # [γ+1, V]
+
+    # acceptance: u_i < p_t(d_i)/p_d(d_i); the first rejection truncates
+    rng, u_rng, resid_rng, bonus_rng = jax.random.split(rng, 4)
+    u = jax.random.uniform(u_rng, (num_draft,))
+    ratio = jnp.exp(
+        tgt_logps[jnp.arange(num_draft), props]
+        - drf_logps[jnp.arange(num_draft), props]
+    )
+    accept = u < jnp.minimum(ratio, 1.0)
+    n_acc = jnp.where(
+        jnp.all(accept), num_draft, jnp.argmin(accept)
+    ).astype(jnp.int32)
+    # replacement at the first rejection: residual max(0, p_t - p_d),
+    # renormalized; on full acceptance: sample p_t at the bonus position
+    p_t = jnp.exp(tgt_logps[n_acc])
+    p_d = jnp.exp(drf_logps[jnp.minimum(n_acc, num_draft - 1)])
+    resid = jnp.maximum(p_t - p_d, 0.0)
+    resid_sum = jnp.sum(resid)
+    # degenerate residual (p_t <= p_d everywhere numerically) -> p_t
+    resid = jnp.where(resid_sum > 0, resid / jnp.maximum(resid_sum, 1e-30),
+                      p_t)
+    replacement = jax.random.categorical(
+        resid_rng, jnp.log(jnp.maximum(resid, 1e-30))
+    ).astype(jnp.int32)
+    bonus = jax.random.categorical(bonus_rng, tgt_logps[num_draft]).astype(
+        jnp.int32
+    )
+    pending = jnp.where(n_acc == num_draft, bonus, replacement)
+    out = _assemble_round(props, n_acc, pending, num_draft, pad_id)
+    return tgt_cache, drf_cache, out, n_acc + 1, pending[None], rng
 
 
 def generate_speculative(
@@ -137,15 +217,22 @@ def generate_speculative(
     prompt: jax.Array,
     max_new_tokens: int,
     num_draft: int = 4,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
 ):
-    """Greedy generation of the TARGET model, accelerated by the draft.
+    """Generation of the TARGET model, accelerated by the draft.
 
-    prompt is [1, P] int32 (single stream — see module docstring). Returns
-    (tokens [1, P + max_new_tokens], lengths [1]) matching
-    `generate(model, params, prompt, max_new_tokens, eos_id=..., pad_id=...)`
-    with greedy decoding.
+    prompt is [1, P] int32 (single stream — see module docstring). With
+    `temperature == 0` (default) the output matches greedy
+    `generate(model, params, prompt, ...)` token for token. With
+    `temperature > 0` the rounds run speculative SAMPLING: draft samples,
+    the target accepts with min(1, p_t/p_d) and resamples the residual at
+    the first rejection — committed tokens are distributed exactly as
+    target-model sampling at that temperature, with draft quality
+    affecting only the speed. Returns (tokens [1, P + max_new_tokens],
+    lengths [1]).
     """
     b, p = prompt.shape
     if b != 1:
@@ -168,19 +255,34 @@ def generate_speculative(
     drf_cache = init_cache(draft_model, 1, cache_len)
     prompt = prompt.astype(jnp.int32)
 
-    tgt_cache, drf_cache, tok = _prefill(
+    sampled = temperature > 0.0
+    if sampled and rng is None:
+        rng = jax.random.key(0)
+    tgt_cache, drf_cache, first_logits = _prefill(
         tgt, drf, tgt_cache, drf_cache, params, draft_params, prompt
     )
+    if sampled:
+        rng, sub = jax.random.split(rng)
+        tok = sample_logits(first_logits, sub, temperature=temperature)
+    else:
+        tok = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
     out_tokens = [int(tok[0])]
     committed = p  # tokens whose K/V both caches hold; `tok` is pending
     done = eos_id is not None and out_tokens[0] == eos_id
     while len(out_tokens) < max_new_tokens and not done:
         tgt_cache = _set_index_counters(tgt_cache, committed)
         drf_cache = _set_index_counters(drf_cache, committed)
-        tgt_cache, drf_cache, round_toks, n_new, tok = _spec_round(
-            tgt, drf, tgt_cache, drf_cache, params, draft_params, tok,
-            num_draft, pad_id,
-        )
+        if sampled:
+            (tgt_cache, drf_cache, round_toks, n_new, tok,
+             rng) = _spec_round_sampled(
+                tgt, drf, tgt_cache, drf_cache, params, draft_params, tok,
+                rng, num_draft, pad_id, temperature,
+            )
+        else:
+            tgt_cache, drf_cache, round_toks, n_new, tok = _spec_round(
+                tgt, drf, tgt_cache, drf_cache, params, draft_params, tok,
+                num_draft, pad_id,
+            )
         toks = np.asarray(round_toks)[: int(n_new)].tolist()
         if eos_id is not None and eos_id in toks:
             toks = toks[: toks.index(eos_id) + 1]
