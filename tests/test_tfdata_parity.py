@@ -1,0 +1,146 @@
+"""Differential tests: data/pipeline.Dataset vs REAL tf.data.
+
+The data layer claims tf.data-compatible semantics throughout
+(data/pipeline.py docstring; SURVEY.md §2b). TensorFlow ships in this
+image (pulled in by transformers), so the claims are testable against the
+genuine article rather than against our own reading of the docs:
+
+- deterministic chains (map/batch/shard/cache/repeat) must match
+  tf.data ELEMENT FOR ELEMENT;
+- seeded shuffle uses a different PRNG, so order cannot match — there the
+  SEMANTICS must: per-epoch multiset equality, reshuffle-each-iteration,
+  repeat-crosses-epoch batching, drop_remainder shapes.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from tfde_tpu.data.pipeline import Dataset  # noqa: E402
+
+
+def _ours(ds):
+    return [tuple(np.asarray(x) for x in el) for el in iter(ds)]
+
+
+def _tfs(ds):
+    out = []
+    for el in ds:
+        if not isinstance(el, (tuple, list)):
+            el = (el,)
+        out.append(tuple(np.asarray(x) for x in el))
+    return out
+
+
+def _assert_same(ours, theirs):
+    assert len(ours) == len(theirs), (len(ours), len(theirs))
+    for a, b in zip(ours, theirs):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_deterministic_map_batch_chain_matches():
+    x = np.arange(20, dtype=np.float32)
+    y = np.arange(20, dtype=np.int32) % 3
+    ours = _ours(
+        Dataset.from_tensor_slices((x, y))
+        .map(lambda a, b: (a * 2.0 + 1.0, b))
+        .batch(6)
+    )
+    theirs = _tfs(
+        tf.data.Dataset.from_tensor_slices((x, y))
+        .map(lambda a, b: (a * 2.0 + 1.0, b))
+        .batch(6)
+    )
+    _assert_same(ours, theirs)
+
+
+def test_drop_remainder_matches():
+    x = np.arange(10, dtype=np.int32)
+    for drop in (True, False):
+        ours = _ours(
+            Dataset.from_tensor_slices((x,)).batch(4, drop_remainder=drop)
+        )
+        theirs = _tfs(
+            tf.data.Dataset.from_tensor_slices(x).batch(
+                4, drop_remainder=drop
+            )
+        )
+        _assert_same(ours, theirs)
+
+
+def test_repeat_crosses_epoch_boundaries_like_tfdata():
+    """repeat().batch() must batch ACROSS epochs — never a short batch at
+    an epoch boundary (the property jit static shapes rely on)."""
+    x = np.arange(5, dtype=np.int32)
+    ours = _ours(Dataset.from_tensor_slices((x,)).repeat(4).batch(3))
+    theirs = _tfs(tf.data.Dataset.from_tensor_slices(x).repeat(4).batch(3))
+    _assert_same(ours, theirs)
+
+
+def test_shard_matches():
+    x = np.arange(17, dtype=np.int32)
+    for n, i in ((2, 0), (2, 1), (3, 2)):
+        ours = _ours(Dataset.from_tensor_slices((x,)).shard(n, i))
+        theirs = _tfs(tf.data.Dataset.from_tensor_slices(x).shard(n, i))
+        _assert_same(ours, theirs)
+
+
+def test_cache_repeat_matches():
+    x = np.arange(8, dtype=np.float32)
+    ours = _ours(
+        Dataset.from_tensor_slices((x,)).map(lambda a: a + 1).cache()
+        .repeat(3).batch(4)
+    )
+    theirs = _tfs(
+        tf.data.Dataset.from_tensor_slices(x).map(lambda a: a + 1).cache()
+        .repeat(3).batch(4)
+    )
+    _assert_same(ours, theirs)
+
+
+def test_shuffle_semantics_match_tfdata():
+    """PRNGs differ, so compare SEMANTICS: full-buffer seeded shuffle is a
+    permutation of each epoch (multiset equality with tf.data's output),
+    reshuffled differently each epoch, deterministic per seed."""
+    x = np.arange(32, dtype=np.int32)
+    ds = Dataset.from_tensor_slices((x,)).shuffle(32, seed=7).repeat(2)
+    flat = [int(el[0]) for el in iter(ds)]
+    ours_epochs = [flat[:32], flat[32:]]
+
+    tfds = tf.data.Dataset.from_tensor_slices(x).shuffle(
+        32, seed=7, reshuffle_each_iteration=True
+    ).repeat(2)
+    tflat = [int(np.asarray(el)) for el in tfds]
+    tf_epochs = [tflat[:32], tflat[32:]]
+
+    for o, t in zip(ours_epochs, tf_epochs):
+        assert sorted(o) == sorted(t) == list(range(32))
+    # both reshuffle per epoch...
+    assert ours_epochs[0] != ours_epochs[1]
+    assert tf_epochs[0] != tf_epochs[1]
+    # ...and both are deterministic under the seed
+    flat2 = [int(el[0]) for el in iter(
+        Dataset.from_tensor_slices((x,)).shuffle(32, seed=7).repeat(2)
+    )]
+    assert flat == flat2
+
+
+def test_windowed_shuffle_semantics():
+    """buffer < n: tf.data's windowed shuffle guarantees element i appears
+    only after at least i - buffer elements have been emitted (an element
+    can't leave the buffer before entering it). Same law must hold here."""
+    n, buf = 64, 8
+    x = np.arange(n, dtype=np.int32)
+    for seq in (
+        [int(el[0]) for el in iter(
+            Dataset.from_tensor_slices((x,)).shuffle(buf, seed=3)
+        )],
+        [int(np.asarray(el)) for el in
+         tf.data.Dataset.from_tensor_slices(x).shuffle(buf, seed=3)],
+    ):
+        assert sorted(seq) == list(range(n))
+        for pos, val in enumerate(seq):
+            assert val <= pos + buf, (pos, val)
