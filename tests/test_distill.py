@@ -1,0 +1,129 @@
+"""Distillation (training/distill.py) and its payoff: a distilled draft
+raises speculative-decoding acceptance.
+
+The end-to-end story: train a teacher on the structured synthetic stream,
+distill a half-size student against its soft targets through the standard
+custom-loss machinery, and verify (a) the distillation metrics move the
+right way, (b) the distilled student accelerates speculative decoding
+measurably versus an undistilled twin — tokens_per_round is the
+acceptance telemetry the serving side exposes for exactly this."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tfde_tpu.data.datasets import synthetic_tokens
+from tfde_tpu.models.gpt import GPT, gpt_tiny_test
+from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
+from tfde_tpu.training.distill import make_distill_loss
+from tfde_tpu.training.step import init_state, make_custom_train_step
+
+
+def _student():
+    return GPT(vocab_size=97, hidden_size=16, depth=1, num_heads=2,
+               mlp_dim=32, max_position=64, dtype=jnp.float32)
+
+
+def test_distill_improves_agreement_and_speculation():
+    """Runs in a subprocess: the 400-step train+distill loop is stable
+    standalone but can abort inside pytest's process environment (an XLA
+    CPU runtime issue unrelated to the code under test — no Python frame
+    beyond the jitted call in the crash dump); subprocess isolation is the
+    same methodology as tests/test_multiprocess.py."""
+    import json
+    import subprocess
+    import sys
+
+    script = r"""
+import json
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import jax.numpy as jnp, numpy as np, optax
+from tfde_tpu.data.datasets import synthetic_tokens
+from tfde_tpu.inference.speculative import generate_speculative
+from tfde_tpu.models.gpt import GPT, gpt_tiny_test, next_token_loss
+from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
+from tfde_tpu.training.distill import make_distill_loss
+from tfde_tpu.training.step import init_state, make_custom_train_step
+
+tokens = synthetic_tokens(512, 16, vocab=96)
+strategy = MultiWorkerMirroredStrategy()
+teacher = gpt_tiny_test()
+tstate, _ = init_state(teacher, optax.adamw(3e-3), strategy,
+                       np.zeros((32, 16), np.int32))
+tstep = make_custom_train_step(strategy, tstate, next_token_loss, donate=False)
+rng = np.random.default_rng(0)
+key = jax.random.key(0)
+for _ in range(120):
+    idx = rng.integers(0, len(tokens), 32)
+    tstate, _ = tstep(tstate, (tokens[idx],), key)
+tparams = jax.device_get(tstate.params)
+
+student = GPT(vocab_size=97, hidden_size=16, depth=1, num_heads=2, mlp_dim=32,
+              max_position=64, dtype=jnp.float32)
+state, _ = init_state(student, optax.adamw(3e-3), strategy,
+                      np.zeros((32, 16), np.int32))
+undistilled = jax.device_get(state.params)
+loss_fn = make_distill_loss(teacher, tparams, temperature=1.0)
+step = make_custom_train_step(strategy, state, loss_fn, donate=False)
+rng = np.random.default_rng(1)
+key = jax.random.key(1)
+state, m0 = step(state, (tokens[rng.integers(0, 512, 32)],), key)
+metrics = m0
+for _ in range(150):
+    idx = rng.integers(0, len(tokens), 32)
+    state, metrics = step(state, (tokens[idx],), key)
+distilled = jax.device_get(state.params)
+
+prompt = jnp.asarray(tokens[:1, :6], jnp.int32)
+def rate(dp):
+    _, _, stats = generate_speculative(teacher, student, tparams, dp, prompt,
+                                       max_new_tokens=24, num_draft=4,
+                                       return_stats=True)
+    return stats["tokens_per_round"]
+
+print(json.dumps({
+    "first_kl": float(m0["kl"]), "first_agree": float(m0["agreement"]),
+    "kl": float(metrics["kl"]), "agreement": float(metrics["agreement"]),
+    "rate_distilled": rate(distilled), "rate_undistilled": rate(undistilled),
+}))
+"""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=800, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    r = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert r["kl"] < r["first_kl"]
+    assert r["agreement"] > max(r["first_agree"], 0.25)
+    # the payoff: identical speculative runs, draft params the only delta —
+    # the distilled draft commits more tokens per target forward
+    assert r["rate_distilled"] > r["rate_undistilled"]
+
+
+def test_distill_hard_mix_and_validation():
+    import pytest
+
+    teacher = gpt_tiny_test()
+    tparams = teacher.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="temperature"):
+        make_distill_loss(teacher, tparams, temperature=0.0)
+
+    strategy = MultiWorkerMirroredStrategy()
+    student = _student()
+    state, _ = init_state(student, optax.sgd(1e-2), strategy,
+                          np.zeros((16, 16), np.int32))
+    loss_fn = make_distill_loss(teacher, tparams, temperature=1.0,
+                                hard_weight=0.5)
+    step = make_custom_train_step(strategy, state, loss_fn, donate=False)
+    toks = synthetic_tokens(64, 16, vocab=96)
+    state, metrics = step(state, (toks[:16],), jax.random.key(0))
+    assert np.isfinite(float(metrics["kl"]))
+    assert np.isfinite(float(metrics["hard_loss"]))
+    assert 0.0 <= float(metrics["agreement"]) <= 1.0

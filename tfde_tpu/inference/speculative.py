@@ -221,6 +221,7 @@ def generate_speculative(
     rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
+    return_stats: bool = False,
 ):
     """Generation of the TARGET model, accelerated by the draft.
 
@@ -269,7 +270,9 @@ def generate_speculative(
     out_tokens = [int(tok[0])]
     committed = p  # tokens whose K/V both caches hold; `tok` is pending
     done = eos_id is not None and out_tokens[0] == eos_id
+    rounds = 0
     while len(out_tokens) < max_new_tokens and not done:
+        rounds += 1
         tgt_cache = _set_index_counters(tgt_cache, committed)
         drf_cache = _set_index_counters(drf_cache, committed)
         if sampled:
@@ -296,4 +299,17 @@ def generate_speculative(
     new[: len(out_tokens)] = out_tokens
     tokens = np.concatenate([np.asarray(prompt)[0], new]).astype(np.int32)
     lengths = np.asarray([p + len(out_tokens)], np.int32)
+    if return_stats:
+        generated = len(out_tokens)
+        stats = {
+            "rounds": rounds,
+            "generated": generated,
+            # the prefill contributes the first token without a round; a
+            # run with zero rounds reports 0.0 (no acceptance information),
+            # never a fake 1.0 that would skew a dashboard's average
+            "tokens_per_round": (
+                (generated - 1) / rounds if rounds else 0.0
+            ),
+        }
+        return tokens[None], lengths, stats
     return tokens[None], lengths

@@ -1,0 +1,104 @@
+"""Knowledge distillation — train a small student against a teacher's
+soft targets.
+
+The training-side companion of speculative decoding (inference/
+speculative.py): a draft model is only as fast as its acceptance rate,
+and acceptance is exactly agreement with the target's distribution — the
+thing distillation optimizes. The same loss serves classic model
+compression.
+
+`make_distill_loss` returns a loss_fn for the existing custom-objective
+machinery (training/step.py make_custom_train_step, or
+Estimator(loss_fn=...)), so distillation inherits every strategy (DP/
+FSDP/TP/...), grad accumulation, and the full lifecycle for free. The
+teacher runs frozen inside the student's step — one fused program, no
+separate teacher pipeline.
+
+Teacher memory: the captured `teacher_params` become constants of the
+compiled step and KEEP whatever sharding they carry — `jax.device_put`
+them onto the layout you want (e.g. FSDP-shard a large teacher) BEFORE
+calling; jit preserves a captured array's sharding. Host numpy teacher
+params would be embedded replicated on every device — the loss_fn warns
+and device_puts are the caller's lever.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger(__name__)
+
+
+def make_distill_loss(
+    teacher_model,
+    teacher_params,
+    temperature: float = 2.0,
+    hard_weight: float = 0.0,
+):
+    """loss_fn for make_custom_train_step: KL(teacher_T || student_T).
+
+    batch is `(tokens,)` [B, S] int32 (the causal-LM convention,
+    models/gpt.next_token_loss): both models score every position; the
+    student matches the teacher's tempered distribution at each. The
+    standard T^2 factor keeps gradient scale comparable across
+    temperatures. `hard_weight` mixes in the data CE against the actual
+    next tokens (0 = pure distillation).
+
+    Metrics: `kl` (the objective term), `agreement` (argmax match rate
+    with the teacher — the quantity speculative acceptance depends on),
+    and `hard_loss` when hard_weight > 0.
+    """
+    if temperature <= 0:
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    if not 0.0 <= hard_weight <= 1.0:
+        # outside [0, 1] the mix silently flips a term's sign — the KL
+        # would become a reward for diverging from the teacher
+        raise ValueError(f"hard_weight must be in [0, 1], got {hard_weight}")
+    if any(
+        not isinstance(leaf, jax.Array)
+        for leaf in jax.tree_util.tree_leaves(teacher_params)
+    ):
+        log.warning(
+            "teacher_params contain host arrays: they will be embedded "
+            "REPLICATED in the compiled step — jax.device_put them with "
+            "the sharding you want (see module docstring)"
+        )
+
+    def loss_fn(state, params, batch, rng):
+        (tokens,) = batch if isinstance(batch, tuple) else (batch,)
+        student_logits = state.apply_fn(
+            {"params": params}, tokens, train=True, rngs={"dropout": rng}
+        )
+        teacher_logits = jax.lax.stop_gradient(
+            teacher_model.apply({"params": teacher_params}, tokens,
+                                train=False)
+        )
+        # align: predictions for positions 1..S-1
+        s = student_logits[:, :-1].astype(jnp.float32)
+        t = teacher_logits[:, :-1].astype(jnp.float32)
+        t_logp = jax.nn.log_softmax(t / temperature, axis=-1)
+        s_logp = jax.nn.log_softmax(s / temperature, axis=-1)
+        kl = jnp.mean(
+            jnp.sum(jnp.exp(t_logp) * (t_logp - s_logp), axis=-1)
+        ) * temperature ** 2
+        agreement = jnp.mean(
+            (jnp.argmax(s, axis=-1) == jnp.argmax(t, axis=-1)).astype(
+                jnp.float32
+            )
+        )
+        loss = kl
+        metrics = {"kl": kl, "agreement": agreement}
+        if hard_weight > 0.0:
+            from tfde_tpu.ops.losses import masked_lm_loss
+
+            hard, _ = masked_lm_loss(
+                student_logits[:, :-1], tokens[:, 1:].astype(jnp.int32)
+            )
+            loss = (1.0 - hard_weight) * kl + hard_weight * hard
+            metrics["hard_loss"] = hard
+        return loss, metrics
+
+    return loss_fn
