@@ -14,6 +14,50 @@ jax.config.update("jax_num_cpu_devices", 8)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Smoke tier (VERDICT r3 next-round #8): one fast, load-bearing test per
+# subsystem, runnable in <3 minutes on one core — `pytest -m smoke`. The
+# full 300-test suite stays as the deep tier. Maintained here (not as
+# scattered decorators) so the subsystem coverage is reviewable in one
+# place; names are nodeid bases (parametrized variants inherit the mark).
+SMOKE = {
+    "test_models.py::test_bn_cnn_param_count_matches_keras",   # models/cnn
+    "test_data.py::test_from_tensor_slices_roundtrip",         # data pipeline
+    "test_data.py::test_shard_partitions_examples",            # sharding math
+    "test_losses.py::test_ce_matches_hand_computed",           # ops/losses
+    "test_mesh.py::test_data_parallel_mesh_spans_all_devices", # runtime/mesh
+    "test_train_dp.py::test_dp_matches_single_device_numerics",  # DP psum
+    "test_lifecycle.py::test_train_and_evaluate_end_to_end",   # lifecycle
+    "test_checkpoint.py::test_save_and_restore_roundtrip",     # checkpoint
+    "test_export.py::test_export_and_load_roundtrip",          # export
+    "test_tensorboard.py::test_event_file_structure",          # observability
+    "test_fs.py::test_fs_helpers_on_memory",                   # remote fs
+    "test_optimizers.py::test_mask_excludes_biases_and_scales",  # optimizers
+    "test_tensor_parallel.py::test_tp_matches_dp_numerics",    # TP
+    "test_pipeline.py::test_pipeline_gradients_match_sequential",  # PP core
+    "test_decode.py::test_greedy_cache_matches_full_forward_rollout",  # KV
+    "test_speculative.py::test_perfect_draft_full_acceptance", # speculation
+    "test_flash_attention.py::test_flash_single_block",        # Pallas kernel
+    "test_ring_attention.py::test_ring_causal_matches_reference",  # SP ring
+    "test_native_loader.py::test_one_epoch_covers_every_row_once",  # C++ IO
+    "test_tfrecord.py::test_round_trip",                       # TFRecord IO
+    "test_gpt.py::test_gpt_is_causal",                         # GPT family
+    "test_bert.py::test_bert_tiny_forward_shapes",             # BERT family
+    "test_vit.py::test_vit_tiny_forward",                      # ViT family
+    "test_resnet.py::test_resnet18_forward",                   # ResNet family
+    "test_moe.py::test_moe_output_shape_and_aux_loss",         # MoE/EP
+    "test_grad_accum.py::test_grad_accum_rejects_indivisible_batch",
+    "test_transformer.py::test_causal_masking_blocks_future",  # attention
+    "test_rotary.py",  # whole file: tiny pure-math checks            (RoPE)
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        base = item.nodeid.split("[")[0]
+        base = base.split("tests/")[-1]
+        if base in SMOKE or base.split("::")[0] in SMOKE:
+            item.add_marker(pytest.mark.smoke)
+
 
 @pytest.fixture(scope="session", autouse=True)
 def _assert_fake_devices():

@@ -87,3 +87,47 @@ def test_training_resumes_from_checkpoint(tmp_path):
     state2, _ = step_fn2(resumed, dev_batch, rng)
     assert int(jax.device_get(state2.step)) == 4
     mngr2.close()
+
+
+def test_optimizer_change_relabeled_with_guidance(tmp_path):
+    """Restoring an adamw checkpoint into an sgd(momentum) state must fail
+    with the optimizer-changed guidance (a genuine structure mismatch,
+    detected via orbax metadata — not error-text sniffing)."""
+    strat = MultiWorkerMirroredStrategy()
+    saved, _ = init_state(
+        PlainCNN(), optax.adamw(1e-3), strat, jnp.zeros((8, 28, 28, 1))
+    )
+    mngr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    mngr.save(saved, force=True)
+    mngr.wait()
+    with pytest.raises(ValueError, match="optimizer configuration"):
+        mngr.restore_latest(_state(strat, seed=1))
+    mngr.close()
+
+
+def test_structure_check_discriminates(tmp_path):
+    """_saved_structure_differs: False for the matching state (so unrelated
+    restore errors keep their original message), True for a changed
+    optimizer."""
+    strat = MultiWorkerMirroredStrategy()
+    saved, _ = init_state(
+        PlainCNN(), optax.adamw(1e-3), strat, jnp.zeros((8, 28, 28, 1))
+    )
+    mngr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    mngr.save(saved, force=True)
+    mngr.wait()
+    step = mngr.latest_step
+
+    def abstract_of(state):
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            mngr._tree(state),
+        )
+
+    assert not mngr._saved_structure_differs(step, abstract_of(saved))
+    changed, _ = init_state(
+        PlainCNN(), optax.sgd(0.1, momentum=0.9), strat,
+        jnp.zeros((8, 28, 28, 1)),
+    )
+    assert mngr._saved_structure_differs(step, abstract_of(changed))
+    mngr.close()

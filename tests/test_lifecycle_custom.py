@@ -145,3 +145,25 @@ def test_lm_estimator_grad_accum(tmp_path):
     state = est.train(_token_input_fn(0), max_steps=5)
     assert int(jax.device_get(state.step)) == 5
     est.close()
+
+
+def test_partial_eval_batch_fails_with_named_cause(tmp_path):
+    """A trailing partial batch (input_fn without drop_remainder) must fail
+    with an error naming drop_remainder, not an opaque sharding error
+    inside device_put/jit (advisor r3)."""
+    from tfde_tpu.data.datasets import synthetic_tokens
+    from tfde_tpu.parallel.strategies import MirroredStrategy
+
+    cfg = RunConfig(model_dir=str(tmp_path))
+    est = Estimator(gpt_tiny_test(), optax.sgd(0.1), config=cfg,
+                    loss_fn=next_token_loss, eval_fn=lm_eval_fn,
+                    strategy=MirroredStrategy())
+    est.train(_token_input_fn(3), max_steps=1)
+    tokens = synthetic_tokens(37, 16, vocab=96)  # 37 % 8 devices != 0
+
+    def ragged_input_fn():
+        # one full batch of 32, then a partial batch of 5
+        return iter(Dataset.from_tensor_slices((tokens,)).batch(32))
+
+    with pytest.raises(ValueError, match="drop_remainder"):
+        est.evaluate(ragged_input_fn, name="ragged")

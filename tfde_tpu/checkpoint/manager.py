@@ -92,7 +92,12 @@ class CheckpointManager:
                 step, args=ocp.args.StandardRestore(abstract)
             )
         except ValueError as e:
-            if "structure" in str(e).lower() or "match" in str(e).lower():
+            # Reword ONLY genuine structure mismatches: compare the saved
+            # checkpoint's tree structure (orbax metadata) against the
+            # requested abstract tree, instead of sniffing the error text —
+            # an unrelated ValueError that happens to mention "structure"
+            # must surface unrelabeled.
+            if self._saved_structure_differs(step, abstract):
                 raise ValueError(
                     f"checkpoint step {step} in {self._dir} does not match "
                     f"the current train state's structure — most commonly "
@@ -109,6 +114,37 @@ class CheckpointManager:
             batch_stats=restored["batch_stats"],
             opt_state=restored["opt_state"],
         )
+
+    @staticmethod
+    def _normalize_structure(tree):
+        """Container skeleton of a pytree in orbax-metadata-comparable
+        form: namedtuples (optax states) -> {field: ...} dicts (metadata
+        loses the namedtuple class), plain tuples/lists -> lists, empty
+        containers -> None (metadata collapses e.g. optax.EmptyState() to
+        a leaf), every leaf -> None. Verified empirically: a matching
+        adamw state normalizes equal to its saved metadata; an
+        sgd(momentum) state against an adamw checkpoint does not."""
+        n = CheckpointManager._normalize_structure
+        if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+            return {f: n(v) for f, v in zip(tree._fields, tree)} or None
+        if isinstance(tree, dict):
+            return {k: n(v) for k, v in tree.items()} or None
+        if isinstance(tree, (list, tuple)):
+            return [n(v) for v in tree] or None
+        return None
+
+    def _saved_structure_differs(self, step: int, abstract) -> bool:
+        """True when the on-disk checkpoint's pytree structure differs from
+        the tree we asked to restore into — the condition the optimizer-
+        changed guidance in restore_latest is about. Conservative: any
+        failure reading metadata returns False (the original error then
+        propagates untouched)."""
+        try:
+            meta = self._mngr.item_metadata(step).tree
+            return (self._normalize_structure(meta)
+                    != self._normalize_structure(abstract))
+        except Exception:
+            return False
 
     def close(self) -> None:
         self._mngr.close()

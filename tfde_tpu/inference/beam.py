@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from tfde_tpu.inference.decode import (
     _decode_clone,
+    _make_model_step,
     init_cache,
     validate_budget,
 )
@@ -78,13 +79,13 @@ def beam_search(
     decode_model = _decode_clone(model)
     prompt = prompt.astype(jnp.int32)
 
+    base_step = _make_model_step(decode_model, params)
+
     def model_step(cache, tokens):
-        logits, mutated = decode_model.apply(
-            {"params": params, "cache": cache}, tokens, train=False,
-            mutable=["cache"],
-        )
-        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
-        return mutated["cache"], logp  # [rows, V]
+        # decode.py's shared step + log-softmax: beam scoring is the ONE
+        # consumer that wants log-probs instead of raw logits
+        cache, logits = base_step(cache, tokens)
+        return cache, jax.nn.log_softmax(logits, axis=-1)  # [rows, V]
 
     # Prefill on [B*K, P]: all K beams of a row share the prompt, so the
     # cache starts correctly beam-expanded (a [B, P] prefill + tile of the

@@ -41,8 +41,8 @@ import numpy as np
 
 def _make_model_step(decode_model, params):
     """One decode forward: (cache, [B, S] tokens) -> (cache', last-position
-    fp32 logits). Shared by generate / generate_ragged (and closed over by
-    beam_search's log-prob variant)."""
+    fp32 logits). Shared by generate / generate_ragged; beam_search wraps
+    it with a log_softmax for joint-score accumulation."""
 
     def model_step(cache, tokens):
         logits, mutated = decode_model.apply(

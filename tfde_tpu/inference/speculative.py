@@ -31,18 +31,23 @@ TPU shape discipline:
 - Both KV caches are DONATED to the round program: XLA updates them in
   place instead of copying hundreds of MB of cache per round on the
   bandwidth-bound path the optimization exists to relieve.
-- Cache rewind is scalar surgery: rejected proposals leave stale K/V in
+- Cache rewind is index surgery: rejected proposals leave stale K/V in
   both caches, but the attention validity mask reads only `cache_index`
   (models/transformer.py), so setting the index counters back makes the
   stale entries unreachable — no cache copy, no re-prefill.
-- Batch is 1 by design: `cache_index` is shared across rows and per-row
-  acceptance lengths diverge — classic speculative decoding is a latency
-  optimization for single-stream serving (batch throughput is already
-  served by `generate`).
+- Batch > 1 rides PER-ROW cache indices: acceptance lengths diverge
+  across rows, so the rewind writes a [B] index vector and the decode
+  attention switches to per-row scatter writes + per-row validity masks
+  (models/transformer.py `_decode_attention`, vector branch). Each row's
+  committed text evolves exactly as its solo greedy run. Batch 1 keeps
+  the scalar index (cheap dynamic_update_slice writes) — speculation is
+  first a latency feature, and the batched path exists so a server can
+  fold a few concurrent streams into one round loop.
 
-Invariant between rounds: both caches hold K/V for exactly the committed
-text T[0..m) (`m` = the rewound index counters), and `tok` carries the
-last committed token T[m], generated but not yet fed.
+Invariant between rounds: both caches hold K/V for exactly row r's
+committed text T_r[0..m_r) (`m_r` = the rewound index counters, scalar
+at batch 1, [B] above), and `tok[r]` carries the last committed token,
+generated but not yet fed.
 """
 
 from __future__ import annotations
@@ -76,14 +81,16 @@ def _set_index_counters(cache, value):
 
 
 def _assemble_round(props, n_acc, pending, num_draft: int, pad_id: int):
-    """round_tokens [num_draft+1] = accepted proposals, then the pending
-    token at position n_acc, pad after — ONE definition for the greedy and
-    sampled rounds."""
-    return jnp.where(
-        jnp.arange(num_draft + 1) < n_acc,
-        jnp.concatenate([props, jnp.array([pad_id], jnp.int32)]),
-        pad_id,
-    ).at[n_acc].set(pending)
+    """round_tokens [B, num_draft+1] = row r's accepted proposals, then its
+    pending token at position n_acc[r], pad after — ONE definition for the
+    greedy and sampled rounds. props [B, num_draft], n_acc/pending [B]."""
+    b = props.shape[0]
+    ar = jnp.arange(num_draft + 1)[None, :]
+    props_ext = jnp.concatenate(
+        [props, jnp.full((b, 1), pad_id, jnp.int32)], axis=1
+    )
+    out = jnp.where(ar < n_acc[:, None], props_ext, pad_id)
+    return jnp.where(ar == n_acc[:, None], pending[:, None], out)
 
 
 def _full_step(decode_model, params, cache, tokens):
@@ -110,9 +117,10 @@ def _prefill(tgt, drf, tgt_cache, drf_cache, params, dparams, prompt):
                    donate_argnums=(2, 3))
 def _spec_round(tgt, drf, tgt_cache, drf_cache, params, dparams, tok_last,
                 num_draft, pad_id):
-    """(caches, round_tokens [num_draft+1] pad-filled, n_new, pending).
-    round_tokens[:n_new] = accepted proposals + the target's token at the
-    first disagreement (== the bonus token on full acceptance)."""
+    """(caches, round_tokens [B, num_draft+1] pad-filled, n_new [B],
+    pending [B]). round_tokens[r, :n_new[r]] = row r's accepted proposals
+    + the target's token at its first disagreement (== the bonus token on
+    full acceptance). Batch-generic: every row runs its own acceptance."""
 
     def draft_body(carry, _):
         cache, tok = carry
@@ -123,25 +131,26 @@ def _spec_round(tgt, drf, tgt_cache, drf_cache, params, dparams, tok_last,
     (drf_cache, last_prop), props = jax.lax.scan(
         draft_body, (drf_cache, tok_last), length=num_draft
     )
-    props = jnp.moveaxis(props, 0, 1)[0]  # [num_draft]
+    props = jnp.moveaxis(props, 0, 1)  # [B, num_draft]
     # feed the final proposal too: on full acceptance its K/V must be in
     # the draft cache for the next round
     drf_cache, _ = _full_step(drf, dparams, drf_cache, last_prop[:, None])
 
-    verify_in = jnp.concatenate([tok_last, props], axis=0)[None, :]
+    verify_in = jnp.concatenate([tok_last[:, None], props], axis=1)
     tgt_cache, logits = _full_step(tgt, params, tgt_cache, verify_in)
-    targets = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
-    # targets[i] = target's greedy choice after verify_in[:, :i+1];
-    # proposal i is correct iff targets[i] == props[i]
-    agree = targets[:num_draft] == props
+    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, nd+1]
+    # targets[r, i] = target's greedy choice after verify_in[r, :i+1];
+    # proposal i is correct iff targets[r, i] == props[r, i]
+    agree = targets[:, :num_draft] == props
     n_acc = jnp.where(
-        jnp.all(agree),
+        jnp.all(agree, axis=1),
         num_draft,
-        jnp.argmin(agree),  # index of the first False == True-prefix length
+        jnp.argmin(agree, axis=1),  # first False == True-prefix length
     ).astype(jnp.int32)
-    pending = targets[n_acc]  # target's own token after the prefix
+    # target's own token after each row's accepted prefix
+    pending = jnp.take_along_axis(targets, n_acc[:, None], axis=1)[:, 0]
     out = _assemble_round(props, n_acc, pending, num_draft, pad_id)
-    return tgt_cache, drf_cache, out, n_acc + 1, pending[None]
+    return tgt_cache, drf_cache, out, n_acc + 1, pending
 
 
 @functools.partial(jax.jit,
@@ -157,56 +166,67 @@ def _spec_round_sampled(tgt, drf, tgt_cache, drf_cache, params, dparams,
     replacement from the residual distribution norm(max(0, p_t - p_d)) —
     the committed tokens are then distributed EXACTLY as target-model
     sampling at this temperature (the classic correctness theorem). On
-    full acceptance the bonus token samples from p_t directly."""
+    full acceptance the bonus token samples from p_t directly. Batch-
+    generic: rows draw independent uniforms/categoricals from shared key
+    splits, so each row's committed stream is an independent exact sample
+    of the target distribution."""
     inv_t = 1.0 / temperature
 
     def draft_body(carry, rng_i):
         cache, tok = carry
         cache, logits = _full_step(drf, dparams, cache, tok[:, None])
-        logp = jax.nn.log_softmax(logits[:, -1] * inv_t, axis=-1)  # [1, V]
+        logp = jax.nn.log_softmax(logits[:, -1] * inv_t, axis=-1)  # [B, V]
         nxt = jax.random.categorical(rng_i, logp, axis=-1).astype(jnp.int32)
-        return (cache, nxt), (nxt, logp[0])
+        return (cache, nxt), (nxt, logp)
 
     rng, *step_rngs = jax.random.split(rng, num_draft + 1)
     (drf_cache, last_prop), (props, drf_logps) = jax.lax.scan(
         draft_body, (drf_cache, tok_last), jnp.stack(step_rngs)
     )
-    props = jnp.moveaxis(props, 0, 1)[0]  # [num_draft]
+    props = jnp.moveaxis(props, 0, 1)  # [B, num_draft]
+    drf_logps = jnp.moveaxis(drf_logps, 0, 1)  # [B, num_draft, V]
     drf_cache, _ = _full_step(drf, dparams, drf_cache, last_prop[:, None])
 
-    verify_in = jnp.concatenate([tok_last, props], axis=0)[None, :]
+    verify_in = jnp.concatenate([tok_last[:, None], props], axis=1)
+    b = verify_in.shape[0]
     tgt_cache, logits = _full_step(tgt, params, tgt_cache, verify_in)
-    tgt_logps = jax.nn.log_softmax(logits[0] * inv_t, axis=-1)  # [γ+1, V]
+    tgt_logps = jax.nn.log_softmax(logits * inv_t, axis=-1)  # [B, γ+1, V]
 
     # acceptance: u_i < p_t(d_i)/p_d(d_i); the first rejection truncates
     rng, u_rng, resid_rng, bonus_rng = jax.random.split(rng, 4)
-    u = jax.random.uniform(u_rng, (num_draft,))
+    u = jax.random.uniform(u_rng, (b, num_draft))
+    gather = lambda logps, ids: jnp.take_along_axis(
+        logps, ids[..., None], axis=-1
+    )[..., 0]
     ratio = jnp.exp(
-        tgt_logps[jnp.arange(num_draft), props]
-        - drf_logps[jnp.arange(num_draft), props]
+        gather(tgt_logps[:, :num_draft], props)
+        - gather(drf_logps, props)
     )
     accept = u < jnp.minimum(ratio, 1.0)
     n_acc = jnp.where(
-        jnp.all(accept), num_draft, jnp.argmin(accept)
+        jnp.all(accept, axis=1), num_draft, jnp.argmin(accept, axis=1)
     ).astype(jnp.int32)
     # replacement at the first rejection: residual max(0, p_t - p_d),
     # renormalized; on full acceptance: sample p_t at the bonus position
-    p_t = jnp.exp(tgt_logps[n_acc])
-    p_d = jnp.exp(drf_logps[jnp.minimum(n_acc, num_draft - 1)])
+    row = lambda logps, i: jnp.take_along_axis(
+        logps, i[:, None, None], axis=1
+    )[:, 0]
+    p_t = jnp.exp(row(tgt_logps, n_acc))  # [B, V]
+    p_d = jnp.exp(row(drf_logps, jnp.minimum(n_acc, num_draft - 1)))
     resid = jnp.maximum(p_t - p_d, 0.0)
-    resid_sum = jnp.sum(resid)
+    resid_sum = jnp.sum(resid, axis=-1, keepdims=True)
     # degenerate residual (p_t <= p_d everywhere numerically) -> p_t
     resid = jnp.where(resid_sum > 0, resid / jnp.maximum(resid_sum, 1e-30),
                       p_t)
     replacement = jax.random.categorical(
-        resid_rng, jnp.log(jnp.maximum(resid, 1e-30))
+        resid_rng, jnp.log(jnp.maximum(resid, 1e-30)), axis=-1
     ).astype(jnp.int32)
-    bonus = jax.random.categorical(bonus_rng, tgt_logps[num_draft]).astype(
-        jnp.int32
-    )
+    bonus = jax.random.categorical(
+        bonus_rng, tgt_logps[:, num_draft], axis=-1
+    ).astype(jnp.int32)
     pending = jnp.where(n_acc == num_draft, bonus, replacement)
     out = _assemble_round(props, n_acc, pending, num_draft, pad_id)
-    return tgt_cache, drf_cache, out, n_acc + 1, pending[None], rng
+    return tgt_cache, drf_cache, out, n_acc + 1, pending, rng
 
 
 def generate_speculative(
@@ -225,23 +245,23 @@ def generate_speculative(
 ):
     """Generation of the TARGET model, accelerated by the draft.
 
-    prompt is [1, P] int32 (single stream — see module docstring). With
-    `temperature == 0` (default) the output matches greedy
+    prompt is [B, P] int32 (rows share a prompt length; bucket or left-trim
+    ragged prompts upstream, as for `generate`). With `temperature == 0`
+    (default) each row's output matches greedy
     `generate(model, params, prompt, ...)` token for token. With
     `temperature > 0` the rounds run speculative SAMPLING: draft samples,
     the target accepts with min(1, p_t/p_d) and resamples the residual at
     the first rejection — committed tokens are distributed exactly as
     target-model sampling at that temperature, with draft quality
-    affecting only the speed. Returns (tokens [1, P + max_new_tokens],
-    lengths [1]).
+    affecting only the speed. Returns (tokens [B, P + max_new_tokens],
+    lengths [B]).
+
+    Batch 1 runs on the scalar shared cache index (cheapest writes); batch
+    > 1 rewinds per-row [B] index vectors so acceptance lengths diverge
+    independently (see module docstring). Rounds continue until every row
+    is finished; finished rows ride along with frozen indices.
     """
     b, p = prompt.shape
-    if b != 1:
-        raise ValueError(
-            f"speculative decoding is single-stream (batch 1), got batch "
-            f"{b} — cache_index is shared across rows and per-row "
-            f"acceptance diverges; use generate() for batch throughput"
-        )
     if num_draft < 1:
         raise ValueError(f"num_draft must be >= 1, got {num_draft}")
     total = validate_budget(model, p, max_new_tokens)
@@ -250,10 +270,16 @@ def generate_speculative(
     tgt = _decode_clone(model)
     drf = _decode_clone(draft_model)
     # every round feeds at most num_draft+1 tokens to each cache before the
-    # rewind, so size for the final round's overshoot
+    # rewind, so size for the final round's overshoot. Invariant (learned-
+    # position models): overshoot slots can carry positions past
+    # max_position; output stays correct because the wpe gather CLAMPS and
+    # the overshoot tokens are ALWAYS truncated host-side before commit —
+    # a change to position lookup or to the truncation below must keep
+    # both halves, or clamp the last round's num_draft to the remaining
+    # budget instead.
     cache_len = total + num_draft + 1
-    tgt_cache = init_cache(model, 1, cache_len)
-    drf_cache = init_cache(draft_model, 1, cache_len)
+    tgt_cache = init_cache(model, b, cache_len)
+    drf_cache = init_cache(draft_model, b, cache_len)
     prompt = prompt.astype(jnp.int32)
 
     sampled = temperature > 0.0
@@ -267,14 +293,31 @@ def generate_speculative(
         tok = sample_logits(first_logits, sub, temperature=temperature)
     else:
         tok = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
-    out_tokens = [int(tok[0])]
-    committed = p  # tokens whose K/V both caches hold; `tok` is pending
-    done = eos_id is not None and out_tokens[0] == eos_id
+    tok_np = np.asarray(tok)
+    out_tokens = [[int(t)] for t in tok_np]  # per-row committed stream
+    # committed[r]: tokens whose K/V both caches hold for row r; the last
+    # element of out_tokens[r] is pending (generated, not yet fed)
+    committed = np.full((b,), p, np.int64)
+    done = np.zeros((b,), bool)
+    if eos_id is not None:
+        done |= tok_np == eos_id
     rounds = 0
-    while len(out_tokens) < max_new_tokens and not done:
+
+    def _active(r):
+        return not done[r] and len(out_tokens[r]) < max_new_tokens
+
+    while any(_active(r) for r in range(b)):
         rounds += 1
-        tgt_cache = _set_index_counters(tgt_cache, committed)
-        drf_cache = _set_index_counters(drf_cache, committed)
+        # batch 1 keeps the scalar index (dynamic_update_slice writes);
+        # batch > 1 rewinds a [B] vector, flipping the decode attention to
+        # its per-row scatter branch (one extra trace on the first round)
+        # host-side values (int / np.ndarray), NOT jnp arrays: every index
+        # leaf must become its OWN device buffer — a shared jnp array would
+        # alias across the two donated cache pytrees and trip XLA's
+        # donate-the-same-buffer-twice check
+        rewind = int(committed[0]) if b == 1 else committed.astype(np.int32)
+        tgt_cache = _set_index_counters(tgt_cache, rewind)
+        drf_cache = _set_index_counters(drf_cache, rewind)
         if sampled:
             (tgt_cache, drf_cache, round_toks, n_new, tok,
              rng) = _spec_round_sampled(
@@ -286,30 +329,39 @@ def generate_speculative(
                 tgt, drf, tgt_cache, drf_cache, params, draft_params, tok,
                 num_draft, pad_id,
             )
-        toks = np.asarray(round_toks)[: int(n_new)].tolist()
-        if eos_id is not None and eos_id in toks:
-            toks = toks[: toks.index(eos_id) + 1]
-            done = True
-        toks = toks[: max_new_tokens - len(out_tokens)]
-        committed += len(toks)  # tok_last + accepted (pending stays unfed)
-        out_tokens.extend(toks)
-        tok = jnp.asarray([out_tokens[-1]], jnp.int32)
+        round_np = np.asarray(round_toks)  # [B, num_draft+1]
+        n_np = np.asarray(n_new)
+        for r in range(b):
+            if not _active(r):
+                continue
+            toks = round_np[r, : int(n_np[r])].tolist()
+            if eos_id is not None and eos_id in toks:
+                toks = toks[: toks.index(eos_id) + 1]
+                done[r] = True
+            toks = toks[: max_new_tokens - len(out_tokens[r])]
+            committed[r] += len(toks)  # tok_last + accepted (pending unfed)
+            out_tokens[r].extend(toks)
+        tok = jnp.asarray([row[-1] for row in out_tokens], jnp.int32)
 
-    new = np.full((max_new_tokens,), pad_id, np.int64)
-    new[: len(out_tokens)] = out_tokens
-    tokens = np.concatenate([np.asarray(prompt)[0], new]).astype(np.int32)
-    lengths = np.asarray([p + len(out_tokens)], np.int32)
+    new = np.full((b, max_new_tokens), pad_id, np.int64)
+    for r in range(b):
+        new[r, : len(out_tokens[r])] = out_tokens[r]
+    tokens = np.concatenate([np.asarray(prompt), new], axis=1).astype(
+        np.int32
+    )
+    lengths = np.asarray([p + len(row) for row in out_tokens], np.int32)
     if return_stats:
-        generated = len(out_tokens)
+        generated = sum(len(row) for row in out_tokens)
         stats = {
             "rounds": rounds,
             "generated": generated,
-            # the prefill contributes the first token without a round; a
-            # run with zero rounds reports 0.0 (no acceptance information),
-            # never a fake 1.0 that would skew a dashboard's average
+            # the prefill contributes each row's first token without a
+            # round; a run with zero rounds reports 0.0 (no acceptance
+            # information), never a fake 1.0 that would skew a dashboard's
+            # average. Batch > 1 averages over rows (rows share rounds).
             "tokens_per_round": (
-                (generated - 1) / rounds if rounds else 0.0
+                (generated - b) / (rounds * b) if rounds else 0.0
             ),
         }
-        return tokens[None], lengths, stats
-    return tokens[None], lengths
+        return tokens, lengths, stats
+    return tokens, lengths

@@ -90,9 +90,13 @@ class GPT(nn.Module):
                 pos_index = self.variable("cache", "position_index",
                                           lambda: jnp.zeros((), jnp.int32))
                 if is_filled and not self.is_initializing():
-                    positions = pos_index.value + positions
+                    # scalar index -> positions [S]; per-row [B] index (the
+                    # batched-speculation rewind, inference/speculative.py)
+                    # broadcasts to [B, S]
+                    positions = pos_index.value[..., None] + positions
                     pos_index.value = pos_index.value + seq
-            x = x + wpe(positions[None, :])
+            x = x + wpe(positions if positions.ndim == 2
+                        else positions[None, :])
         x = constrain(x, b, "seq")
         if self.dropout_rate > 0.0:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)

@@ -143,10 +143,14 @@ class MultiHeadAttention(nn.Module):
         """Rotary q/k rotation at absolute positions start + [0, S) — the
         ONE rotation site for the train forward and both decode paths. A
         cached key's rotation is fixed at write time, so each call rotates
-        only its own tokens."""
+        only its own tokens. `start` is a scalar (shared cache index) or
+        [B] (per-row indices, the batched-speculation path): both broadcast
+        to per-token positions [S] / [B, S], which apply_rotary accepts."""
         if not self.rope:
             return q, k
-        pos = start + jnp.arange(q.shape[1], dtype=jnp.int32)
+        pos = jnp.asarray(start, jnp.int32)[..., None] + jnp.arange(
+            q.shape[1], dtype=jnp.int32
+        )  # scalar -> [S] (shape-(1,) start broadcasts away), [B] -> [B, S]
         return (apply_rotary(q, pos, self.rope_theta),
                 apply_rotary(k, pos, self.rope_theta))
 
@@ -185,24 +189,48 @@ class MultiHeadAttention(nn.Module):
             )
         idx = cache_index.value
         q, k = self._rotate(q, k, idx)
-        k_all = jax.lax.dynamic_update_slice(
-            cached_key.value, k.astype(cached_key.value.dtype), (0, idx, 0, 0)
-        )
-        v_all = jax.lax.dynamic_update_slice(
-            cached_value.value, v.astype(cached_value.value.dtype),
-            (0, idx, 0, 0)
-        )
+        if idx.ndim == 0:
+            # shared index (generate / batch-1 speculation): one cheap
+            # dynamic_update_slice covers every row
+            k_all = jax.lax.dynamic_update_slice(
+                cached_key.value, k.astype(cached_key.value.dtype),
+                (0, idx, 0, 0)
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                cached_value.value, v.astype(cached_value.value.dtype),
+                (0, idx, 0, 0)
+            )
+            # [1, 1, Sq, max_len]: query (position idx+i) sees kv j<=idx+i
+            pos_q = idx + jnp.arange(sq, dtype=jnp.int32)
+            valid = (jnp.arange(max_len, dtype=jnp.int32)[None, :]
+                     <= pos_q[:, None])[None, None]
+        else:
+            # per-row indices [B] (batched speculation, inference/
+            # speculative.py: acceptance lengths diverge across rows, so
+            # each row writes at its own offset). vmapping the update
+            # slice over rows gives per-row starts and lowers to an
+            # in-place scatter of just the sq new tokens — no full-cache
+            # rewrite on the bandwidth-bound decode path.
+            write = jax.vmap(
+                lambda cache, new, i: jax.lax.dynamic_update_slice(
+                    cache, new, (i, 0, 0)
+                )
+            )
+            k_all = write(cached_key.value,
+                          k.astype(cached_key.value.dtype), idx)
+            v_all = write(cached_value.value,
+                          v.astype(cached_value.value.dtype), idx)
+            # [B, 1, Sq, max_len]: row b's query i sits at idx[b]+i
+            pos_w = idx[:, None] + jnp.arange(sq, dtype=jnp.int32)  # [B,sq]
+            valid = (jnp.arange(max_len, dtype=jnp.int32)[None, None, :]
+                     <= pos_w[:, :, None])[:, None]
         cached_key.value = constrain(k_all, batch, None, "tensor")
         cached_value.value = constrain(v_all, batch, None, "tensor")
         cache_index.value = idx + sq
-        # [1, 1, Sq, max_len]: query (global position idx+i) sees kv j<=idx+i
-        pos_q = idx + jnp.arange(sq, dtype=jnp.int32)
-        valid = jnp.arange(max_len, dtype=jnp.int32)[None, :] <= pos_q[:, None]
         # grouped_attention == reference_attention at kv_heads == num_heads;
         # with GQA the kv_heads-shaped cache feeds the einsum directly (no
         # expanded copy on the bandwidth-bound decode path)
-        return attn_lib.grouped_attention(q, k_all, v_all,
-                                          mask=valid[None, None])
+        return attn_lib.grouped_attention(q, k_all, v_all, mask=valid)
 
 
 class Mlp(nn.Module):

@@ -319,8 +319,32 @@ class Estimator:
         if custom:
             # custom batches are arbitrary pytrees: no (images, labels)
             # padding protocol — feed them as produced (drop_remainder
-            # batching upstream keeps shapes static)
-            feed = device_prefetch(iter(input_fn()), strat.mesh)
+            # batching upstream keeps shapes static). Validate leading-dim
+            # divisibility per batch so a trailing partial batch fails with
+            # the cause named instead of an opaque sharding error inside
+            # device_put/jit.
+            def _checked(it, divisor):
+                for i, b in enumerate(it):
+                    if divisor > 1:
+                        for leaf in jax.tree_util.tree_leaves(b):
+                            if not getattr(leaf, "ndim", 0):
+                                continue  # scalars carry no batch dim
+                            if leaf.shape[0] % divisor:
+                                raise ValueError(
+                                    f"evaluate[{name}]: batch {i} has a "
+                                    f"leaf with leading dim "
+                                    f"{leaf.shape[0]}, not divisible by "
+                                    f"the strategy's batch divisor "
+                                    f"{divisor}. The usual cause is a "
+                                    f"trailing partial batch — batch the "
+                                    f"eval input_fn with "
+                                    f"drop_remainder=True, or pad it"
+                                )
+                    yield b
+
+            feed = device_prefetch(
+                _checked(iter(input_fn()), strat.batch_divisor), strat.mesh
+            )
         else:
             divisor = strat.batch_divisor
             padded = (pad_batch_for_mesh(b, divisor) for b in input_fn())
