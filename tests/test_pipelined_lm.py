@@ -347,3 +347,171 @@ def test_pp_with_seq_axis_rejected(tokens):
     )
     with pytest.raises(ValueError, match="SequenceParallelStrategy"):
         init_state(pipelined_tiny_test(), optax.adam(1e-3), strat, tokens)
+
+
+# --------------------------------------------------------------------------
+# 1F1B schedule (parallel/pipeline.pipeline_train_1f1b)
+# --------------------------------------------------------------------------
+
+def test_1f1b_loss_and_grads_match_gpipe(model, tokens):
+    """The hand-scheduled 1F1B backward must produce the SAME loss and
+    gradients as AD through the GPipe forward (both compute exact math;
+    only summation order differs -> fp32 tolerance)."""
+    from tfde_tpu.parallel import axes as axes_lib
+
+    m_1f1b = pipelined_tiny_test(schedule="1f1b")
+    variables = model.init(jax.random.key(0), tokens)
+    mesh = make_mesh({"data": 2, "pipe": 2}, jax.devices()[:4])
+
+    def loss_with(mdl):
+        def f(params):
+            with axes_lib.use_axes(mesh):
+                loss, _ = mdl.loss_and_metrics(
+                    {"params": params}, tokens, train=True
+                )
+            return loss
+        return f
+
+    v_g, g_g = jax.jit(jax.value_and_grad(loss_with(model)))(
+        variables["params"]
+    )
+    v_1, g_1 = jax.jit(jax.value_and_grad(loss_with(m_1f1b)))(
+        variables["params"]
+    )
+    np.testing.assert_allclose(float(v_1), float(v_g), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        ),
+        g_1, g_g,
+    )
+
+
+def test_1f1b_train_matches_dp(tokens):
+    """5 Adam steps through the 1F1B schedule at pipe=2 x data=2 == plain
+    DP at data=4 — the same oracle as the GPipe path (VERDICT r3 #5 'done'
+    bar)."""
+    from tfde_tpu.models.gpt import next_token_loss
+    from tfde_tpu.models.pipelined import pipelined_next_token_loss
+
+    m_1f1b = pipelined_tiny_test(schedule="1f1b")
+    strat_p = PipelineParallelStrategy(data=2, pipe=2)
+    state_p, _ = init_state(m_1f1b, optax.adam(1e-3), strat_p, tokens)
+    step_p = make_custom_train_step(strat_p, state_p,
+                                    pipelined_next_token_loss, donate=False)
+
+    strat_d = MultiWorkerMirroredStrategy(
+        make_mesh({"data": 4}, jax.devices()[:4])
+    )
+    plain = pipelined_tiny_test()  # sequential fallback on the DP mesh
+    state_d, _ = init_state(plain, optax.adam(1e-3), strat_d, tokens)
+    step_d = make_custom_train_step(strat_d, state_d, next_token_loss,
+                                    donate=False)
+
+    rng = jax.random.key(0)
+    for _ in range(5):
+        state_p, m_p = step_p(state_p, (tokens,), rng)
+        state_d, m_d = step_d(state_d, (tokens,), rng)
+    np.testing.assert_allclose(
+        float(m_p["loss"]), float(m_d["loss"]), rtol=2e-5
+    )
+    assert float(m_p["loss"]) < 4.6
+
+
+def test_1f1b_single_stage_direct():
+    """Degenerate S=1 of pipeline_train_1f1b called directly (the model
+    path falls back to the sequential stack at pipe=1, so the schedule's
+    S=1 edge — stash_n=1, ticks=M, last rank == rank 0 — only gets
+    coverage here)."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.parallel.pipeline import pipeline_train_1f1b
+
+    mesh = make_mesh({"data": 1, "pipe": 1}, jax.devices()[:1])
+    rng = np.random.default_rng(2)
+    stacked = jnp.asarray(rng.normal(size=(1, 3)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(4, 2, 3)), jnp.float32)
+    aux = jnp.asarray(rng.normal(size=(4, 2, 3)), jnp.float32)
+    extra = jnp.asarray(rng.normal(size=(3,)), jnp.float32)
+
+    def stage_fn(p, h):
+        return jnp.tanh(h * p)
+
+    def loss_fn(e, y, a):
+        return {"loss_sum": jnp.sum(e * y * a),
+                "count": jnp.asarray(y.size, jnp.float32)}
+
+    sums, grads = jax.jit(lambda s, xx, a, e: pipeline_train_1f1b(
+        stage_fn, s, xx, mesh, loss_fn=loss_fn, loss_aux=a, extra_params=e
+    ))(stacked, x, aux, extra)
+
+    def ref(s, xx, e):
+        return jnp.sum(e * jnp.tanh(xx * s[0]) * aux)
+
+    v, (g_s, g_x, g_e) = jax.value_and_grad(ref, argnums=(0, 1, 2))(
+        stacked, x, extra
+    )
+    np.testing.assert_allclose(float(sums["loss_sum"]), float(v), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads["stages"]), np.asarray(g_s),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["x"]), np.asarray(g_x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["extra"]), np.asarray(g_e),
+                               rtol=1e-5)
+
+
+def test_1f1b_many_microbatches(tokens):
+    """M > 2S runs the schedule correctly (steady-state dominates)."""
+    from tfde_tpu.parallel import axes as axes_lib
+
+    m8 = pipelined_tiny_test(schedule="1f1b", microbatches=8)
+    g8 = pipelined_tiny_test(microbatches=8)
+    variables = m8.init(jax.random.key(1), tokens)
+    mesh = make_mesh({"data": 1, "pipe": 2}, jax.devices()[:2])
+
+    def loss_fn(mdl):
+        def f(params):
+            with axes_lib.use_axes(mesh):
+                loss, _ = mdl.loss_and_metrics(
+                    {"params": params}, tokens, train=True
+                )
+            return loss
+        return f
+
+    v_1, g_1 = jax.jit(jax.value_and_grad(loss_fn(m8)))(variables["params"])
+    v_g, g_g = jax.jit(jax.value_and_grad(loss_fn(g8)))(variables["params"])
+    np.testing.assert_allclose(float(v_1), float(v_g), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        ),
+        g_1, g_g,
+    )
+
+
+def test_1f1b_dropout_trains(tokens):
+    """Dropout keys pass through the custom_vjp as an explicit argument;
+    masks reproduce between the fwd slot and the bwd recompute, so training
+    stays finite and deterministic per seed."""
+    from tfde_tpu.models.pipelined import pipelined_next_token_loss
+
+    model = pipelined_tiny_test(schedule="1f1b", dropout_rate=0.3)
+    strat = PipelineParallelStrategy(data=2, pipe=2)
+    state, _ = init_state(model, optax.adam(1e-3), strat, tokens)
+    step = make_custom_train_step(strat, state, pipelined_next_token_loss,
+                                  donate=False)
+    state, m = step(state, (tokens,), jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_1f1b_refused_with_tensor_axis(tokens):
+    """dp x pp x tp uses AD for its backward; 1F1B must refuse loudly."""
+    m = pipelined_tiny_test(schedule="1f1b")
+    strat = PipelineParallelStrategy(data=2, pipe=2, tensor=2)
+    state, _ = init_state(m, optax.adam(1e-3), strat, tokens)
+    from tfde_tpu.models.pipelined import pipelined_next_token_loss
+
+    step = make_custom_train_step(strat, state, pipelined_next_token_loss,
+                                  donate=False)
+    with pytest.raises(NotImplementedError, match="1f1b"):
+        step(state, (tokens,), jax.random.key(0))

@@ -1,0 +1,249 @@
+"""Streaming TFRecord input (data/streaming.py): file-backed shuffle/
+repeat/batch with bounded memory — the tf.data `TFRecordDataset ->
+shuffle -> batch -> prefetch` composition (SURVEY.md §2b row 3)."""
+
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.data.streaming import StreamingTFRecordLoader, shard_files
+from tfde_tpu.data.tfrecord import write_tfrecord
+
+
+def _write_shards(tmp_path, n_files, rows_per_file, dim=4):
+    """Each record: <i32 id><dim f32 features deterministic in id>."""
+    paths = []
+    rid = 0
+    for f in range(n_files):
+        recs = []
+        for _ in range(rows_per_file):
+            feat = (np.arange(dim, dtype=np.float32) + rid).tobytes()
+            recs.append(struct.pack("<i", rid) + feat)
+            rid += 1
+        p = str(tmp_path / f"part-{f:03d}.tfrecord")
+        write_tfrecord(p, recs)
+        paths.append(p)
+    return paths, rid
+
+
+def _parse(dim=4):
+    def parse(rec):
+        (i,) = struct.unpack("<i", rec[:4])
+        feat = np.frombuffer(rec[4:], np.float32)
+        return np.int32(i), feat
+
+    return parse
+
+
+def test_one_epoch_exact_multiset(tmp_path):
+    paths, n = _write_shards(tmp_path, 3, 40)
+    loader = StreamingTFRecordLoader(
+        paths, _parse(), batch_size=16, window=32, seed=1, repeat=1
+    )
+    ids, feats = [], []
+    for i, f in loader:
+        ids.extend(i.tolist())
+        feats.append(f.copy())
+    assert sorted(ids) == list(range(n))
+    # features stay paired with their ids through the shuffle
+    feats = np.concatenate(feats)
+    for row_id, row in zip(ids, feats):
+        np.testing.assert_array_equal(
+            row, np.arange(4, dtype=np.float32) + row_id
+        )
+
+
+def test_final_partial_batch_and_drop_remainder(tmp_path):
+    paths, n = _write_shards(tmp_path, 1, 37)
+    kept = list(
+        StreamingTFRecordLoader(paths, _parse(), batch_size=8, window=16,
+                                repeat=1)
+    )
+    assert sum(b[0].shape[0] for b in kept) == 37
+    assert kept[-1][0].shape[0] == 37 % 8
+    dropped = list(
+        StreamingTFRecordLoader(paths, _parse(), batch_size=8, window=16,
+                                repeat=1, drop_remainder=True)
+    )
+    assert all(b[0].shape[0] == 8 for b in dropped)
+    assert sum(b[0].shape[0] for b in dropped) == 37 - 37 % 8
+
+
+def test_shuffle_windowed_and_seeded(tmp_path):
+    paths, n = _write_shards(tmp_path, 2, 64)
+    run = lambda seed: [
+        i for b in StreamingTFRecordLoader(
+            paths, _parse(), batch_size=16, window=64, seed=seed, repeat=1
+        ) for i in b[0].tolist()
+    ]
+    a, b, c = run(5), run(5), run(6)
+    assert a == b  # deterministic per seed
+    assert a != c  # seed moves the order
+    assert a != sorted(a)  # actually shuffled
+    assert sorted(a) == list(range(n))
+
+
+def test_infinite_repeat_reshuffles_epochs(tmp_path):
+    """window < dataset: per-epoch exactness holds ONLY because windows
+    flush at epoch boundaries — a window spanning epochs would let an
+    epoch-2 record displace an epoch-1 straggler out of the first n."""
+    paths, n = _write_shards(tmp_path, 2, 32)
+    loader = StreamingTFRecordLoader(
+        paths, _parse(), batch_size=16, window=48, seed=3, repeat=None
+    )
+    seen = [next(loader)[0].tolist() for _ in range(12)]  # 3 epochs
+    flat = [i for b in seen for i in b]
+    assert sorted(flat[:n]) == list(range(n))
+    assert sorted(flat[n : 2 * n]) == list(range(n))
+    assert flat[:n] != flat[n : 2 * n]  # reshuffled across epochs
+    loader.close()
+
+
+def test_shard_files_round_robin():
+    paths = [f"p{i}" for i in range(7)]
+    assert shard_files(paths, 0, 3) == ["p0", "p3", "p6"]
+    assert shard_files(paths, 2, 3) == ["p2", "p5"]
+    union = sorted(sum((shard_files(paths, h, 3) for h in range(3)), []))
+    assert union == sorted(paths)
+    with pytest.raises(ValueError, match="file-shard"):
+        shard_files(paths[:2], 0, 3)
+    with pytest.raises(ValueError, match="host_index"):
+        shard_files(paths, 3, 3)
+
+
+def test_hosts_partition_records(tmp_path):
+    paths, n = _write_shards(tmp_path, 4, 16)
+    all_ids = []
+    for h in range(2):
+        ids = [
+            i for b in StreamingTFRecordLoader(
+                paths, _parse(), batch_size=8, window=32, repeat=1,
+                host_index=h, host_count=2,
+            ) for i in b[0].tolist()
+        ]
+        assert len(ids) == n // 2
+        all_ids.extend(ids)
+    assert sorted(all_ids) == list(range(n))
+
+
+def test_corrupt_record_surfaces_in_consumer(tmp_path):
+    paths, _ = _write_shards(tmp_path, 1, 8)
+    raw = bytearray(open(paths[0], "rb").read())
+    raw[20] ^= 0xFF
+    open(paths[0], "wb").write(bytes(raw))
+    loader = StreamingTFRecordLoader(paths, _parse(), batch_size=4,
+                                     window=8, repeat=1)
+    with pytest.raises(ValueError, match="crc"):
+        list(loader)
+
+
+def test_bad_args(tmp_path):
+    paths, _ = _write_shards(tmp_path, 1, 8)
+    with pytest.raises(ValueError, match="window"):
+        StreamingTFRecordLoader(paths, _parse(), batch_size=16, window=8)
+    with pytest.raises(ValueError, match="at least one"):
+        StreamingTFRecordLoader([], _parse(), batch_size=4)
+    with pytest.raises(ValueError, match="together"):
+        StreamingTFRecordLoader(paths, _parse(), batch_size=4, host_index=0)
+
+
+def test_streaming_to_device_training(tmp_path):
+    """The full file->chip path: TFRecord shards stream through the loader
+    and device_prefetch into a sharded train step; loss falls."""
+    import optax
+
+    from tfde_tpu.data.device import device_prefetch
+    from tfde_tpu.models.cnn import PlainCNN
+    from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
+    from tfde_tpu.training.step import init_state, make_train_step
+
+    rng = np.random.default_rng(0)
+    # learnable structure: label = brightest quadrant
+    imgs = rng.uniform(0, 0.3, (256, 28, 28, 1)).astype(np.float32)
+    labels = rng.integers(0, 4, 256).astype(np.int32)
+    for k in range(256):
+        q = labels[k]
+        imgs[k, (q // 2) * 14 : (q // 2) * 14 + 14,
+             (q % 2) * 14 : (q % 2) * 14 + 14] += 0.7
+    recs = [
+        imgs[k].tobytes() + struct.pack("<i", labels[k]) for k in range(256)
+    ]
+    path = str(tmp_path / "train.tfrecord")
+    write_tfrecord(path, recs)
+
+    def parse(rec):
+        img = np.frombuffer(rec[:-4], np.float32).reshape(28, 28, 1)
+        (lab,) = struct.unpack("<i", rec[-4:])
+        return img, np.asarray([lab], np.int32)
+
+    strat = MultiWorkerMirroredStrategy()
+    state, _ = init_state(
+        PlainCNN(num_classes=4), optax.sgd(0.1, momentum=0.9), strat,
+        jnp.zeros((16, 28, 28, 1)),
+    )
+    step = make_train_step(strat, state)
+    loader = StreamingTFRecordLoader(
+        path, parse, batch_size=16, window=64, seed=0
+    )
+    key = jax.random.key(0)
+    losses = []
+    for i, batch in zip(range(60), device_prefetch(loader, strat.mesh)):
+        state, m = step(state, batch, key)
+        losses.append(float(m["loss"]))
+    loader.close()
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_streaming_throughput_not_pathological(tmp_path):
+    """Host-throughput sanity vs the in-memory native loader on identical
+    data. With 256-byte records the stream path is bounded by per-record
+    Python (framing + parse_fn), ~165k rec/s on this host once the CRC
+    runs natively (native/loader.cc tfde_crc32c; the Python CRC loop was
+    13k rec/s) — the per-record overhead amortizes at the KB-to-100KB
+    record sizes real image/token shards use. This guards the floor and
+    the ratio against an accidental O(n^2), a serialization stall, or the
+    CRC silently falling back to Python."""
+    import time
+
+    rng = np.random.default_rng(0)
+    imgs = rng.standard_normal((2048, 64), dtype=np.float32)
+    recs = [imgs[k].tobytes() for k in range(2048)]
+    path = str(tmp_path / "tp.tfrecord")
+    write_tfrecord(path, recs)
+    parse = lambda rec: (np.frombuffer(rec, np.float32),)
+
+    def time_stream():
+        loader = StreamingTFRecordLoader(path, parse, batch_size=128,
+                                         window=512, repeat=4)
+        t0 = time.perf_counter()
+        n = sum(b[0].shape[0] for b in loader)
+        return n / (time.perf_counter() - t0)
+
+    def time_mem():
+        from tfde_tpu import native
+
+        if not native.available():
+            from tfde_tpu.data.pipeline import Dataset
+
+            src = (Dataset.from_tensor_slices((imgs,))
+                   .shuffle(2048, seed=0).repeat(4).batch(128))
+            t0 = time.perf_counter()
+            n = sum(b[0].shape[0] for b in iter(src))
+            return n / (time.perf_counter() - t0)
+        ldr = native.NativeBatchLoader([imgs], 128, repeat=4)
+        t0 = time.perf_counter()
+        n = sum(b[0].shape[0] for b in ldr)
+        return n / (time.perf_counter() - t0)
+
+    stream_rps, mem_rps = time_stream(), time_mem()
+    # relative-only guard plus a floor far below healthy throughput
+    # (~165k rec/s measured): catches regressions of 10x+ without flaking
+    # on contended CI hosts
+    from tfde_tpu import native
+
+    floor = 15_000 if native.available() else 2_000
+    assert stream_rps > floor, (stream_rps, mem_rps)
+    assert stream_rps * 300 > mem_rps, (stream_rps, mem_rps)

@@ -15,3 +15,7 @@ from tfde_tpu.data.tfrecord import (  # noqa: F401
     tfrecord_dataset,
     write_tfrecord,
 )
+from tfde_tpu.data.streaming import (  # noqa: F401
+    StreamingTFRecordLoader,
+    shard_files,
+)

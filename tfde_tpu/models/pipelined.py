@@ -81,6 +81,11 @@ class PipelinedLM:
     # x tp) when the mesh has a >1 'tensor' axis, the proven fully-'manual'
     # ring otherwise. Set explicitly to force either.
     pipeline_mode: Optional[str] = None
+    # backward schedule for the training loss path: 'gpipe' (AD through the
+    # forward ring — activation memory O(M + S) per rank) or '1f1b'
+    # (pipeline_train_1f1b: explicit fwd/bwd interleave, memory O(S) with
+    # stage-input remat; manual mode only — see parallel/pipeline.py).
+    schedule: str = "gpipe"
 
     @property
     def depth(self) -> int:
@@ -354,9 +359,12 @@ class PipelinedLM:
         head = self._head
 
         def reduce_fn(extra, outputs, labels_loc):
-            # outputs [M, micro_local, seq, H]; labels_loc [M, micro_local,
-            # seq-1]. Per-shard SUMS (pipeline_apply psums them globally).
-            logits = head(extra, outputs)[:, :, :-1]
+            # outputs [..., micro_local, seq, H]; labels_loc [...,
+            # micro_local, seq-1] — the leading dims are [M] on the GPipe
+            # full-buffer reduction and absent on the 1F1B per-microbatch
+            # loss, so slicing is ellipsis-based. Per-shard SUMS
+            # (the pipeline psums them globally).
+            logits = head(extra, outputs)[..., :-1, :]
             import optax
 
             per_tok = optax.losses.softmax_cross_entropy_with_integer_labels(
@@ -369,15 +377,83 @@ class PipelinedLM:
                 "count": jnp.asarray(per_tok.size, jnp.float32),
             }
 
-        red = pipeline_apply(
-            self._make_stage_fn(train, base_key, mesh), p["stages"], xm, mesh,
-            reduce_fn=reduce_fn, reduce_aux=labels_m, extra_params=extra,
-            mode=self._pipe_mode(mesh),
-        )
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got {self.schedule!r}"
+            )
+        mode = self._pipe_mode(mesh)
+        if self.schedule == "1f1b":
+            if mode != "manual":
+                raise NotImplementedError(
+                    "schedule='1f1b' runs in the fully-manual ring only; "
+                    "the partial-manual 'tensor' mode (dp x pp x tp) uses "
+                    "AD for its backward — use schedule='gpipe' there"
+                )
+            red = _sums_1f1b(self, mesh, reduce_fn, train)(
+                p["stages"], extra, xm, labels_m, base_key
+            )
+        else:
+            red = pipeline_apply(
+                self._make_stage_fn(train, base_key, mesh), p["stages"],
+                xm, mesh, reduce_fn=reduce_fn, reduce_aux=labels_m,
+                extra_params=extra, mode=mode,
+            )
         denom = jnp.maximum(red["count"], 1.0)
         loss = red["loss_sum"] / denom
         acc = red["correct_sum"] / denom
         return loss, {"next_token_accuracy": acc}
+
+
+def _sums_1f1b(model: "PipelinedLM", mesh, loss_fn, train: bool):
+    """custom_vjp around the pipelined loss sums so jax.grad composes with
+    the hand-scheduled 1F1B backward (parallel/pipeline.pipeline_train_1f1b):
+
+    - primal (no differentiation, e.g. eval loss): the cheap forward-only
+      GPipe pass — identical sums, no gradient work.
+    - fwd rule (under jax.grad): ONE 1F1B pass computes the sums AND the
+      gradients; the grads ride the residuals.
+    - bwd rule: scales the stored grads by the loss_sum cotangent. The
+      other sums (count, correct_sum) are shape-constants / argmax metrics
+      with zero derivative a.e. — their cotangents are ignored.
+
+    The dropout key is an explicit argument (not a closure): custom_vjp
+    functions must not close over tracers, and the key is traced inside a
+    jitted train step.
+    """
+    import numpy as np
+
+    def stage_of(key):
+        return model._make_stage_fn(train, key, mesh)
+
+    @jax.custom_vjp
+    def sums(stages, extra, xm, labels_m, key):
+        return pipeline_apply(
+            stage_of(key), stages, xm, mesh, reduce_fn=loss_fn,
+            reduce_aux=labels_m, extra_params=extra, mode="manual",
+        )
+
+    def fwd(stages, extra, xm, labels_m, key):
+        from tfde_tpu.parallel.pipeline import pipeline_train_1f1b
+
+        s, grads = pipeline_train_1f1b(
+            stage_of(key), stages, xm, mesh, loss_fn=loss_fn,
+            loss_aux=labels_m, extra_params=extra,
+        )
+        return s, (grads, labels_m, key)
+
+    def bwd(res, ct):
+        grads, labels_m, key = res
+        scale = ct["loss_sum"]
+        sc = lambda t: jax.tree_util.tree_map(
+            lambda g: (g * scale).astype(g.dtype), t
+        )
+        key_ct = (None if key is None
+                  else np.zeros(np.shape(key), jax.dtypes.float0))
+        return (sc(grads["stages"]), sc(grads["extra"]), sc(grads["x"]),
+                np.zeros(labels_m.shape, jax.dtypes.float0), key_ct)
+
+    sums.defvjp(fwd, bwd)
+    return sums
 
 
 def pipelined_next_token_loss(state, params, batch, rng):

@@ -72,6 +72,8 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             lib.tfde_loader_release.argtypes = [ctypes.c_void_p]
             lib.tfde_loader_stop.argtypes = [ctypes.c_void_p]
             lib.tfde_loader_destroy.argtypes = [ctypes.c_void_p]
+            lib.tfde_crc32c.restype = ctypes.c_uint32
+            lib.tfde_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64]
             _lib = lib
         except Exception as e:  # no toolchain / build error -> python fallback
             log.warning("native loader unavailable (%s); using python pipeline", e)
@@ -81,6 +83,17 @@ def _get_lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _get_lib() is not None
+
+
+def crc32c(data: bytes) -> Optional[int]:
+    """Native crc32c (Castagnoli), or None when the library is unavailable
+    (caller falls back to the Python table walk). ~100x the Python loop —
+    the difference between a CRC-checked streaming TFRecord reader being
+    IO-bound and being checksum-bound (tests/test_streaming.py)."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    return int(lib.tfde_crc32c(data, len(data)))
 
 
 class NativeBatchLoader:

@@ -264,6 +264,44 @@ void tfde_loader_stop(void* handle) {
   for (auto& s : L->slots) s.cv.notify_all();
 }
 
+// crc32c (Castagnoli) — slice-by-8 table walk. The TFRecord framing CRC is
+// the decode-path bottleneck in Python (measured 13k rec/s table loop vs
+// 1M rec/s for everything else, tests/test_streaming.py); at C speed the
+// check is effectively free, so streaming readers keep corruption
+// detection on.
+static uint32_t crc_tables[8][256];
+static bool crc_init_done = []() {
+  const uint32_t poly = 0x82F63B78u;
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+    crc_tables[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; ++i)
+    for (int t = 1; t < 8; ++t)
+      crc_tables[t][i] =
+          crc_tables[0][crc_tables[t - 1][i] & 0xFF] ^ (crc_tables[t - 1][i] >> 8);
+  return true;
+}();
+
+uint32_t tfde_crc32c(const uint8_t* data, int64_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  const uint8_t* p = data;
+  while (n >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    w ^= c;  // little-endian hosts only (this toolchain's targets)
+    c = crc_tables[7][w & 0xFF] ^ crc_tables[6][(w >> 8) & 0xFF] ^
+        crc_tables[5][(w >> 16) & 0xFF] ^ crc_tables[4][(w >> 24) & 0xFF] ^
+        crc_tables[3][(w >> 32) & 0xFF] ^ crc_tables[2][(w >> 40) & 0xFF] ^
+        crc_tables[1][(w >> 48) & 0xFF] ^ crc_tables[0][(w >> 56) & 0xFF];
+    p += 8;
+    n -= 8;
+  }
+  while (n-- > 0) c = crc_tables[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
 void tfde_loader_destroy(void* handle) {
   auto* L = (Loader*)handle;
   L->stop.store(true);

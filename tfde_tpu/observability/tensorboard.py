@@ -43,7 +43,23 @@ def _build_table() -> None:
 _build_table()
 
 
+_native_crc = None  # resolved lazily; False = probed and unavailable
+
+
 def crc32c(data: bytes) -> int:
+    # the native slice-by-8 crc (native/loader.cc) is ~100x this table
+    # walk — load-bearing for the streaming TFRecord reader, where the
+    # Python loop was the decode bottleneck (tests/test_streaming.py)
+    global _native_crc
+    if _native_crc is None:
+        try:
+            from tfde_tpu import native as _native
+
+            _native_crc = _native.crc32c if _native.available() else False
+        except Exception:
+            _native_crc = False
+    if _native_crc:
+        return _native_crc(data)
     c = 0xFFFFFFFF
     for b in data:
         c = _CRC_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
