@@ -35,6 +35,8 @@ import sys as _sys
 # root (the directory holding tfde_tpu/) ahead of the script dir
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
+import jax.numpy as jnp
+
 from tfde_tpu import bootstrap
 from tfde_tpu.data import Dataset, datasets
 from tfde_tpu.export.serving import FinalExporter
@@ -42,6 +44,7 @@ from tfde_tpu.models.cnn import BatchNormCNN
 from tfde_tpu.observability.tb_server import start_tensorboard
 from tfde_tpu.parallel.strategies import ParameterServerStrategy
 from tfde_tpu.training import Estimator, EvalSpec, RunConfig, TrainSpec, train_and_evaluate
+from tfde_tpu.utils import model_summary
 
 
 def get_args(argv=None):
@@ -104,8 +107,11 @@ def train_and_evaluate_main(args):
         log_step_count_steps=100,
         save_checkpoints_steps=500,
     )
+    model = BatchNormCNN()
+    # the reference prints model.summary() before training (mnist_keras:117)
+    print(model_summary(model, jnp.zeros((args.batch_size, 28 * 28))))
     est = Estimator(
-        BatchNormCNN(),
+        model,
         optax.sgd(args.learning_rate),
         strategy=ParameterServerStrategy(),
         config=run_config,

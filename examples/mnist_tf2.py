@@ -42,6 +42,7 @@ from tfde_tpu.models.cnn import BatchNormCNN, PlainCNN
 from tfde_tpu.parallel.strategies import ParameterServerStrategy
 from tfde_tpu.training import Estimator, EvalSpec, RunConfig, TrainSpec, train_and_evaluate
 from tfde_tpu.training.step import init_state, make_train_step
+from tfde_tpu.utils import model_summary
 
 BATCH_SIZE = 128       # tf2_mnist:33
 BUFFER_SIZE = 10000    # tf2_mnist:34
@@ -111,8 +112,11 @@ def main(argv=None):
         len(train_images) // BATCH_SIZE if args.max_steps is None else args.max_steps
     )
 
+    model = BatchNormCNN()
+    # the reference prints model.summary() before training (tf2_mnist:143)
+    print(model_summary(model, jnp.zeros((BATCH_SIZE, 28 * 28))))
     est = Estimator(
-        BatchNormCNN(),
+        model,
         optax.sgd(LEARNING_RATE),
         strategy=strategy,
         config=RunConfig(model_dir=args.model_dir),  # tf2_mnist:205-211

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tfde_tpu.export.serving import FinalExporter, export_serving, load_serving
-from tfde_tpu.models.cnn import BatchNormCNN
+from tfde_tpu.models.cnn import BatchNormCNN, PlainCNN
 
 
 def _trained_vars():
@@ -87,3 +87,32 @@ def test_export_token_model_int_signature(tmp_path):
     probs = served.predict(x)
     assert probs.shape == (3, 16, 97)
     np.testing.assert_allclose(probs.sum(-1), np.ones((3, 16)), rtol=1e-4)
+
+
+def test_savedmodel_export_serves_in_tensorflow(tmp_path):
+    """Opt-in TF-Serving interop (reference FinalExporter writes a
+    SavedModel, mnist_keras:151-162): the jax2tf-wrapped artifact must
+    load in plain TensorFlow and agree with the native path's outputs,
+    at any batch size."""
+    import pytest as _pytest
+
+    tf = _pytest.importorskip("tensorflow")
+
+    from tfde_tpu.export.savedmodel import export_savedmodel
+
+    model = PlainCNN()
+    variables = model.init(jax.random.key(0), jnp.zeros((2, 28, 28, 1)))
+
+    def apply_fn(v, x):
+        return model.apply(v, x, train=False)
+
+    out = export_savedmodel(
+        apply_fn, variables, (None, 28, 28, 1), str(tmp_path / "sm")
+    )
+    loaded = tf.saved_model.load(out)
+    x = np.random.default_rng(0).normal(size=(5, 28, 28, 1)).astype(np.float32)
+    served = loaded.signatures["serving_default"](tf.constant(x))
+    probs = next(iter(served.values())).numpy()
+    ref = jax.nn.softmax(apply_fn(variables, jnp.asarray(x)), axis=-1)
+    np.testing.assert_allclose(probs, np.asarray(ref), rtol=1e-5, atol=1e-6)
+    assert probs.shape == (5, 10)

@@ -57,3 +57,36 @@ def test_bn_cnn_accepts_flat_and_image_input():
     a = m.apply(vars_, jnp.ones((2, 784)), train=False)
     b = m.apply(vars_, jnp.ones((2, 28, 28, 1)), train=False)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_model_summary_matches_real_counts():
+    """model.summary() parity (mnist_keras:117/tf2_mnist:143): grouped table
+    via abstract shapes only, totals matching the real init."""
+    import jax
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.cnn import BatchNormCNN
+    from tfde_tpu.utils import model_summary
+
+    model = BatchNormCNN()
+    text = model_summary(model, jnp.zeros((4, 784)))
+    variables = model.init(jax.random.key(0), jnp.zeros((4, 784)))
+    total = sum(x.size for x in jax.tree_util.tree_leaves(variables["params"]))
+    assert f"Total params: {total:,}" in text
+    assert "Conv" in text and "Dense" in text
+    # non-trainable batch stats reported separately
+    stats = sum(x.size for x in jax.tree_util.tree_leaves(variables["batch_stats"]))
+    assert f"batch_stats: {stats:,}" in text
+
+
+def test_model_summary_duck_typed_model():
+    """Works for non-flax models (PipelinedLM duck-types init)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfde_tpu.models.pipelined import pipelined_tiny_test
+    from tfde_tpu.utils import model_summary
+
+    model = pipelined_tiny_test()
+    text = model_summary(model, np.zeros((8, 16), np.int32))
+    assert "stages" in text and "Total params:" in text

@@ -174,6 +174,117 @@ def imagenet(
     return train, test
 
 
+_DOWNLOADS = {
+    # canonical keras-datasets mirror; the file the reference's
+    # tf.keras.datasets.mnist.load_data() fetches (mnist_keras:207-208)
+    "mnist": {
+        "url": "https://storage.googleapis.com/tensorflow/tf-keras-datasets/mnist.npz",
+        "sha256": "731c5ac602752760c8e48fbffcf8c3b850d9dc2a2aedcf2cc48468fc17b673d1",
+        "filename": "mnist.npz",
+    },
+    # official CIFAR-10 python batches; converted to the cifar10.npz
+    # layout the loader resolves
+    "cifar10": {
+        "url": "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz",
+        "sha256": "6d958be074577803d12ecdefd02955f39262c83c16fe9348329d7fe0b5c001ce",
+        "filename": "cifar-10-python.tar.gz",
+    },
+}
+
+
+def _convert_cifar_tarball(tar_path: Path, out_path: Path) -> None:
+    """cifar-10-python.tar.gz (pickled batches) -> cifar10.npz
+    (x_train/y_train/x_test/y_test uint8), the `_load_npz` layout."""
+    import pickle
+    import tarfile
+
+    xs, ys, xt, yt = [], [], None, None
+    with tarfile.open(tar_path, "r:gz") as tf:
+        for member in tf.getmembers():
+            base = os.path.basename(member.name)
+            if not (base.startswith("data_batch") or base == "test_batch"):
+                continue
+            with tf.extractfile(member) as f:
+                d = pickle.load(f, encoding="bytes")
+            x = (
+                np.asarray(d[b"data"], np.uint8)
+                .reshape(-1, 3, 32, 32)
+                .transpose(0, 2, 3, 1)
+            )
+            y = np.asarray(d[b"labels"], np.int64)
+            if base == "test_batch":
+                xt, yt = x, y
+            else:
+                xs.append(x)
+                ys.append(y)
+    if not xs or xt is None:
+        raise ValueError(f"{tar_path} does not look like cifar-10-python")
+    np.savez_compressed(
+        out_path,
+        x_train=np.concatenate(xs),
+        y_train=np.concatenate(ys),
+        x_test=xt,
+        y_test=yt,
+    )
+
+
+def download(name: str, dest_dir: str = None, timeout: float = 600.0) -> str:
+    """Opt-in dataset fetch into the standard local layout; returns the
+    resolved dataset file path.
+
+    Parity with the reference's network acquisition
+    (`tf.keras.datasets.mnist.load_data()` at mnist_keras:207-208,
+    `tfds.load('mnist', data_dir='/tmp/data')` at dwk:25-28) for machines
+    WITH egress — never automatic: the loaders above stay hermetic
+    (local file, else synthetic) and this function is the explicit knob
+    (`python -m tfde_tpu.data.datasets mnist`). The payload is
+    sha256-verified before it is installed; a mismatch deletes the
+    download and raises.
+    """
+    if name not in _DOWNLOADS:
+        raise ValueError(
+            f"unknown dataset {name!r}; available: {sorted(_DOWNLOADS)}"
+        )
+    spec = _DOWNLOADS[name]
+    dest = Path(
+        dest_dir
+        or os.environ.get("TFDE_DATA_DIR")
+        or os.path.expanduser("~/.keras/datasets")
+    )
+    dest.mkdir(parents=True, exist_ok=True)
+    final = dest / f"{name}.npz"
+    if final.exists():
+        return str(final)
+
+    import hashlib
+    import urllib.request
+
+    tmp = dest / (spec["filename"] + ".download")
+    h = hashlib.sha256()
+    with urllib.request.urlopen(spec["url"], timeout=timeout) as r, \
+            open(tmp, "wb") as f:
+        while True:
+            chunk = r.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+            f.write(chunk)
+    digest = h.hexdigest()
+    if digest != spec["sha256"]:
+        tmp.unlink(missing_ok=True)
+        raise ValueError(
+            f"{name}: checksum mismatch for {spec['url']}: got {digest}, "
+            f"expected {spec['sha256']} — refusing to install a corrupted "
+            f"or tampered download"
+        )
+    if name == "cifar10":
+        _convert_cifar_tarball(tmp, final)
+        tmp.unlink()
+    else:
+        os.replace(tmp, final)
+    return str(final)
+
+
 def synthetic_tokens(
     n: int, seq_len: int, vocab: int = 30522, seed: int = 2
 ) -> np.ndarray:
@@ -187,3 +298,14 @@ def synthetic_tokens(
         follow = rng.random((n,)) < 0.7
         base[follow, t] = succ[base[follow, t - 1]]
     return base
+
+
+if __name__ == "__main__":  # python -m tfde_tpu.data.datasets mnist [dir]
+    import sys
+
+    if len(sys.argv) < 2 or sys.argv[1] not in _DOWNLOADS:
+        print(f"usage: python -m tfde_tpu.data.datasets "
+              f"{{{'|'.join(sorted(_DOWNLOADS))}}} [dest_dir]",
+              file=sys.stderr)
+        sys.exit(2)
+    print(download(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None))

@@ -1,0 +1,91 @@
+"""TensorFlow SavedModel export — ecosystem interop for TF-Serving shops.
+
+The reference's `FinalExporter` writes a SavedModel
+(`/root/reference/mnist_keras_distributed.py:151-162,264`) that TF Serving
+loads directly. The framework's native artifact (export/serving.py:
+StableHLO + params.npz + signature.json) is capability-equivalent and
+self-contained, but a TF-Serving deployment cannot consume it — this
+module closes that gap with an OPT-IN exporter that wraps the same jitted
+serve function via `jax.experimental.jax2tf` and writes a genuine
+SavedModel with a `serving_default` signature and a symbolic batch dim.
+
+Opt-in and lazily imported: TensorFlow is an interop dependency only (the
+compute path never touches it); without TF installed this module raises a
+clear error and everything else works. `FinalExporter(...,
+savedmodel=True)` (export/serving.py) writes both artifacts side by side.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from tfde_tpu.utils import fs
+
+log = logging.getLogger(__name__)
+
+
+def export_savedmodel(
+    apply_fn: Callable,
+    variables: dict,
+    input_shape: Sequence[Optional[int]],
+    directory: str,
+    input_dtype=np.float32,
+    apply_softmax: bool = True,
+) -> str:
+    """Write `<directory>/<timestamp>/` as a TF SavedModel; returns the
+    timestamped dir. Same contract as export_serving: `apply_fn(variables,
+    x) -> logits`, `input_shape` with None for the batch dim.
+    """
+    try:
+        import tensorflow as tf
+        from jax.experimental import jax2tf
+    except ImportError as e:
+        raise RuntimeError(
+            "export_savedmodel needs tensorflow (an interop-only "
+            "dependency): pip install tensorflow, or use the native "
+            "artifact (export_serving) which has no TF dependency"
+        ) from e
+
+    host_vars = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)), variables
+    )
+
+    def serve(x):
+        logits = apply_fn(host_vars, x)
+        return jax.nn.softmax(logits, axis=-1) if apply_softmax else logits
+
+    # symbolic batch dim ("b") so one artifact serves any batch size —
+    # the [None, 784] placeholder contract (mnist_keras:159)
+    poly = ",".join("b" if d is None else str(d) for d in input_shape)
+    tf_fn = tf.function(
+        jax2tf.convert(
+            serve, with_gradient=False, polymorphic_shapes=[f"({poly})"]
+        ),
+        input_signature=[
+            tf.TensorSpec(list(input_shape), tf.as_dtype(np.dtype(input_dtype)))
+        ],
+        autograph=False,
+    )
+    module = tf.Module()
+    module.serve = tf_fn  # keep the concrete function referenced
+    stamp = datetime.datetime.now().strftime("%Y%m%d%H%M%S")
+    out_dir = fs.join(directory, stamp)
+    if fs.is_remote(out_dir):
+        # tf.saved_model.save writes through TF's own filesystem layer,
+        # which handles gs:// natively; memory:// etc. do not exist there
+        raise ValueError(
+            f"SavedModel export supports local and gs:// paths (TF's "
+            f"filesystem), got {out_dir}; use export_serving for "
+            f"arbitrary fsspec URLs"
+        )
+    fs.makedirs(directory)
+    tf.saved_model.save(
+        module, out_dir, signatures={"serving_default": tf_fn}
+    )
+    log.info("SavedModel exported -> %s", out_dir)
+    return out_dir

@@ -190,14 +190,20 @@ class FinalExporter:
         input_shape: Sequence[Optional[int]],
         input_dtype=jnp.float32,
         apply_softmax: bool = True,
+        savedmodel: bool = False,
     ):
+        """savedmodel=True additionally writes a genuine TF SavedModel
+        next to the native artifact (under `<...>/<name>_savedmodel/`) for
+        TF-Serving deployments — opt-in, needs tensorflow installed
+        (export/savedmodel.py)."""
         self.name = name
         self.input_shape = tuple(input_shape)
         self.input_dtype = input_dtype
         self.apply_softmax = apply_softmax
+        self.savedmodel = savedmodel
 
     def export(self, model_dir: str, apply_fn: Callable, variables: dict) -> str:
-        return export_serving(
+        out = export_serving(
             apply_fn,
             variables,
             self.input_shape,
@@ -205,3 +211,15 @@ class FinalExporter:
             input_dtype=self.input_dtype,
             apply_softmax=self.apply_softmax,
         )
+        if self.savedmodel:
+            from tfde_tpu.export.savedmodel import export_savedmodel
+
+            export_savedmodel(
+                apply_fn,
+                variables,
+                self.input_shape,
+                fs.join(model_dir, "export", f"{self.name}_savedmodel"),
+                input_dtype=np.dtype(jnp.dtype(self.input_dtype).name),
+                apply_softmax=self.apply_softmax,
+            )
+        return out

@@ -166,6 +166,29 @@ def resolve_cluster() -> ClusterInfo:
     return ClusterInfo(num, pid, coord, job_type, task_index)
 
 
+def coordinator_endpoint(coord: str, default_port: int = 8476) -> str:
+    """host[:port] from the cluster spec -> the jax.distributed coordinator
+    endpoint.
+
+    The spec port belongs to the application's own service (in a genuine
+    TF_CONFIG migration, the TF gRPC server — a leftover process bound to
+    it would make init fail), so the coordinator listens on a DERIVED
+    port: spec port + 1011, wrapped to stay in range. Deterministic, so
+    every process computes the same endpoint from the same spec.
+    `TFDE_COORD_PORT` overrides when the derived port is also taken.
+    """
+    tail = coord.rsplit("]")[-1]  # IPv6-bracket aware
+    if ":" in tail:
+        host, spec_port = coord.rsplit(":", 1)
+        derived = int(spec_port) + 1011
+        if derived > 65535:
+            derived = int(spec_port) - 1011
+    else:
+        host, derived = coord, default_port
+    port = int(os.environ.get("TFDE_COORD_PORT", derived))
+    return f"{host}:{port}"
+
+
 def bootstrap(coordinator_port: int = 8476) -> ClusterInfo:
     """Resolve the cluster and initialize `jax.distributed` if multi-process.
 
@@ -179,8 +202,8 @@ def bootstrap(coordinator_port: int = 8476) -> ClusterInfo:
         import jax
 
         coord = info.coordinator_address
-        if coord and ":" not in coord.rsplit("]")[-1]:
-            coord = f"{coord}:{coordinator_port}"
+        if coord:
+            coord = coordinator_endpoint(coord, coordinator_port)
         log.info(
             "jax.distributed.initialize(coordinator=%s, num_processes=%d, process_id=%d)",
             coord, info.num_processes, info.process_id,

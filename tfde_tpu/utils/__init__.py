@@ -1,4 +1,5 @@
-"""Shared utilities: filesystem abstraction for remote working dirs."""
+"""Shared utilities: filesystem abstraction for remote working dirs; model
+parameter summaries (`model.summary()` parity)."""
 
 from tfde_tpu.utils.fs import (  # noqa: F401
     exists,
@@ -10,3 +11,4 @@ from tfde_tpu.utils.fs import (  # noqa: F401
     makedirs,
     write_bytes,
 )
+from tfde_tpu.utils.summary import model_summary  # noqa: F401

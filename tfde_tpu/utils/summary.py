@@ -1,0 +1,96 @@
+"""Model architecture/parameter summary — `model.summary()` parity.
+
+Both reference Estimator scripts print a Keras layer/param summary before
+training (`/root/reference/mnist_keras_distributed.py:117`,
+`tf2_mnist_distributed.py:143`); this is the framework-native equivalent
+for any model the step factories accept (flax modules and duck-typed
+models like PipelinedLM alike — anything with `init(rng, sample)`).
+
+Counting happens on abstract shapes (`jax.eval_shape`), so summarizing a
+70B-param config costs nothing: no parameter materializes, no device
+memory is touched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _count(tree) -> tuple:
+    """(param count, bytes) over a pytree of ShapeDtypeStructs/arrays."""
+    n = b = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        size = int(np.prod(leaf.shape, dtype=np.int64)) if leaf.shape else 1
+        n += size
+        b += size * np.dtype(leaf.dtype).itemsize
+    return n, b
+
+
+def _fmt_count(n: int) -> str:
+    return f"{n:,}"
+
+
+def _fmt_bytes(b: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if b < 1024 or unit == "TB":
+            return f"{b:.1f} {unit}" if unit != "B" else f"{b} B"
+        b /= 1024
+    return f"{b:.1f} TB"
+
+
+def model_summary(
+    model: Any,
+    sample_input: Any,
+    depth: int = 2,
+    variables: Optional[dict] = None,
+) -> str:
+    """Parameter summary table for `model`, grouped to `depth` path levels.
+
+    model: anything with `init(rng, sample) -> variables` (flax module or
+    duck-typed). sample_input: one batch-shaped input (only shapes/dtypes
+    are read). variables: pass an existing tree to skip abstract init.
+    Returns the table as a string — print it, the reference's
+    `model.summary()` behavior.
+    """
+    if variables is None:
+        variables = jax.eval_shape(
+            lambda s: model.init(jax.random.key(0), s), sample_input
+        )
+    params = variables.get("params", variables)
+    groups: dict = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        keys = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+        group = "/".join(keys[:depth]) or "(root)"
+        n, b = _count([leaf])
+        cn, cb = groups.get(group, (0, 0))
+        groups[group] = (cn + n, cb + b)
+
+    name = type(model).__name__
+    rows = [(g, *groups[g]) for g in groups]
+    w = max([len(r[0]) for r in rows] + [len("module")]) + 2
+    cw = max([len(_fmt_count(r[1])) for r in rows] + [len("params")]) + 2
+    lines = [
+        f'Model: "{name}"',
+        "=" * (w + cw + 10),
+        f"{'module':<{w}}{'params':>{cw}}  {'bytes':>8}",
+        "-" * (w + cw + 10),
+    ]
+    for g, n, b in rows:
+        lines.append(f"{g:<{w}}{_fmt_count(n):>{cw}}  {_fmt_bytes(b):>8}")
+    total_n, total_b = _count(params)
+    lines.append("=" * (w + cw + 10))
+    lines.append(
+        f"Total params: {_fmt_count(total_n)} ({_fmt_bytes(total_b)})"
+    )
+    extras = [k for k in variables if k not in ("params",)] \
+        if isinstance(variables, dict) else []
+    for col in extras:
+        n, b = _count(variables[col])
+        if n:
+            lines.append(
+                f"{col}: {_fmt_count(n)} ({_fmt_bytes(b)}) — non-trainable"
+            )
+    return "\n".join(lines)
