@@ -305,7 +305,11 @@ def _bench_mnist_e2e(clock: _Clock, strategy, n_chips: int, smoke: bool) -> dict
         .batch(GLOBAL_BATCH, drop_remainder=True)
         .prefetch(4)
     )
-    feed = device_prefetch(iter(ds), strategy.mesh, buffer_size=2)
+    # background=True: host pull + device_put in a worker thread, so a
+    # link whose device_put is effectively synchronous (the axon tunnel)
+    # still overlaps transfer with the device step
+    feed = device_prefetch(iter(ds), strategy.mesh, buffer_size=2,
+                           background=True)
     import jax
 
     key = jax.random.key(0)
@@ -336,9 +340,50 @@ def _bench_mnist_e2e(clock: _Clock, strategy, n_chips: int, smoke: bool) -> dict
     }
 
 
+def _bench_link(clock: _Clock, smoke: bool) -> dict:
+    """Host->device transfer microbenchmark — the attribution control for
+    the e2e gap (VERDICT r3 #3). Measures the per-transfer latency floor
+    (4-byte put), the MNIST batch payload's per-batch cost, and streaming
+    bandwidth (16 MiB put). On a co-located host, link_batch_ms is tens of
+    microseconds and e2e==compute; through the tunnel it is the gap. The
+    derived fields land in the cumulative result via run_mode."""
+    import jax
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+
+    def put_time_s(arr, budget):
+        def run(reps):
+            out = None
+            for _ in range(reps):
+                out = jax.device_put(arr)
+            return out
+
+        reps, window, _gap, _ = clock.timed(
+            run, lambda o: float(np.asarray(o).ravel()[0]),
+            budget, start_reps=3 if smoke else 20, max_reps=5000,
+        )
+        return window / reps
+
+    budget = 0.05 if smoke else 1.0
+    lat_s = put_time_s(np.ones((1,), np.float32), budget)
+    batch = rng.random((GLOBAL_BATCH, 784), np.float32)
+    batch_s = put_time_s(batch, budget)
+    big = rng.random((1 << 22,), np.float32)  # 16 MiB
+    big_s = put_time_s(big, budget)
+    return {
+        "link_latency_ms": round(lat_s * 1e3, 3),
+        "link_batch_ms": round(batch_s * 1e3, 3),
+        "link_batch_bytes": int(batch.nbytes),
+        "link_bandwidth_mb_s": round(
+            big.nbytes / max(big_s - lat_s, 1e-9) / 1e6, 1
+        ),
+    }
+
+
 def _bench_bert_mfu(clock: _Clock, strategy, n_chips: int, peak: float,
                     smoke: bool, per_chip_batch: int = 16,
-                    prefix: str = "bert") -> dict:
+                    prefix: str = "bert", fused_qkv: bool = False) -> dict:
     import jax
     import numpy as np
     import optax
@@ -350,11 +395,13 @@ def _bench_bert_mfu(clock: _Clock, strategy, n_chips: int, peak: float,
     if smoke:  # CPU-sized config: validates the path, not a real number
         seq, per_chip_batch = 128, 2
         model = Bert(vocab_size=1024, hidden_size=128, depth=2, num_heads=4,
-                     mlp_dim=256, dropout_rate=0.0, pad_vocab=True)
+                     mlp_dim=256, dropout_rate=0.0, pad_vocab=True,
+                     fused_qkv=fused_qkv)
         warmup = 1
     else:
         seq = 512
-        model = BertBase(dropout_rate=0.0, pad_vocab=True)
+        model = BertBase(dropout_rate=0.0, pad_vocab=True,
+                         fused_qkv=fused_qkv)
         warmup = 3
     global_batch = per_chip_batch * n_chips
     vocab = model.padded_vocab
@@ -768,6 +815,7 @@ def run_mode() -> None:
         ("calib", lambda: _bench_calibration(clock, peak, smoke)),
         ("mnist", lambda: _bench_mnist(clock, strategy, n_chips, smoke)),
         ("mnist_e2e", lambda: _bench_mnist_e2e(clock, strategy, n_chips, smoke)),
+        ("link", lambda: _bench_link(clock, smoke)),
         ("bert", lambda: _bench_bert_mfu(clock, strategy, n_chips, peak, smoke)),
         ("flash", lambda: _bench_flash(clock, smoke)),
         # stretch configs: ordered last so an attempt-timeout salvages the
@@ -775,6 +823,12 @@ def run_mode() -> None:
         ("bert32", lambda: _bench_bert_mfu(clock, strategy, n_chips, peak,
                                            smoke, per_chip_batch=32,
                                            prefix="bert32")),
+        # fusion A/B at equal batch: bert_fused_mfu - bert_mfu isolates the
+        # one-GEMM qkv projection (transformer.fused_qkv)
+        ("bert_fused", lambda: _bench_bert_mfu(clock, strategy, n_chips,
+                                               peak, smoke,
+                                               prefix="bert_fused",
+                                               fused_qkv=True)),
         ("gpt_long", lambda: _bench_gpt_long(clock, strategy, n_chips, peak,
                                              smoke)),
         ("decode", lambda: _bench_decode(clock, smoke)),
@@ -804,6 +858,21 @@ def run_mode() -> None:
             line["value"] = 0.0
         print(json.dumps(line), flush=True)
 
+    def attribute_e2e() -> None:
+        """e2e-gap attribution (VERDICT r3 #3): how much of
+        e2e_step - compute_step the measured per-batch link cost explains.
+        A fraction near 1.0 proves the residual is pure transfer (tunnel
+        latency); well below 1.0 points at pipeline overhead instead."""
+        need = ("mnist_step_ms", "mnist_e2e_step_ms", "link_batch_ms")
+        if not all(k in result for k in need):
+            return
+        gap = result["mnist_e2e_step_ms"] - result["mnist_step_ms"]
+        result["e2e_gap_ms"] = round(gap, 3)
+        if gap > 1e-3:
+            result["e2e_gap_link_fraction"] = round(
+                result["link_batch_ms"] / gap, 3
+            )
+
     for i, (name, fn) in enumerate(configs):
         try:
             result.update(fn())
@@ -812,6 +881,8 @@ def run_mode() -> None:
         print(f"{name} done", file=sys.stderr)
         if name == "calib" and "calib_error" in result:
             break  # timing itself is broken; more numbers would be noise
+        if name == "link":
+            attribute_e2e()
         if i < len(configs) - 1:
             emit(partial=True)
     emit(partial=False)

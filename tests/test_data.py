@@ -312,3 +312,35 @@ def test_cifar_tarball_conversion(tmp_path):
         assert d["x_train"].shape == (40, 32, 32, 3)
         assert d["x_test"].shape == (10, 32, 32, 3)
         assert d["y_train"].shape == (40,)
+
+
+def test_device_prefetch_background_matches_inline():
+    """background=True (worker-thread device_put, the tunnel-overlap mode)
+    must yield the same stream in the same order, and surface source
+    errors in the consumer."""
+    import jax
+
+    from tfde_tpu.data.device import device_prefetch
+    from tfde_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    batches = [
+        (np.full((16, 4), i, np.float32), np.full((16, 1), i, np.int32))
+        for i in range(6)
+    ]
+    inline = [jax.device_get(b[0])
+              for b in device_prefetch(iter(batches), mesh)]
+    bg = [jax.device_get(b[0])
+          for b in device_prefetch(iter(batches), mesh, background=True)]
+    assert len(inline) == len(bg) == 6
+    for a, b in zip(inline, bg):
+        np.testing.assert_array_equal(a, b)
+
+    def broken():
+        yield batches[0]
+        raise RuntimeError("source exploded")
+
+    feed = device_prefetch(broken(), mesh, background=True)
+    next(feed)
+    with pytest.raises(RuntimeError, match="source exploded"):
+        next(feed)

@@ -168,3 +168,101 @@ def test_remat_policies_match_no_remat_numerics(rng):
 
     with pytest.raises(ValueError, match="remat"):
         remat_policy("bogus")
+
+
+def test_fused_qkv_matches_unfused(rng):
+    """fused_qkv computes the SAME attention as the three-GEMM layout when
+    its stacked kernel carries the same weights — the fusion is a pure
+    MXU-utilization change, never a numerics change."""
+    import jax
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.transformer import MultiHeadAttention
+
+    x = jnp.asarray(rng.standard_normal((2, 10, 32)), jnp.float32)
+    unfused = MultiHeadAttention(num_heads=4, head_dim=8, dtype=jnp.float32,
+                                 causal=True)
+    fused = MultiHeadAttention(num_heads=4, head_dim=8, dtype=jnp.float32,
+                               causal=True, fused_qkv=True)
+    pu = unfused.init(jax.random.key(0), x)["params"]
+    pf = fused.init(jax.random.key(1), x)["params"]
+    # map: stack [E,H,D] kernels on a new axis 1 -> [E,3,H,D]
+    pf = dict(pf)
+    pf["qkv"] = {
+        "kernel": jnp.stack(
+            [pu["query"]["kernel"], pu["key"]["kernel"],
+             pu["value"]["kernel"]], axis=1,
+        ),
+        "bias": jnp.stack(
+            [pu["query"]["bias"], pu["key"]["bias"], pu["value"]["bias"]],
+            axis=0,
+        ),
+    }
+    pf["out"] = pu["out"]
+    a = unfused.apply({"params": pu}, x)
+    b = fused.apply({"params": pf}, x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_fused_qkv_rejects_gqa():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from tfde_tpu.models.transformer import MultiHeadAttention
+
+    m = MultiHeadAttention(num_heads=4, head_dim=8, num_kv_heads=2,
+                           dtype=jnp.float32, fused_qkv=True)
+    with _pytest.raises(NotImplementedError, match="fused_qkv"):
+        m.init(jax.random.key(0), jnp.zeros((1, 4, 32)))
+
+
+def test_fused_qkv_gpt_decodes_and_tp_matches_dp(rng):
+    """fused_qkv composes with the KV-cache decode path and with Megatron
+    TP (the 'qkv' kernel column-shards over heads)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.gpt import gpt_tiny_test, next_token_loss
+    from tfde_tpu.parallel.strategies import (
+        MultiWorkerMirroredStrategy,
+        TensorParallelStrategy,
+    )
+    from tfde_tpu.runtime.mesh import make_mesh
+    from tfde_tpu.training.step import init_state, make_custom_train_step
+
+    model = gpt_tiny_test(fused_qkv=True)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = jnp.asarray(rng.integers(0, 97, (2, 5)), jnp.int32)
+    toks, _ = generate(model, params, prompt, max_new_tokens=6)
+    assert toks.shape == (2, 11)
+
+    tokens = rng.integers(0, 97, (16, 24)).astype(np.int32)
+    strat_t = TensorParallelStrategy(
+        make_mesh({"data": 2, "tensor": 2}, jax.devices()[:4])
+    )
+    state_t, _ = init_state(model, optax.adam(1e-3), strat_t, tokens)
+    # the fused kernel must actually shard over 'tensor'
+    qkv_leaf = jax.tree_util.tree_leaves_with_path(state_t.params)
+    sharded = [
+        (jax.tree_util.keystr(p), l.sharding.spec)
+        for p, l in qkv_leaf if "qkv" in jax.tree_util.keystr(p)
+    ]
+    assert sharded and all("tensor" in str(spec) for _, spec in sharded), sharded
+    step_t = make_custom_train_step(strat_t, state_t, next_token_loss,
+                                    donate=False)
+    strat_d = MultiWorkerMirroredStrategy(
+        make_mesh({"data": 4}, jax.devices()[:4])
+    )
+    state_d, _ = init_state(model, optax.adam(1e-3), strat_d, tokens)
+    step_d = make_custom_train_step(strat_d, state_d, next_token_loss,
+                                    donate=False)
+    key = jax.random.key(0)
+    for _ in range(3):
+        state_t, m_t = step_t(state_t, (tokens,), key)
+        state_d, m_d = step_d(state_d, (tokens,), key)
+    np.testing.assert_allclose(float(m_t["loss"]), float(m_d["loss"]),
+                               rtol=2e-5)

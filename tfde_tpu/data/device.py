@@ -55,6 +55,7 @@ def device_prefetch(
     spec: Optional[P] = None,
     buffer_size: int = 2,
     policy: AutoShardPolicy = AutoShardPolicy.DATA,
+    background: bool = False,
 ) -> Iterator:
     """Yield global device arrays, keeping `buffer_size` transfers in flight.
 
@@ -62,6 +63,15 @@ def device_prefetch(
     consumer blocks on the current step gives copy/compute overlap (the
     `prefetch(100)` capability of mnist_keras:145 plus `experimental_prefetch_
     to_device`, without the 100-deep host queue — device HBM holds the window).
+
+    `background=True` moves the host-batch pull AND the device_put into a
+    worker thread (a `buffer_size`-deep queue hands finished device arrays
+    to the consumer). Use when either blocks the calling thread — a host
+    pipeline with real per-batch work, or a link whose device_put is
+    effectively synchronous (a high-latency tunnel): the transfer then
+    overlaps the device step even though the consumer never returns to
+    Python between steps. Same stream, same order; worker exceptions
+    re-raise in the consumer.
     """
     if spec is None:
         from tfde_tpu.parallel.sharding import batch_spec
@@ -69,17 +79,53 @@ def device_prefetch(
         spec = batch_spec(mesh)
     sharding = NamedSharding(mesh, spec)
 
-    buf: collections.deque = collections.deque()
-    it = iter(batches)
-    try:
-        while len(buf) < max(1, buffer_size):
-            buf.append(_to_global(next(it), sharding, policy))
-    except StopIteration:
-        pass
-    while buf:
-        out = buf.popleft()
+    if background:
+        import queue as _queue
+        import threading
+
+        q: "_queue.Queue" = _queue.Queue(maxsize=max(1, buffer_size))
+        _END = object()
+
+        class _Raise:  # unambiguous error envelope (a batch is never one)
+            def __init__(self, e):
+                self.e = e
+
+        def worker():
+            try:
+                for b in batches:
+                    q.put(_to_global(b, sharding, policy))
+                q.put(_END)
+            except BaseException as e:
+                q.put(_Raise(e))
+
+        threading.Thread(target=worker, daemon=True,
+                         name="tfde-device-prefetch").start()
+
+        def gen():
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, _Raise):
+                    raise item.e
+                yield item
+
+        return gen()
+
+    def gen_inline():
+        buf: collections.deque = collections.deque()
+        it = iter(batches)
         try:
-            buf.append(_to_global(next(it), sharding, policy))
+            while len(buf) < max(1, buffer_size):
+                buf.append(_to_global(next(it), sharding, policy))
         except StopIteration:
             pass
-        yield out
+        while buf:
+            out = buf.popleft()
+            try:
+                buf.append(_to_global(next(it), sharding, policy))
+            except StopIteration:
+                pass
+            yield out
+
+    return gen_inline()

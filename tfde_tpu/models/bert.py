@@ -85,6 +85,7 @@ class Bert(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "auto"
     remat: Any = False  # False | True/'full' | 'dots' (transformer.remat_policy)
+    fused_qkv: bool = False  # one-GEMM qkv projection (transformer.py)
     pad_vocab: bool = False
     ln_eps: float = 1e-6  # BERT checkpoints use 1e-12 (models/convert.py)
 
@@ -127,6 +128,7 @@ class Bert(nn.Module):
             dtype=self.dtype,
             dropout_rate=self.dropout_rate,
             attn_impl=self.attn_impl,
+            fused_qkv=self.fused_qkv,
             norm_style="post",
             ln_eps=self.ln_eps,
             remat=self.remat,

@@ -37,6 +37,7 @@ class GPT(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attn_impl: str = "auto"
     remat: Any = False  # False | True/'full' | 'dots' (transformer.remat_policy)
+    fused_qkv: bool = False  # one-GEMM qkv projection (transformer.py)
     # > 0 swaps every `moe_every`-th block's MLP for a routed expert MLP
     # (models/moe.py) — train under ExpertParallelStrategy to shard experts
     num_experts: int = 0
@@ -113,6 +114,7 @@ class GPT(nn.Module):
             rope=self.position == "rope",
             rope_theta=self.rope_theta,
             num_kv_heads=self.num_kv_heads,
+            fused_qkv=self.fused_qkv,
             norm=self.norm,
             mlp_act=self.mlp_act,
             use_bias=self.use_bias,

@@ -58,6 +58,13 @@ class MultiHeadAttention(nn.Module):
     # the same factor — the reason every modern serving stack uses GQA.
     num_kv_heads: Optional[int] = None
     use_bias: bool = True  # False: the LLaMA bias-free projections
+    # one [embed, 3, heads, head_dim] projection instead of three
+    # [embed, heads, head_dim] GEMMs: a 3x-wider matmul keeps the MXU
+    # busier at small per-chip batch (the training MFU knob). Parameter
+    # layout changes ('qkv' vs 'query'/'key'/'value'), so checkpoint
+    # conversion (models/convert.py) and HF interop stay on the unfused
+    # default; MHA only (GQA's k/v are shaped differently).
+    fused_qkv: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -83,9 +90,23 @@ class MultiHeadAttention(nn.Module):
             param_dtype=jnp.float32,
             use_bias=self.use_bias,
         )
-        q = proj(features=(self.num_heads, self.head_dim), name="query")(x)
-        k = proj(features=(self.kv_heads, self.head_dim), name="key")(x)
-        v = proj(features=(self.kv_heads, self.head_dim), name="value")(x)
+        if self.fused_qkv:
+            if self.kv_heads != self.num_heads:
+                raise NotImplementedError(
+                    "fused_qkv requires classic MHA (num_kv_heads=None): "
+                    "GQA's k/v projections have different shapes and "
+                    "cannot stack into one kernel"
+                )
+            qkv = proj(
+                features=(3, self.num_heads, self.head_dim), name="qkv"
+            )(x)  # [B, S, 3, H, D] from ONE GEMM
+            q, k, v = (qkv[..., i, :, :] for i in range(3))
+        else:
+            q = proj(features=(self.num_heads, self.head_dim),
+                     name="query")(x)
+            k = proj(features=(self.kv_heads, self.head_dim), name="key")(x)
+            v = proj(features=(self.kv_heads, self.head_dim),
+                     name="value")(x)
         if self.rope and not self.decode:
             q, k = self._rotate(q, k, jnp.zeros((), jnp.int32))
         # [B, S, H, D]: heads carry the tensor-parallel shard.
@@ -286,6 +307,7 @@ class TransformerBlock(nn.Module):
     rope: bool = False
     rope_theta: float = 10_000.0
     num_kv_heads: Optional[int] = None  # GQA (MultiHeadAttention)
+    fused_qkv: bool = False  # one-GEMM qkv projection (MultiHeadAttention)
     norm_style: str = "pre"  # 'pre' | 'post'
     norm: str = "layer"  # 'layer' | 'rms' (LLaMA: scale-only, no bias)
     mlp_act: str = "gelu"  # Mlp.act
@@ -318,6 +340,7 @@ class TransformerBlock(nn.Module):
             rope=self.rope,
             rope_theta=self.rope_theta,
             num_kv_heads=self.num_kv_heads,
+            fused_qkv=self.fused_qkv,
             use_bias=self.use_bias,
             name="attn",
         )
@@ -394,6 +417,7 @@ class Encoder(nn.Module):
     rope: bool = False
     rope_theta: float = 10_000.0
     num_kv_heads: Optional[int] = None
+    fused_qkv: bool = False
     norm_style: str = "pre"
     norm: str = "layer"
     mlp_act: str = "gelu"
@@ -441,6 +465,7 @@ class Encoder(nn.Module):
                 rope=self.rope,
                 rope_theta=self.rope_theta,
                 num_kv_heads=self.num_kv_heads,
+                fused_qkv=self.fused_qkv,
                 norm_style=self.norm_style,
                 norm=self.norm,
                 mlp_act=self.mlp_act,

@@ -41,6 +41,7 @@ class ViT(nn.Module):
     pool: str = "cls"  # 'cls' | 'gap'
     attn_impl: str = "auto"
     remat: Any = False  # False | True/'full' | 'dots' (transformer.remat_policy)
+    fused_qkv: bool = False  # one-GEMM qkv projection (transformer.py)
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -83,6 +84,7 @@ class ViT(nn.Module):
             dtype=self.dtype,
             dropout_rate=self.dropout_rate,
             attn_impl=self.attn_impl,
+            fused_qkv=self.fused_qkv,
             remat=self.remat,
             name="encoder",
         )(x, train=train)

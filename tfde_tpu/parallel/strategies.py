@@ -189,6 +189,15 @@ def _megatron_tensor_dim(module: str, kind: str, shape, tsize: int,
     if tsize <= 1:
         return None
     body = shape[offset:]
+    if module == "qkv":
+        # fused projection (transformer.fused_qkv): kernel
+        # [embed, 3, heads, hd] / bias [3, heads, hd] — still
+        # column-parallel, heads dim split over 'tensor'
+        if kind == "kernel" and len(body) >= 3 and body[2] % tsize == 0:
+            return offset + 2
+        if kind == "bias" and len(body) >= 2 and body[1] % tsize == 0:
+            return offset + 1
+        return None
     if module in _TP_COLUMN:
         # qkv [embed, heads, hd] / fc1 [embed, ffn]: split dim 1
         if kind == "kernel" and len(body) >= 2 and body[1] % tsize == 0:
