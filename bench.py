@@ -360,7 +360,10 @@ def _bench_link(clock: _Clock, smoke: bool) -> dict:
             return out
 
         reps, window, _gap, _ = clock.timed(
-            run, lambda o: float(np.asarray(o).ravel()[0]),
+            # a device-side scalar slice: the fetch must move 4 bytes, not
+            # the whole buffer (a full device_get inside the window would
+            # inflate link_batch_ms on exactly the links this measures)
+            run, lambda o: o.ravel()[0],
             budget, start_reps=3 if smoke else 20, max_reps=5000,
         )
         return window / reps

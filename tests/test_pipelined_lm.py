@@ -338,17 +338,6 @@ def test_auto_dispatch_skips_flash_under_abstract_mesh(monkeypatch):
     assert chosen == ["reference"]
 
 
-def test_pp_with_seq_axis_rejected(tokens):
-    """pp x sp doesn't lower in jax 0.9 (Shardy rejects the ring backward's
-    residual shardings inside a nested manual region) — the strategy must
-    say so loudly instead of failing deep in MLIR."""
-    strat = PipelineParallelStrategy(
-        mesh=make_mesh({"data": 2, "pipe": 2, "seq": 2}, jax.devices()[:8])
-    )
-    with pytest.raises(ValueError, match="SequenceParallelStrategy"):
-        init_state(pipelined_tiny_test(), optax.adam(1e-3), strat, tokens)
-
-
 # --------------------------------------------------------------------------
 # 1F1B schedule (parallel/pipeline.pipeline_train_1f1b)
 # --------------------------------------------------------------------------
@@ -511,6 +500,96 @@ def test_1f1b_refused_with_tensor_axis(tokens):
     state, _ = init_state(m, optax.adam(1e-3), strat, tokens)
     from tfde_tpu.models.pipelined import pipelined_next_token_loss
 
+    step = make_custom_train_step(strat, state, pipelined_next_token_loss,
+                                  donate=False)
+    with pytest.raises(NotImplementedError, match="1f1b"):
+        step(state, (tokens,), jax.random.key(0))
+
+
+# --------------------------------------------------------------------------
+# pp x sp: ring attention inside the fully-manual pipe
+# --------------------------------------------------------------------------
+
+def test_pp_sp_forward_matches_sequential(model, tokens):
+    """dp x pipe x seq: sequence sharded over the ring INSIDE pipeline
+    stages (ring_attention_manual in the flat manual region) must equal
+    the no-mesh sequential forward."""
+    from tfde_tpu.parallel import axes as axes_lib
+
+    variables = model.init(jax.random.key(0), tokens)
+    seq_logits = jax.jit(lambda v, t: model.apply(v, t))(variables, tokens)
+
+    mesh = make_mesh({"data": 2, "pipe": 2, "seq": 2}, jax.devices()[:8])
+
+    def pipe_forward(v, t):
+        with axes_lib.use_axes(mesh):
+            return model.apply(v, t)
+
+    pipe_logits = jax.jit(pipe_forward)(variables, tokens)
+    np.testing.assert_allclose(
+        np.asarray(pipe_logits), np.asarray(seq_logits), rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+def test_pp_sp_train_matches_dp(model, tokens):
+    """5 Adam steps at dp=2 x pipe=2 x seq=2 == plain DP at data=4 — the
+    same numerics oracle as every other strategy family."""
+    from tfde_tpu.models.gpt import next_token_loss
+
+    strat_p = PipelineParallelStrategy(data=2, pipe=2, seq=2)
+    state_p, _ = init_state(model, optax.adam(1e-3), strat_p, tokens)
+    step_p = make_custom_train_step(strat_p, state_p, next_token_loss,
+                                    donate=False)
+
+    strat_d = MultiWorkerMirroredStrategy(
+        make_mesh({"data": 4}, jax.devices()[:4])
+    )
+    state_d, _ = init_state(model, optax.adam(1e-3), strat_d, tokens)
+    step_d = make_custom_train_step(strat_d, state_d, next_token_loss,
+                                    donate=False)
+
+    rng = jax.random.key(0)
+    for _ in range(5):
+        state_p, m_p = step_p(state_p, (tokens,), rng)
+        state_d, m_d = step_d(state_d, (tokens,), rng)
+    np.testing.assert_allclose(
+        float(m_p["loss"]), float(m_d["loss"]), rtol=2e-5
+    )
+    assert float(m_p["loss"]) < 4.6
+
+
+def test_pp_sp_loss_and_metrics_routes_outside(model, tokens):
+    """loss_and_metrics under a seq axis must route through the full-logit
+    path (shift correctness across shard boundaries) and still match the
+    sequential loss."""
+    from tfde_tpu.parallel import axes as axes_lib
+
+    variables = model.init(jax.random.key(0), tokens)
+    ref_loss, _ = model.loss_and_metrics(variables, tokens)  # no mesh
+    mesh = make_mesh({"data": 2, "pipe": 2, "seq": 2}, jax.devices()[:8])
+
+    def f(v, t):
+        with axes_lib.use_axes(mesh):
+            return model.loss_and_metrics(v, t)
+
+    loss, metrics = jax.jit(f)(variables, tokens)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+
+
+def test_pp_sp_tp_refused(tokens):
+    strat = PipelineParallelStrategy(data=1, pipe=2, tensor=2, seq=2)
+    with pytest.raises(ValueError, match="pp x sp x tp"):
+        init_state(pipelined_tiny_test(), optax.adam(1e-3), strat,
+                   np.zeros((8, 32), np.int32))
+
+
+def test_pp_sp_1f1b_refused(model, tokens):
+    from tfde_tpu.models.pipelined import pipelined_next_token_loss
+
+    m = pipelined_tiny_test(schedule="1f1b")
+    strat = PipelineParallelStrategy(data=2, pipe=2, seq=2)
+    state, _ = init_state(m, optax.adam(1e-3), strat, tokens)
     step = make_custom_train_step(strat, state, pipelined_next_token_loss,
                                   donate=False)
     with pytest.raises(NotImplementedError, match="1f1b"):

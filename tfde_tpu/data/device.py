@@ -90,25 +90,54 @@ def device_prefetch(
             def __init__(self, e):
                 self.e = e
 
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer is gone — a
+            # consumer breaking out of its loop early must not leave the
+            # worker blocked forever pinning device arrays
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
         def worker():
             try:
                 for b in batches:
-                    q.put(_to_global(b, sharding, policy))
-                q.put(_END)
+                    if not put(_to_global(b, sharding, policy)):
+                        return
+                put(_END)
             except BaseException as e:
-                q.put(_Raise(e))
+                put(_Raise(e))
 
         threading.Thread(target=worker, daemon=True,
                          name="tfde-device-prefetch").start()
 
+        empty_exc = _queue.Empty  # bind the class in the closure: at
+        # interpreter shutdown a GC'd generator's finally can run after
+        # module teardown has nulled `queue.Empty`
+
         def gen():
-            while True:
-                item = q.get()
-                if item is _END:
-                    return
-                if isinstance(item, _Raise):
-                    raise item.e
-                yield item
+            try:
+                while True:
+                    item = q.get()
+                    if item is _END:
+                        return
+                    if isinstance(item, _Raise):
+                        raise item.e
+                    yield item
+            finally:
+                # generator close/GC: release the worker and drop any
+                # buffered device arrays
+                stop.set()
+                try:
+                    while True:
+                        q.get_nowait()
+                except empty_exc:
+                    pass
 
         return gen()
 

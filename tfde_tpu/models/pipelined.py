@@ -178,7 +178,8 @@ class PipelinedLM:
         return logits.astype(jnp.float32)
 
     def _make_layer_fn(self, train: bool, base_key, in_pipe: bool,
-                       shard_axes: tuple = (), auto_axes: bool = False):
+                       shard_axes: tuple = (), auto_axes: bool = False,
+                       seq_ring: int = 1, manual_axes: tuple = ()):
         """One block application, scanned over a stage's layers. Carries
         (h, mb_idx); per-layer dropout key = fold_in(base, mb, layer) plus,
         inside the fully-manual pipe, the data-shard index (see
@@ -199,9 +200,17 @@ class PipelinedLM:
             if in_pipe and not auto_axes:
                 # fully-manual shard_map: every mesh axis is manual, so the
                 # blocks' `constrain` annotations (which name full-mesh
-                # axes) must degrade to identity here.
+                # axes) must degrade to identity here. With a >1 'seq'
+                # ring, attention must run the per-shard ring body
+                # (pp x sp) — manual_seq flips ops/attention's dispatch.
                 with axes_lib.use_axes(None):
-                    h = block.apply({"params": lp}, h, None, train, **kwargs)
+                    if seq_ring > 1:
+                        with axes_lib.manual_seq(seq_ring, manual_axes):
+                            h = block.apply({"params": lp}, h, None, train,
+                                            **kwargs)
+                    else:
+                        h = block.apply({"params": lp}, h, None, train,
+                                        **kwargs)
             elif in_pipe:
                 # partial-manual (auto) mode: non-pipe axes stay under the
                 # automatic partitioner — bind constraints to the abstract
@@ -229,10 +238,16 @@ class PipelinedLM:
         from tfde_tpu.parallel.sharding import data_axes as _data_axes
 
         auto = mesh is not None and self._pipe_mode(mesh) == "auto"
+        seq_ring = self._seq_ring(mesh) if mesh is not None else 1
         shard_axes = _data_axes(mesh) if (mesh is not None and base_key
                                           is not None and not auto) else ()
-        layer = self._make_layer_fn(train, base_key, in_pipe=True,
-                                    shard_axes=shard_axes, auto_axes=auto)
+        if shard_axes and seq_ring > 1:
+            shard_axes = shard_axes + ("seq",)  # uncorrelated dropout/shard
+        layer = self._make_layer_fn(
+            train, base_key, in_pipe=True, shard_axes=shard_axes,
+            auto_axes=auto, seq_ring=seq_ring,
+            manual_axes=tuple(mesh.axis_names) if mesh is not None else (),
+        )
         lps = self.layers_per_stage
 
         def stage_fn(stage_params, h, mb_idx):
@@ -284,6 +299,11 @@ class PipelinedLM:
             )
         return x.reshape((m, batch // m) + x.shape[1:])
 
+    @staticmethod
+    def _seq_ring(mesh) -> int:
+        return (mesh.shape["seq"]
+                if mesh is not None and "seq" in mesh.axis_names else 1)
+
     def _pipe_mesh(self):
         mesh = axes_lib.current_mesh()
         if (
@@ -291,14 +311,15 @@ class PipelinedLM:
             and "pipe" in mesh.axis_names
             and mesh.shape["pipe"] > 1
         ):
-            if "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
-                # covers the direct use_axes(mesh) entry point too, not
-                # just PipelineParallelStrategy's params_spec guard
+            if self._seq_ring(mesh) > 1 and self._pipe_mode(mesh) != "manual":
+                # pp x sp runs only in the fully-manual ring (the ring
+                # body inlines into the same flat manual region); the
+                # partial-manual 'tensor' mode would nest manual regions,
+                # which does not lower (Shardy, jax 0.9)
                 raise ValueError(
-                    "the pipeline does not compose with a 'seq' axis: the "
-                    "ring's backward residuals do not lower through nested "
-                    "manual regions (Shardy, jax 0.9) — use "
-                    "SequenceParallelStrategy for SP without pipelining"
+                    "pp x sp x tp does not compose: a 'seq' axis needs the "
+                    "fully-manual pipe (no 'tensor' axis / "
+                    "pipeline_mode='manual') — drop either tensor or seq"
                 )
             return mesh
         return None
@@ -345,7 +366,18 @@ class PipelinedLM:
         labels = tokens[:, 1:].astype(jnp.int32)
 
         mesh = self._pipe_mesh()
-        if mesh is None:
+        if mesh is None or self._seq_ring(mesh) > 1:
+            # no pipe mesh: the sequential fallback. pp x sp: loss on the
+            # GLOBAL sequence outside the pipe — the last-stage reduction
+            # would shift labels across seq-shard boundaries. Either way
+            # the full-logit path computes the exact shifted CE.
+            if mesh is not None and self.schedule == "1f1b":
+                raise NotImplementedError(
+                    "schedule='1f1b' does not compose with a 'seq' axis "
+                    "(its loss runs inside the pipe, where the shifted "
+                    "next-token loss would misalign at shard boundaries) "
+                    "— use schedule='gpipe' for pp x sp"
+                )
             logits = self.apply(variables, tokens, train=train, rngs=rngs)
             from tfde_tpu.ops.losses import masked_lm_loss
 

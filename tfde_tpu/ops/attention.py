@@ -110,6 +110,8 @@ def grouped_attention(
 
 
 def _seq_parallel_active() -> bool:
+    if axes_lib.manual_seq_info() is not None:
+        return True  # pp x sp: seq is a manual axis, no mesh to consult
     mesh = axes_lib.current_mesh()
     return mesh is not None and "seq" in mesh.axis_names and mesh.shape["seq"] > 1
 
@@ -144,7 +146,35 @@ def attention(
     bench.py flash config on v5e) — and the reference einsum otherwise (XLA
     fuses it optimally at short S). ``TFDE_FLASH=0`` disables the flash
     auto-pick; ``TFDE_FLASH=1`` lowers its threshold to S >= 1024.
+
+    Inside a fully-manual region whose 'seq' axis is manual (the pp x sp
+    pipeline, parallel/axes.manual_seq), dispatch goes straight to the
+    per-shard ring body — there is no mesh to consult in there, and local
+    attention over a seq shard would silently be the wrong math.
     """
+    manual = axes_lib.manual_seq_info()
+    if manual is not None:
+        if impl not in ("auto", "ring"):
+            # q/k/v here are per-shard sequence slices: any non-ring impl
+            # would silently attend within the shard only — wrong math
+            raise NotImplementedError(
+                f"attn_impl={impl!r} inside a seq-manual region would "
+                f"compute shard-local attention; use 'auto' or 'ring' "
+                f"(the per-shard ring body) under pp x sp"
+            )
+        ring_size, vary_axes = manual
+        if mask is not None:
+            raise NotImplementedError(
+                "ring attention inside a manual region supports causal "
+                "masking only (key-padding masks would need a sharded "
+                "validity plane threaded through the pipe)"
+            )
+        from tfde_tpu.ops import ring_attention as ra
+
+        return ra.ring_attention_manual(
+            q, k, v, causal=causal, ring_size=ring_size,
+            vary_axes=vary_axes,
+        )
     if impl == "auto":
         import os
 

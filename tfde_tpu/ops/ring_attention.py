@@ -90,6 +90,75 @@ def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
     return carry
 
 
+def ring_attention_manual(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_valid: Optional[jax.Array] = None,
+    causal: bool = False,
+    axis: str = "seq",
+    ring_size: int = 1,
+    block_k: int = 1024,
+    vary_axes: tuple = (),
+) -> jax.Array:
+    """The per-shard ring body, for callers ALREADY inside a manual region
+    where `axis` is a manual mesh axis — e.g. a stage of the fully-manual
+    pipeline (models/pipelined.py), which is how pp x sp composes: one
+    flat manual region, pipe hops and seq rotations side by side, AD
+    straight through (the round-3 refusal was about NESTED manual
+    regions; a flat one lowers fine — tests/test_pipelined_lm.py pp x sp
+    suite).
+
+    q/k/v are this shard's [B, S_local, H, D]; `ring_size` the number of
+    seq shards; `vary_axes` the manual axes accumulators must be typed
+    varying over (normally every manual axis in play). Returns the
+    local shard of softmax(QK^T)V over the GLOBAL sequence.
+    """
+    idx = jax.lax.axis_index(axis)
+    sq = q.shape[1]
+    out_dtype = q.dtype
+    q_pos = idx * sq + jnp.arange(sq)
+    b, _, h, d = q.shape
+    from tfde_tpu.parallel.axes import vary_over
+
+    o, m, l = (
+        vary_over(jnp.zeros((b, sq, h, d), jnp.float32), vary_axes),
+        vary_over(jnp.full((b, h, sq), _NEG, jnp.float32), vary_axes),
+        vary_over(jnp.zeros((b, h, sq), jnp.float32), vary_axes),
+    )
+    n = ring_size
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(t, carry):
+        o_m_l, k, v, kv_valid = carry
+        src = (idx - t) % n  # whose KV shard we hold at step t
+        k_pos = src * sq + jnp.arange(sq)
+        o_m_l = _block_attention(
+            o_m_l, q, k, v, kv_valid, q_pos, k_pos, causal, block_k=block_k
+        )
+
+        # rotate KV one hop; skipped after the last accumulation
+        def rotate(args):
+            k, v, kv_valid = args
+            k = jax.lax.ppermute(k, axis, perm)
+            v = jax.lax.ppermute(v, axis, perm)
+            if kv_valid is not None:
+                kv_valid = jax.lax.ppermute(kv_valid, axis, perm)
+            return k, v, kv_valid
+
+        k, v, kv_valid = jax.lax.cond(
+            t < n - 1, rotate, lambda args: args, (k, v, kv_valid)
+        )
+        return o_m_l, k, v, kv_valid
+
+    (o, m, l), _, _, _ = jax.lax.fori_loop(
+        0, n, body, ((o, m, l), k, v, kv_valid)
+    )
+    l = jnp.maximum(l, 1e-20)  # fully-masked rows (padding) stay finite
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(out_dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -120,11 +189,11 @@ def ring_attention(
             )
         kv_valid = mask[:, 0, 0, :].astype(jnp.bool_)
 
-    # Note on pp x sp: the FORWARD of this construction nests inside a
-    # partial-manual pipe region (AbstractMesh with 'pipe' typed Manual),
-    # but the backward's saved residuals do not lower — Shardy (jax 0.9)
-    # rejects their shardings inside a nested manual computation — so
-    # PipelineParallelStrategy refuses 'seq' axes loudly instead.
+    # Note on pp x sp: NESTING this shard_map inside a partial-manual pipe
+    # region does not lower (Shardy, jax 0.9, backward residuals) — the
+    # composition instead runs the extracted `ring_attention_manual` body
+    # directly inside the pipe's FULLY-manual region (models/pipelined.py
+    # via parallel/axes.manual_seq), one flat region, AD straight through.
     batch = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
     batch = batch if batch else None
     heads = "tensor" if "tensor" in mesh.axis_names else None
@@ -132,53 +201,15 @@ def ring_attention(
     valid_spec = P(batch, axis)
 
     n = mesh.shape[axis]
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def local(q, k, v, kv_valid):
-        idx = jax.lax.axis_index(axis)
-        sq = q.shape[1]
-        out_dtype = q.dtype
-        q_pos = idx * sq + jnp.arange(sq)
-        b, _, h, d = q.shape
-        # mark the accumulators device-varying over every mesh axis (the
-        # incoming q/k/v end up varying over all of them, and the fori_loop
-        # carry type check requires input/output variance to match)
-        from tfde_tpu.parallel.axes import vary_over
-
-        o, m, l = (
-            vary_over(jnp.zeros((b, sq, h, d), jnp.float32), mesh.axis_names),
-            vary_over(jnp.full((b, h, sq), _NEG, jnp.float32), mesh.axis_names),
-            vary_over(jnp.zeros((b, h, sq), jnp.float32), mesh.axis_names),
+        # accumulators typed varying over every mesh axis: the incoming
+        # q/k/v end up varying over all of them, and the fori_loop carry
+        # type check requires input/output variance to match
+        return ring_attention_manual(
+            q, k, v, kv_valid, causal=causal, axis=axis, ring_size=n,
+            block_k=block_k, vary_axes=tuple(mesh.axis_names),
         )
-
-        def body(t, carry):
-            o_m_l, k, v, kv_valid = carry
-            src = (idx - t) % n  # whose KV shard we hold at step t
-            k_pos = src * sq + jnp.arange(sq)
-            o_m_l = _block_attention(
-                o_m_l, q, k, v, kv_valid, q_pos, k_pos, causal,
-                block_k=block_k,
-            )
-            # rotate KV one hop; skipped after the last accumulation
-            def rotate(args):
-                k, v, kv_valid = args
-                k = jax.lax.ppermute(k, axis, perm)
-                v = jax.lax.ppermute(v, axis, perm)
-                if kv_valid is not None:
-                    kv_valid = jax.lax.ppermute(kv_valid, axis, perm)
-                return k, v, kv_valid
-
-            k, v, kv_valid = jax.lax.cond(
-                t < n - 1, rotate, lambda args: args, (k, v, kv_valid)
-            )
-            return o_m_l, k, v, kv_valid
-
-        (o, m, l), _, _, _ = jax.lax.fori_loop(
-            0, n, body, ((o, m, l), k, v, kv_valid)
-        )
-        l = jnp.maximum(l, 1e-20)  # fully-masked rows (padding) stay finite
-        out = o / l.transpose(0, 2, 1)[..., None]
-        return out.astype(out_dtype)
 
     if kv_valid is None:
         # thread a dummy validity plane so the shard_map signature is static

@@ -92,3 +92,24 @@ def batch_axes() -> tuple:
     'fsdp') — mirrors sharding.batch_spec so activation constraints agree
     with the input sharding."""
     return ("data", "fsdp")
+
+
+@contextlib.contextmanager
+def manual_seq(ring_size: int, vary_axes: Sequence[str] = ()):
+    """Mark this thread as INSIDE a fully-manual region whose 'seq' axis is
+    manual with `ring_size` shards — the pp x sp composition signal
+    (models/pipelined.py sets it around stage bodies; ops/attention.py
+    dispatches to ring_attention_manual when it is set, since the usual
+    mesh-based dispatch sees no mesh inside a fully-manual shard_map).
+    `vary_axes`: every manual axis in play, for accumulator variance."""
+    prev = getattr(_state, "manual_seq", None)
+    _state.manual_seq = (int(ring_size), tuple(vary_axes))
+    try:
+        yield
+    finally:
+        _state.manual_seq = prev
+
+
+def manual_seq_info() -> Optional[tuple]:
+    """(ring_size, vary_axes) when inside a manual_seq region, else None."""
+    return getattr(_state, "manual_seq", None)

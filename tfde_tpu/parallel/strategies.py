@@ -385,21 +385,27 @@ class PipelineParallelStrategy(Strategy):
         data: int = 1,
         pipe: Optional[int] = None,
         tensor: int = 1,
+        seq: int = 1,
     ):
         self._data = data
         self._pipe = pipe
         self._tensor = tensor
+        self._seq = seq
         super().__init__(mesh)
 
     def _default_mesh(self) -> Mesh:
         axes = {"data": self._data, "pipe": self._pipe or -1}
         if self._tensor > 1:
             axes["tensor"] = self._tensor
+        if self._seq > 1:
+            axes["seq"] = self._seq
         if self._pipe is not None:
-            # explicit stage count: use the first data*pipe*tensor devices
-            # so the mesh matches the model's num_stages even when the host
-            # has more
-            devices = jax.devices()[: self._data * self._pipe * self._tensor]
+            # explicit stage count: use the first data*pipe*tensor*seq
+            # devices so the mesh matches the model's num_stages even when
+            # the host has more
+            devices = jax.devices()[
+                : self._data * self._pipe * self._tensor * self._seq
+            ]
             return mesh_lib.make_mesh(axes, devices)
         return mesh_lib.make_mesh(axes)
 
@@ -412,12 +418,15 @@ class PipelineParallelStrategy(Strategy):
                 "would replicate every weight across the tensor devices — "
                 "use TensorParallelStrategy for TP without pipelining"
             )
-        if self.mesh.shape.get("seq", 1) > 1:
+        if self.mesh.shape.get("seq", 1) > 1 and tsize > 1:
+            # pp x sp runs in the FULLY-manual ring (the per-shard ring
+            # body inlines into the same flat manual region); the
+            # partial-manual mode tensor>1 needs would nest manual
+            # regions, which does not lower (Shardy, jax 0.9)
             raise ValueError(
-                "PipelineParallelStrategy does not compose with a 'seq' "
-                "axis: the ring's backward residuals do not lower through "
-                "nested manual regions (Shardy, jax 0.9) — use "
-                "SequenceParallelStrategy for SP without pipelining"
+                "pp x sp x tp does not compose: a 'seq' axis needs the "
+                "fully-manual pipe, a 'tensor' axis the partial-manual "
+                "one — drop either tensor or seq"
             )
 
         def leaf_spec(path, leaf):
