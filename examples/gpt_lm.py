@@ -73,6 +73,11 @@ def main(argv=None):
                         help="size of the 'pipe' mesh axis (GPipe stages)")
     parser.add_argument("--microbatches", type=int, default=4,
                         help="GPipe microbatches (with --pipeline)")
+    parser.add_argument("--schedule", default="gpipe",
+                        choices=["gpipe", "1f1b"],
+                        help="with --pipeline: backward schedule — gpipe "
+                             "(AD, O(M+S) activation memory) or 1f1b "
+                             "(explicit interleave, O(S) memory)")
     parser.add_argument("--tensor", type=int, default=1,
                         help="with --pipeline: Megatron tensor-parallel "
                              "size inside each stage (dp x pp x tp, 3D)")
@@ -123,8 +128,19 @@ def main(argv=None):
             "--kv-heads applies to the GPT decoder; PipelinedLM keeps "
             "classic MHA (drop --pipeline to use GQA)"
         )
-    if args.pipeline > 1 and args.seq_parallel > 1:
-        raise ValueError("--pipeline and --seq-parallel don't compose yet")
+    if args.pipeline > 1 and args.seq_parallel > 1 and args.tensor > 1:
+        raise ValueError(
+            "--pipeline + --seq-parallel + --tensor don't compose: pp x sp "
+            "needs the fully-manual pipe, tp the partial-manual one — drop "
+            "--tensor or --seq-parallel"
+        )
+    if args.schedule == "1f1b" and args.pipeline <= 1:
+        raise ValueError("--schedule 1f1b applies to --pipeline runs")
+    if args.schedule == "1f1b" and (args.tensor > 1 or args.seq_parallel > 1):
+        raise ValueError(
+            "--schedule 1f1b runs in the plain dp x pp ring (no "
+            "--tensor/--seq-parallel); use the default gpipe schedule there"
+        )
     if args.moe > 1 and (args.pipeline > 1 or args.seq_parallel > 1):
         # loud, not silent: PipelinedLM has no MoE blocks, and the seq/pipe
         # strategies would drop the expert-axis sharding --moe promises
@@ -164,7 +180,7 @@ def main(argv=None):
         if args.tiny:
             model = pipelined_tiny_test(
                 num_stages=args.pipeline, microbatches=args.microbatches,
-                remat=args.remat,
+                remat=args.remat, schedule=args.schedule,
             )
         else:
             # GPT-2 small dims, depth 12 split across the stages
@@ -174,7 +190,7 @@ def main(argv=None):
                 num_stages=args.pipeline,
                 layers_per_stage=12 // args.pipeline,
                 microbatches=args.microbatches,
-                remat=args.remat,
+                remat=args.remat, schedule=args.schedule,
             )
     else:
         model_kw = {"num_experts": args.moe} if args.moe > 1 else {}
@@ -204,15 +220,18 @@ def main(argv=None):
         from tfde_tpu.parallel.strategies import PipelineParallelStrategy
 
         n = jax.device_count()
-        if n % (args.pipeline * args.tensor):
+        inner = args.pipeline * args.tensor * max(args.seq_parallel, 1)
+        if n % inner:
             raise ValueError(
-                f"--pipeline {args.pipeline} x --tensor {args.tensor} must "
-                f"divide the device count {n}"
+                f"--pipeline {args.pipeline} x --tensor {args.tensor} x "
+                f"--seq-parallel {max(args.seq_parallel, 1)} must divide "
+                f"the device count {n}"
             )
         strategy = PipelineParallelStrategy(
-            data=n // (args.pipeline * args.tensor),
+            data=n // inner,
             pipe=args.pipeline,
             tensor=args.tensor,
+            seq=max(args.seq_parallel, 1),
         )
     elif args.seq_parallel > 1:
         n = jax.device_count()

@@ -47,6 +47,9 @@ SMOKE = {
     "test_moe.py::test_moe_output_shape_and_aux_loss",         # MoE/EP
     "test_grad_accum.py::test_grad_accum_rejects_indivisible_batch",
     "test_transformer.py::test_causal_masking_blocks_future",  # attention
+    "test_transformer.py::test_fused_qkv_matches_unfused",     # fused qkv
+    "test_streaming.py::test_one_epoch_exact_multiset",   # streaming input
+    "test_pipelined_lm.py::test_1f1b_single_stage_direct",  # 1F1B schedule
     "test_rotary.py",  # whole file: tiny pure-math checks            (RoPE)
 }
 

@@ -102,3 +102,29 @@ def test_gpt_example_smoke():
     )
     assert int(jax.device_get(state.step)) == 2
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+def test_gpt_example_pp_sp_and_1f1b_smoke():
+    """The example entrypoint drives the round-4 compositions: pp x sp
+    (ring inside the manual pipe) and the 1F1B schedule."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from examples import gpt_lm
+
+    state, metrics = gpt_lm.main(
+        ["--tiny", "--seq-len", "32", "--max-steps", "2", "--batch-size",
+         "8", "--train-examples", "64", "--pipeline", "2",
+         "--seq-parallel", "2"]
+    )
+    assert int(jax.device_get(state.step)) == 2
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+    state, metrics = gpt_lm.main(
+        ["--tiny", "--seq-len", "32", "--max-steps", "2", "--batch-size",
+         "16", "--train-examples", "64", "--pipeline", "2",
+         "--schedule", "1f1b"]
+    )
+    assert int(jax.device_get(state.step)) == 2
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
