@@ -28,7 +28,14 @@ pipeline_apply's partial-manual mode — stage weights shard over 'pipe' AND
 Megatron-split over 'tensor' (PipelineParallelStrategy(tensor=T)), with the
 automatic partitioner inserting the TP collectives inside the ring
 (dp x pp x tp; tests/test_pipelined_lm.py::test_3d_dp_pp_tp_matches_dp).
-A 'seq' axis is refused loudly — see _pipe_mesh.
+
+pp x sp (round 4): a >1 'seq' axis shards the SEQUENCE inside the
+fully-manual pipe — stage attention runs the per-shard ring body
+(ops/ring_attention.ring_attention_manual via parallel/axes.manual_seq),
+activations shard their seq dim in the pipe specs, and the loss routes
+through the full-logit path outside the pipe (a last-stage shifted loss
+would misalign at shard boundaries). pp x sp x tp and 1F1B+seq are
+refused loudly — see _pipe_mesh / parallel/pipeline.py.
 
 Dropout (round-3, closing VERDICT r2 weak #8's capability cliff vs GPT):
 `dropout_rate > 0` threads per-tick keys through the shard_map schedule —
@@ -75,6 +82,7 @@ class PipelinedLM:
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"
+    fused_qkv: bool = False  # one-GEMM qkv projection (transformer.py)
     remat: Any = False  # False | True/'full' | 'dots' (transformer.remat_policy)
     # pipeline_apply execution mode: None auto-selects — 'auto' (partial-
     # manual shard_map; required for tensor-parallel stage weights, dp x pp
@@ -99,6 +107,7 @@ class PipelinedLM:
             dtype=self.dtype,
             dropout_rate=self.dropout_rate,
             attn_impl=self.attn_impl,
+            fused_qkv=self.fused_qkv,
             causal=True,
             norm_style="pre",
         )
