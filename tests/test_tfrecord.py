@@ -104,3 +104,29 @@ def test_multiple_files(tmp_path):
     write_tfrecord(p2, [b"b"])
     ds = tfrecord_dataset([p1, p2])
     assert [e[0] for e in iter(ds)] == [b"a", b"b"]
+
+
+def test_interop_tfdata_reads_our_files(tmp_path):
+    """Cross-implementation wire-format check: records written by our
+    TFRecordWriter must parse byte-for-byte in real tf.data (the consumer
+    a reference-era shop already runs)."""
+    tf = pytest.importorskip("tensorflow")
+
+    path = str(tmp_path / "ours.tfrecord")
+    payloads = [f"record-{i}".encode() for i in range(7)] + [b"", b"\x00" * 33]
+    write_tfrecord(path, payloads)
+    got = [bytes(r.numpy()) for r in tf.data.TFRecordDataset(path)]
+    assert got == payloads
+
+
+def test_interop_we_read_tf_written_files(tmp_path):
+    """And the other direction: files from tf.io.TFRecordWriter stream
+    through our reader with CRC verification on."""
+    tf = pytest.importorskip("tensorflow")
+
+    path = str(tmp_path / "theirs.tfrecord")
+    payloads = [f"tf-rec-{i}".encode() for i in range(5)] + [b"\xff" * 100]
+    with tf.io.TFRecordWriter(path) as w:
+        for p in payloads:
+            w.write(p)
+    assert list(read_tfrecord(path, verify_crc=True)) == payloads

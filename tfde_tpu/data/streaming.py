@@ -146,6 +146,7 @@ class StreamingTFRecordLoader:
         self._inner = None  # gather engine over the current window
         self._window_idx = 0
         self._done = False
+        self._leftover = None  # rows spilling across a window boundary
 
     # -- reader thread ------------------------------------------------------
     _EPOCH_END = object()
@@ -216,19 +217,12 @@ class StreamingTFRecordLoader:
                     for b, v in zip(bufs, row):
                         b[count] = v
                     count += 1
-                if not exhausted:
-                    # keep windows batch-aligned: defer the tail rows to
-                    # the next window (mid-epoch they just shuffle there
-                    # instead; at an epoch boundary [flush] they join the
-                    # next epoch's first window — batches cross epochs,
-                    # the repeat().batch() contract)
-                    tail = count % self._batch
-                    if tail:
-                        carry = [
-                            tuple(b[count - tail + i].copy() for b in bufs)
-                            for i in range(tail)
-                        ]
-                        count -= tail
+                # no tail trimming: each window's gather emits a short
+                # final chunk and the CONSUMER re-batches across windows —
+                # boundary rows therefore precede the next window's (the
+                # tf.data `shuffle(B).repeat().batch()` ordering law:
+                # every epoch-N record is emitted before any epoch-N+1
+                # record; tests/test_tfdata_parity.py asserts it)
                 if count:
                     self._q.put((bufs, count, exhausted))
             self._q.put(None)
@@ -246,28 +240,29 @@ class StreamingTFRecordLoader:
             raise item[1]
         bufs, count, is_last = item
         views = [b[:count] for b in bufs]
-        drop = self._drop_remainder or not is_last
         seed = np.random.default_rng(
             (self._seed, 7, self._window_idx)
         ).integers(0, 2**63)
         self._window_idx += 1
         from tfde_tpu import native
 
+        # engines always emit the window's short final chunk
+        # (drop_remainder=False): __next__ re-batches across windows, so
+        # boundary rows keep their position in the stream
         if native.available():
             self._inner = native.NativeBatchLoader(
                 views, self._batch, shuffle=self._shuffle, seed=int(seed),
-                repeat=1, drop_remainder=drop, **self._native_kw,
+                repeat=1, drop_remainder=False, **self._native_kw,
             )
         else:
-            self._inner = self._numpy_window(views, count, drop, int(seed))
+            self._inner = self._numpy_window(views, count, int(seed))
 
-    def _numpy_window(self, views, count, drop, seed):
+    def _numpy_window(self, views, count, seed):
         order = (np.random.default_rng(seed).permutation(count)
                  if self._shuffle else np.arange(count))
-        end = count - (count % self._batch) if drop else count
 
         def gen():
-            for start in range(0, end, self._batch):
+            for start in range(0, count, self._batch):
                 idx = order[start : start + self._batch]
                 yield tuple(v[idx] for v in views)
 
@@ -276,16 +271,47 @@ class StreamingTFRecordLoader:
     def __iter__(self):
         return self
 
-    def __next__(self) -> Tuple[np.ndarray, ...]:
+    def _pull_chunk(self):
+        """Next (possibly short) chunk from the window engines."""
         while True:
-            if self._done:
-                raise StopIteration
             if self._inner is None:
-                self._next_window()
+                self._next_window()  # raises StopIteration at end
             try:
                 return next(self._inner)
             except StopIteration:
                 self._inner = None
+
+    def __next__(self) -> Tuple[np.ndarray, ...]:
+        if self._done:
+            raise StopIteration
+        parts = [self._leftover] if self._leftover is not None else []
+        have = parts[0][0].shape[0] if parts else 0
+        while have < self._batch:
+            try:
+                chunk = self._pull_chunk()
+            except StopIteration:
+                if parts and not self._drop_remainder:
+                    self._leftover = None
+                    self._done = True
+                    return tuple(np.concatenate(c, axis=0) if len(parts) > 1
+                                 else c[0]
+                                 for c in zip(*parts))
+                self._done = True
+                raise
+            parts.append(chunk)
+            have += chunk[0].shape[0]
+        merged = tuple(
+            np.concatenate(c, axis=0) if len(parts) > 1 else c[0]
+            for c in zip(*parts)
+        )
+        if have > self._batch:
+            # copy the spill: under copy=False it would otherwise alias a
+            # ring slot that the next _pull_chunk recycles
+            self._leftover = tuple(a[self._batch :].copy() for a in merged)
+            merged = tuple(a[: self._batch] for a in merged)
+        else:
+            self._leftover = None
+        return merged
 
     def close(self) -> None:
         self._stop.set()
