@@ -1,0 +1,110 @@
+"""Continuous batching (inference/server.py): every request's greedy
+output must equal its solo generate() run, no matter what shares the
+batch, when it was admitted, or which recycled row it landed on."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.inference.decode import generate
+from tfde_tpu.inference.server import ContinuousBatcher
+from tfde_tpu.models.gpt import GPT, gpt_tiny_test
+
+
+@pytest.fixture(scope="module")
+def lm():
+    m = gpt_tiny_test()
+    params = m.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+def _solo(model, params, prompt, n, **kw):
+    toks, lengths = generate(
+        model, params, jnp.asarray(prompt[None, :], jnp.int32),
+        max_new_tokens=n, **kw,
+    )
+    p = prompt.size
+    return np.asarray(toks)[0, p : int(lengths[0])]
+
+
+def test_batch_of_varied_requests_matches_solo(lm, rng):
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=3, max_len=48)
+    reqs = {}
+    for i, (plen, n) in enumerate([(3, 9), (5, 4), (2, 12), (7, 7), (4, 1),
+                                   (6, 10), (3, 3)]):
+        prompt = rng.integers(0, 97, plen).astype(np.int64)
+        rid = srv.submit(prompt, max_new_tokens=n)
+        reqs[rid] = (prompt, n)
+    done = dict(srv.run())
+    assert srv.idle
+    assert set(done) == set(reqs)
+    for rid, (prompt, n) in reqs.items():
+        np.testing.assert_array_equal(
+            done[rid], _solo(model, params, prompt, n), err_msg=f"req {rid}"
+        )
+
+
+def test_staggered_submission_mid_flight(lm, rng):
+    """Requests submitted while others are mid-generation take freed rows
+    and still match solo runs — the continuous part of the batching."""
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48)
+    p0 = rng.integers(0, 97, 4).astype(np.int64)
+    p1 = rng.integers(0, 97, 3).astype(np.int64)
+    r0 = srv.submit(p0, max_new_tokens=3)   # finishes quickly
+    r1 = srv.submit(p1, max_new_tokens=10)  # keeps running
+    done = {}
+    for _ in range(3):
+        done.update(srv.step())
+    assert r0 in done  # the short request already finished
+    p2 = rng.integers(0, 97, 5).astype(np.int64)  # lands in r0's old row
+    r2 = srv.submit(p2, max_new_tokens=6)
+    done.update(srv.run())
+    assert set(done) == {r0, r1, r2}
+    np.testing.assert_array_equal(done[r0], _solo(model, params, p0, 3))
+    np.testing.assert_array_equal(done[r1], _solo(model, params, p1, 10))
+    np.testing.assert_array_equal(done[r2], _solo(model, params, p2, 6))
+
+
+def test_eos_and_instant_finish(lm, rng):
+    model, params = lm
+    prompt = rng.integers(0, 97, 4).astype(np.int64)
+    free = _solo(model, params, prompt, 10)
+    eos = int(free[2])  # third generated token
+    ref = _solo(model, params, prompt, 10, eos_id=eos, pad_id=0)
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48,
+                            eos_id=eos)
+    rid = srv.submit(prompt, max_new_tokens=10)
+    one = srv.submit(prompt, max_new_tokens=1)  # budget-1: first token only
+    done = dict(srv.run())
+    np.testing.assert_array_equal(done[rid], ref)
+    np.testing.assert_array_equal(done[one], free[:1])
+
+
+def test_rope_gqa_model(rng):
+    m = GPT(vocab_size=97, hidden_size=32, depth=2, num_heads=4, mlp_dim=64,
+            max_position=64, dtype=jnp.float32, position="rope",
+            num_kv_heads=2)
+    params = m.init(jax.random.key(3), jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = ContinuousBatcher(m, params, batch_size=2, max_len=40)
+    prompts = [rng.integers(0, 97, p).astype(np.int64) for p in (3, 5, 4)]
+    rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+    done = dict(srv.run())
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(done[rid], _solo(m, params, p, 6))
+
+
+def test_queue_longer_than_batch_and_validation(lm, rng):
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=1, max_len=32)
+    with pytest.raises(ValueError, match="max_len"):
+        srv.submit(np.zeros(30, np.int64), max_new_tokens=10)
+    with pytest.raises(ValueError, match="at least one"):
+        srv.submit(np.zeros(0, np.int64), max_new_tokens=4)
+    rids = [srv.submit(rng.integers(0, 97, 3).astype(np.int64), 4)
+            for _ in range(5)]
+    done = dict(srv.run())
+    assert set(done) == set(rids)
+    assert all(len(v) == 4 for v in done.values())

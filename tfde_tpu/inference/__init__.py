@@ -11,5 +11,7 @@ from tfde_tpu.inference.decode import (
 )
 from tfde_tpu.inference.speculative import generate_speculative
 
-__all__ = ["beam_search", "generate", "generate_ragged",
-           "generate_speculative", "init_cache", "sample_logits"]
+__all__ = ["ContinuousBatcher", "beam_search", "generate",
+           "generate_ragged", "generate_speculative", "init_cache",
+           "sample_logits"]
+from tfde_tpu.inference.server import ContinuousBatcher  # noqa: F401

@@ -1,0 +1,274 @@
+"""Continuous batching — the serving loop that keeps every batch row busy.
+
+`generate` (inference/decode.py) serves one batch to completion: rows that
+finish early ride along as padding until the slowest row ends, and new
+requests wait for the whole batch. A serving deployment wants the modern
+alternative: a FIXED decode batch where a finished row is immediately
+re-used for the next queued request while the other rows keep decoding —
+continuous batching (the vLLM/Orca scheduling idea, re-built on this
+framework's primitives).
+
+What makes it cheap here: the per-row KV-cache machinery built for
+batched speculative decoding (models/transformer.py `_decode_attention`
+vector branch + per-row `position_index`) already lets every batch row
+sit at a DIFFERENT sequence position with its own validity horizon.
+Admission is then per-row cache surgery:
+
+- one compiled DECODE tick serves the whole batch ([B, 1] tokens,
+  per-row [B] cache indices — stale K/V beyond a row's index is
+  unreachable, so re-using a slot needs no cache clearing);
+- one compiled PREFILL per distinct prompt length runs the new request
+  on a single-row cache, whose K/V leaves are scattered into the big
+  cache at the freed row (`.at[row].set`), and whose last-position
+  logits seed the row's first token immediately;
+- sampling, EOS, and budget bookkeeping are per-row host state.
+
+Greedy determinism: each request's output equals a solo
+`generate(model, params, prompt)` run token for token regardless of what
+shares the batch (tests/test_server.py asserts it across staggered
+admissions). Temperature>0 draws ride a shared key stream —
+distributionally correct per request, draw values batch-dependent.
+
+Prompt-length compiles: `_prefill_row` retraces per distinct prompt
+length (the `generate` trade) — bucket or pad prompts upstream if your
+traffic has many lengths.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfde_tpu.inference.decode import (
+    _decode_clone,
+    init_cache,
+    sample_logits,
+    validate_budget,
+)
+from tfde_tpu.inference.speculative import _set_index_counters
+
+
+@functools.partial(jax.jit, static_argnames=("model",), donate_argnums=(1,))
+def _decode_tick(model, cache, params, toks):
+    """One decode step for the whole batch: [B] tokens in, fp32 [B, V]
+    last-position logits out. Per-row cache indices advance by 1."""
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, toks[:, None], train=False,
+        mutable=["cache"],
+    )
+    return mutated["cache"], logits[:, -1].astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _prefill_row(model, row_cache, params, prompt):
+    """Prefill a single-row cache with a [1, P] prompt; returns the filled
+    cache and fp32 [1, V] last-position logits. Compiled per prompt
+    length."""
+    logits, mutated = model.apply(
+        {"params": params, "cache": row_cache}, prompt, train=False,
+        mutable=["cache"],
+    )
+    return mutated["cache"], logits[:, -1].astype(jnp.float32)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_row(cache, row_cache, row):
+    """Write a single-row cache's K/V leaves into batch row `row` — the
+    batch cache is donated, so the update lowers in place instead of
+    copying every [B, max_len, ...] leaf per admission. Index counters
+    pass through (they are rewound wholesale before the next tick)."""
+
+    def merge(path, big, small):
+        name = str(getattr(path[-1], "key", path[-1]))
+        if name in ("cache_index", "position_index"):
+            return big
+        return big.at[row].set(small[0])
+
+    return jax.tree_util.tree_map_with_path(merge, cache, row_cache)
+
+
+class ContinuousBatcher:
+    """Fixed-batch continuous serving loop over a causal LM.
+
+    model/params: a decode-capable model (GPT family) and its params.
+    batch_size: resident decode rows. max_len: per-row cache budget
+    (prompt + generated must fit). The sampling config is fixed per
+    batcher, as for `generate`.
+
+    Usage::
+
+        srv = ContinuousBatcher(model, params, batch_size=4, max_len=256)
+        rid = srv.submit(prompt_1d, max_new_tokens=64)
+        while not srv.idle:
+            for req_id, tokens in srv.step():
+                ...   # finished requests, completion order
+
+    `step()` admits queued requests into free rows (per-row prefill) and
+    runs ONE decode tick for the batch; it returns the requests finishing
+    on that call. `run()` drains everything.
+
+    Invariant per active row r (the speculative-decoding contract): the
+    cache holds K/V for exactly `committed[r]` tokens and `tok[r]` is the
+    last generated-but-unfed token.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        batch_size: int,
+        max_len: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        pad_id: int = 0,
+        rng: Optional[jax.Array] = None,
+    ):
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        self._decode_model = _decode_clone(model)
+        self._model = model
+        self._params = params
+        self._b = batch_size
+        self._max_len = int(max_len)
+        self._sample = functools.partial(
+            sample_logits, temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        self._eos = eos_id
+        self._pad = pad_id
+        self._rng = rng if rng is not None else jax.random.key(0)
+
+        self._cache = init_cache(model, batch_size, self._max_len)
+        # zero single-row cache template, built once: _prefill_row does
+        # not donate its cache argument, so the template survives reuse
+        self._row_template = init_cache(model, 1, self._max_len)
+        self._req = [None] * batch_size          # request id or None
+        self._out = [[] for _ in range(batch_size)]
+        self._budget = np.zeros(batch_size, np.int64)
+        self._committed = np.zeros(batch_size, np.int64)
+        self._tok = np.full(batch_size, pad_id, np.int64)
+        self._queue: collections.deque = collections.deque()
+        self._next_id = 0
+        # device indices match self._committed only after a rewind; any
+        # admission or completion desyncs them until the next tick rewinds
+        self._indices_dirty = True
+
+    # -- public -------------------------------------------------------------
+    @property
+    def idle(self) -> bool:
+        return not self._queue and all(r is None for r in self._req)
+
+    @property
+    def free_rows(self) -> int:
+        return sum(r is None for r in self._req)
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Queue a request; returns its id. prompt: 1-D int token ids."""
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        validate_budget(self._model, int(prompt.size), max_new_tokens)
+        if prompt.size + max_new_tokens > self._max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the batcher's max_len "
+                f"{self._max_len}"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append((rid, prompt, int(max_new_tokens)))
+        return rid
+
+    def step(self) -> list:
+        """Admit into free rows, run one decode tick; returns
+        [(request_id, tokens 1-D np.int32), ...] that finished now."""
+        finished = self._admit()
+        active = [r for r in range(self._b) if self._req[r] is not None]
+        if not active:
+            return finished
+
+        if self._indices_dirty:
+            # host values, not a shared jnp array: every index leaf gets
+            # its own buffer (the donated-cache aliasing rule). Steady
+            # state (no admissions/completions) skips this: the device
+            # indices advance by exactly 1 per tick, matching _committed.
+            self._cache = _set_index_counters(
+                self._cache, self._committed.astype(np.int32)
+            )
+            self._indices_dirty = False
+        self._cache, logits = _decode_tick(
+            self._decode_model, self._cache, self._params,
+            jnp.asarray(self._tok, jnp.int32),
+        )
+        self._rng, sub = jax.random.split(self._rng)
+        toks = np.asarray(self._sample(logits, sub))
+        for r in active:
+            # feeding tok[r] committed it; the new sample is now pending
+            self._committed[r] += 1
+            finished.extend(self._take_token(r, int(toks[r])))
+        return finished
+
+    def run(self) -> list:
+        """Step until idle; returns every completion in finish order."""
+        done = []
+        while not self.idle:
+            done.extend(self.step())
+        return done
+
+    # -- internals ----------------------------------------------------------
+    def _take_token(self, r: int, t: int) -> list:
+        """Record a sampled token for row r; frees the row on completion."""
+        self._out[r].append(t)
+        self._budget[r] -= 1
+        self._tok[r] = t
+        if self._budget[r] <= 0 or (self._eos is not None and t == self._eos):
+            done = (self._req[r], np.asarray(self._out[r], np.int32))
+            self._req[r] = None
+            self._out[r] = []
+            self._committed[r] = 0
+            self._tok[r] = self._pad
+            self._indices_dirty = True
+            return [done]
+        return []
+
+    def _admit(self) -> list:
+        """Fill free rows from the queue. The prefill samples the row's
+        first token immediately (generate's prefill contract), so every
+        active row uniformly holds one pending token afterwards. A
+        request finishing on its first token (budget 1 / instant EOS)
+        frees the row for the next queued request in the same call."""
+        finished = []
+        progress = True
+        while progress and self._queue:
+            progress = False
+            for r in range(self._b):
+                if not self._queue or self._req[r] is not None:
+                    continue
+                rid, prompt, budget = self._queue.popleft()
+                row_cache, logits = _prefill_row(
+                    self._decode_model, self._row_template, self._params,
+                    jnp.asarray(prompt[None, :], jnp.int32),
+                )
+                self._cache = _scatter_row(
+                    self._cache, row_cache, jnp.int32(r)
+                )
+                self._indices_dirty = True
+                self._rng, sub = jax.random.split(self._rng)
+                t = int(np.asarray(self._sample(logits, sub))[0])
+                self._req[r] = rid
+                self._out[r] = []
+                self._budget[r] = budget
+                self._committed[r] = prompt.size
+                finished.extend(self._take_token(r, t))
+                progress = True
+        return finished
