@@ -162,14 +162,12 @@ def test_convert_cli_round_trip(tmp_path, hf_gpt2, rng):
     hf_gpt2.save_pretrained(src)
     _cli(["gpt2", src, out])
 
-    z = np.load(f"{out}/params.npz")
-    params = _unflatten_params({k: z[k] for k in z.files})
+    from tfde_tpu.models.convert import load_converted
+
     conf = json.load(open(f"{out}/model_config.json"))
     assert conf["family"] == "gpt2"
-    model = GPT(
-        **{k: v for k, v in conf.items() if k not in ("family", "dtype")},
-        dtype=jnp.float32,
-    )
+    model, params = load_converted(out, dtype=jnp.float32)
+    assert isinstance(model, GPT)
     ids = rng.integers(0, 97, (1, 10)).astype(np.int32)
     ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
     with torch.no_grad():

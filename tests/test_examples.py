@@ -83,3 +83,20 @@ def test_gpt_lm_example_3d_and_moe_smoke():
          "16", "--moe", "4"]
     )
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+def test_serve_gpt_example():
+    """The continuous-batching serving demo drains its queue with every
+    request completed at full budget."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from examples import serve_gpt
+
+    done = serve_gpt.main(
+        ["--tiny", "--requests", "5", "--batch-size", "2",
+         "--max-new-tokens", "6", "--max-len", "32"]
+    )
+    assert len(done) == 5
+    assert all(len(toks) == 6 for _, toks in done)

@@ -308,6 +308,41 @@ _FAMILIES = {
 }
 
 
+def load_converted(artifact_dir: str, dtype=None):
+    """(model, params) from a conversion-CLI artifact directory
+    (params.npz + model_config.json, written by
+    `python -m tfde_tpu.models.convert`). The public loader every
+    consumer of converted checkpoints uses — the serving example,
+    notebooks, and the CLI round-trip test share this one rebuild path.
+
+    dtype overrides the recorded compute dtype (e.g. jnp.float32 on CPU).
+    """
+    import io
+    import json
+
+    import jax.numpy as jnp
+
+    from tfde_tpu.export.serving import _unflatten_params
+    from tfde_tpu.utils import fs
+
+    with fs.fs_open(fs.join(artifact_dir, "model_config.json"), "r") as f:
+        conf = json.load(f)
+    family = conf.pop("family")
+    recorded = conf.pop("dtype")
+    kwargs = dict(conf)
+    kwargs["dtype"] = jnp.dtype(dtype if dtype is not None else recorded)
+
+    from tfde_tpu.models.bert import Bert
+    from tfde_tpu.models.gpt import GPT
+
+    cls = {"gpt2": GPT, "llama": GPT, "bert": Bert}[family]
+    model = cls(**kwargs)
+    with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
+        z = np.load(io.BytesIO(f.read()))
+        params = _unflatten_params({k: z[k] for k in z.files})
+    return model, params
+
+
 def _cli(argv=None) -> str:
     """Convert a local HF checkpoint directory into this framework's
     artifact: <out>/params.npz (flat, the export/serving layout) +
