@@ -108,3 +108,80 @@ def test_queue_longer_than_batch_and_validation(lm, rng):
     done = dict(srv.run())
     assert set(done) == set(rids)
     assert all(len(v) == 4 for v in done.values())
+
+
+# --------------------------------------------------------------------------
+# SpeculativeContinuousBatcher: draft-accelerated continuous serving
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def draft():
+    m = GPT(vocab_size=97, hidden_size=16, depth=1, num_heads=2, mlp_dim=32,
+            max_position=64, dtype=jnp.float32)
+    params = m.init(jax.random.key(9), jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+def test_speculative_batcher_matches_solo(lm, draft, rng):
+    from tfde_tpu.inference.server import SpeculativeContinuousBatcher
+
+    model, params = lm
+    dmodel, dparams = draft
+    srv = SpeculativeContinuousBatcher(
+        model, dmodel, params, dparams, batch_size=2, max_len=40,
+        num_draft=3,
+    )
+    reqs = {}
+    for plen, n in [(3, 8), (5, 5), (2, 11), (6, 4), (4, 9)]:
+        prompt = rng.integers(0, 97, plen).astype(np.int64)
+        reqs[srv.submit(prompt, max_new_tokens=n)] = (prompt, n)
+    done = dict(srv.run())
+    assert srv.idle
+    assert set(done) == set(reqs)
+    for rid, (prompt, n) in reqs.items():
+        np.testing.assert_array_equal(
+            done[rid], _solo(model, params, prompt, n), err_msg=f"req {rid}"
+        )
+    assert srv.stats["generated"] == sum(n for _, n in reqs.values())
+    assert srv.stats["rounds"] > 0
+
+
+def test_speculative_batcher_perfect_draft_accelerates(lm, rng):
+    """Draft == target: every proposal accepted — tokens/round approaches
+    num_draft+1, the speedup the batcher exists for."""
+    from tfde_tpu.inference.server import SpeculativeContinuousBatcher
+
+    model, params = lm
+    srv = SpeculativeContinuousBatcher(
+        model, model, params, params, batch_size=2, max_len=48, num_draft=3,
+    )
+    prompts = [rng.integers(0, 97, 4).astype(np.int64) for _ in range(2)]
+    rids = [srv.submit(p, max_new_tokens=12) for p in prompts]
+    done = dict(srv.run())
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(done[rid], _solo(model, params, p, 12))
+    assert srv.stats["tokens_per_round"] > 2.0, srv.stats
+
+
+def test_speculative_batcher_eos_and_staggering(lm, draft, rng):
+    from tfde_tpu.inference.server import SpeculativeContinuousBatcher
+
+    model, params = lm
+    dmodel, dparams = draft
+    p0 = rng.integers(0, 97, 4).astype(np.int64)
+    free = _solo(model, params, p0, 10)
+    eos = int(free[3])
+    ref = _solo(model, params, p0, 10, eos_id=eos, pad_id=0)
+    srv = SpeculativeContinuousBatcher(
+        model, dmodel, params, dparams, batch_size=1, max_len=40,
+        num_draft=4, eos_id=eos,
+    )
+    r0 = srv.submit(p0, max_new_tokens=10)
+    # second request queued behind the first on the single row
+    p1 = rng.integers(0, 97, 3).astype(np.int64)
+    r1 = srv.submit(p1, max_new_tokens=5)
+    done = dict(srv.run())
+    np.testing.assert_array_equal(done[r0], ref)
+    np.testing.assert_array_equal(
+        done[r1], _solo(model, params, p1, 5, eos_id=eos, pad_id=0)
+    )

@@ -11,7 +11,11 @@ from tfde_tpu.inference.decode import (
 )
 from tfde_tpu.inference.speculative import generate_speculative
 
-__all__ = ["ContinuousBatcher", "beam_search", "generate",
+__all__ = ["ContinuousBatcher", "SpeculativeContinuousBatcher",
+           "beam_search", "generate",
            "generate_ragged", "generate_speculative", "init_cache",
            "sample_logits"]
-from tfde_tpu.inference.server import ContinuousBatcher  # noqa: F401
+from tfde_tpu.inference.server import (  # noqa: F401
+    ContinuousBatcher,
+    SpeculativeContinuousBatcher,
+)

@@ -272,3 +272,193 @@ class ContinuousBatcher:
                 finished.extend(self._take_token(r, t))
                 progress = True
         return finished
+
+
+class SpeculativeContinuousBatcher:
+    """Continuous batching accelerated by a draft model — the two serving
+    levers composed: every round, the draft proposes `num_draft` tokens
+    per row and ONE target forward verifies all of them
+    (inference/speculative.py's batch-generic round, per-row acceptance),
+    while finished rows admit queued requests mid-flight exactly like
+    `ContinuousBatcher`.
+
+    Greedy only (the speculative rounds here run the deterministic
+    verifier): each request's output equals its solo greedy
+    `generate(model, params, prompt)` run. Per-round commits vary between
+    1 and num_draft+1 tokens per row with draft quality; `stats` reports
+    the realized tokens/round.
+    """
+
+    def __init__(
+        self,
+        model,
+        draft_model,
+        params,
+        draft_params,
+        batch_size: int,
+        max_len: int,
+        num_draft: int = 4,
+        eos_id: Optional[int] = None,
+        pad_id: int = 0,
+    ):
+        from tfde_tpu.inference.speculative import _spec_round
+
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        if num_draft < 1:
+            raise ValueError(f"num_draft must be >= 1, got {num_draft}")
+        self._round = _spec_round
+        self._model = model
+        self._draft = draft_model
+        self._tgt = _decode_clone(model)
+        self._drf = _decode_clone(draft_model)
+        self._params = params
+        self._dparams = draft_params
+        self._b = batch_size
+        self._max_len = int(max_len)
+        self._nd = int(num_draft)
+        self._eos = eos_id
+        self._pad = pad_id
+        # the speculative cache invariant: each round feeds at most
+        # num_draft+1 tokens past a row's committed count before the
+        # rewind (inference/speculative.py cache sizing)
+        cache_len = self._max_len + self._nd + 1
+        self._tgt_cache = init_cache(model, batch_size, cache_len)
+        self._drf_cache = init_cache(draft_model, batch_size, cache_len)
+        self._tgt_row = init_cache(model, 1, cache_len)
+        self._drf_row = init_cache(draft_model, 1, cache_len)
+
+        self._req = [None] * batch_size
+        self._out = [[] for _ in range(batch_size)]
+        self._budget = np.zeros(batch_size, np.int64)
+        self._committed = np.zeros(batch_size, np.int64)
+        self._tok = np.full(batch_size, pad_id, np.int64)
+        self._queue: collections.deque = collections.deque()
+        self._next_id = 0
+        self._rounds = 0
+        self._generated = 0      # every delivered token (incl. prefill 1st)
+        self._round_tokens = 0   # tokens produced by speculative rounds
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and all(r is None for r in self._req)
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "rounds": self._rounds,
+            "generated": self._generated,
+            "tokens_per_round": (
+                self._round_tokens / max(self._rounds * self._b, 1)
+            ),
+        }
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        validate_budget(self._model, int(prompt.size), max_new_tokens)
+        validate_budget(self._draft, int(prompt.size), max_new_tokens)
+        if prompt.size + max_new_tokens > self._max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the batcher's max_len "
+                f"{self._max_len}"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append((rid, prompt, int(max_new_tokens)))
+        return rid
+
+    def _take_token(self, r: int, t: int) -> list:
+        self._out[r].append(t)
+        self._budget[r] -= 1
+        self._tok[r] = t
+        self._generated += 1
+        if self._budget[r] <= 0 or (self._eos is not None and t == self._eos):
+            done = (self._req[r], np.asarray(self._out[r], np.int32))
+            self._req[r] = None
+            self._out[r] = []
+            self._committed[r] = 0
+            self._tok[r] = self._pad
+            return [done]
+        return []
+
+    def _admit(self) -> list:
+        finished = []
+        progress = True
+        while progress and self._queue:
+            progress = False
+            for r in range(self._b):
+                if not self._queue or self._req[r] is not None:
+                    continue
+                rid, prompt, budget = self._queue.popleft()
+                ids = jnp.asarray(prompt[None, :], jnp.int32)
+                tgt_row, logits = _prefill_row(
+                    self._tgt, self._tgt_row, self._params, ids
+                )
+                drf_row, _ = _prefill_row(
+                    self._drf, self._drf_row, self._dparams, ids
+                )
+                self._tgt_cache = _scatter_row(
+                    self._tgt_cache, tgt_row, jnp.int32(r)
+                )
+                self._drf_cache = _scatter_row(
+                    self._drf_cache, drf_row, jnp.int32(r)
+                )
+                t = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+                self._req[r] = rid
+                self._out[r] = []
+                self._budget[r] = budget
+                self._committed[r] = prompt.size
+                finished.extend(self._take_token(r, t))
+                progress = True
+        return finished
+
+    def step(self) -> list:
+        """Admit, then run ONE speculative round for the whole batch;
+        returns the requests that finished on it."""
+        finished = self._admit()
+        active = [r for r in range(self._b) if self._req[r] is not None]
+        if not active:
+            return finished
+        self._rounds += 1
+        # per-round rewind is unconditional: acceptance lengths diverge
+        # every round (host ints/np arrays — own buffer per index leaf,
+        # across BOTH donated caches)
+        committed = self._committed.astype(np.int32)
+        self._tgt_cache = _set_index_counters(self._tgt_cache, committed)
+        self._drf_cache = _set_index_counters(self._drf_cache, committed)
+        (self._tgt_cache, self._drf_cache, round_toks, n_new,
+         _pending) = self._round(
+            self._tgt, self._drf, self._tgt_cache, self._drf_cache,
+            self._params, self._dparams, jnp.asarray(self._tok, jnp.int32),
+            self._nd, self._pad,
+        )
+        round_np = np.asarray(round_toks)
+        n_np = np.asarray(n_new)
+        for r in active:
+            toks = round_np[r, : int(n_np[r])].tolist()
+            taken = 0
+            for t in toks:
+                if self._req[r] is None:
+                    break  # row finished mid-round; overshoot discarded
+                self._round_tokens += 1
+                finished.extend(self._take_token(r, int(t)))
+                taken += 1
+            if self._req[r] is not None:
+                # row still active: tok_last + accepted tokens are now in
+                # both caches (the pending one stays unfed) — the
+                # generate_speculative commit bookkeeping
+                self._committed[r] += taken
+        return finished
+
+    def run(self) -> list:
+        done = []
+        while not self.idle:
+            done.extend(self.step())
+        return done
