@@ -47,6 +47,11 @@ def main(argv=None):
                         help="synthetic requests to serve")
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--eos-id", type=int, default=None)
+    parser.add_argument("--num-draft", type=int, default=0, metavar="K",
+                        help="serve through SpeculativeContinuousBatcher "
+                             "with K draft proposals per round (greedy "
+                             "only; demo uses a tiny random draft — point "
+                             "real deployments at a distilled draft)")
     parser.add_argument("--hf-dir", type=str, default=None,
                         help="load GPT-2 weights converted by "
                              "`python -m tfde_tpu.models.convert`")
@@ -74,10 +79,38 @@ def main(argv=None):
         )["params"]
         log.warning("serving RANDOM weights; pass --hf-dir for a real model")
 
-    srv = ContinuousBatcher(
-        model, params, batch_size=args.batch_size, max_len=args.max_len,
-        temperature=args.temperature, eos_id=args.eos_id,
-    )
+    if args.num_draft > 0:
+        if args.temperature != 0.0:
+            raise ValueError(
+                "--num-draft serves the greedy verifier; drop "
+                "--temperature (speculative SAMPLING lives in "
+                "generate_speculative, not the batcher yet)"
+            )
+        from tfde_tpu.inference.server import SpeculativeContinuousBatcher
+        from tfde_tpu.models.gpt import GPT
+
+        draft = GPT(
+            vocab_size=model.vocab_size,
+            hidden_size=max(model.hidden_size // 4, 8),
+            depth=max(model.depth // 4, 1),
+            num_heads=max(model.num_heads // 4, 1),
+            mlp_dim=max(model.mlp_dim // 4, 16),
+            max_position=model.max_position,
+            dtype=model.dtype,
+        )
+        draft_params = draft.init(
+            jax.random.key(7), np.zeros((1, 8), np.int32)
+        )["params"]
+        srv = SpeculativeContinuousBatcher(
+            model, draft, params, draft_params,
+            batch_size=args.batch_size, max_len=args.max_len,
+            num_draft=args.num_draft, eos_id=args.eos_id,
+        )
+    else:
+        srv = ContinuousBatcher(
+            model, params, batch_size=args.batch_size, max_len=args.max_len,
+            temperature=args.temperature, eos_id=args.eos_id,
+        )
     rng = np.random.default_rng(0)
     lengths = {}
     for _ in range(args.requests):
@@ -97,6 +130,8 @@ def main(argv=None):
     log.info("served %d requests / %d tokens in %.2fs (%.1f tok/s, "
              "batch %d)", len(done), total, dt, total / max(dt, 1e-9),
              args.batch_size)
+    if hasattr(srv, "stats"):
+        log.info("speculative stats: %s", srv.stats)
     return done
 
 

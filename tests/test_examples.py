@@ -100,3 +100,10 @@ def test_serve_gpt_example():
     )
     assert len(done) == 5
     assert all(len(toks) == 6 for _, toks in done)
+    # the draft-accelerated path drains the same queue
+    done = serve_gpt.main(
+        ["--tiny", "--requests", "3", "--batch-size", "2",
+         "--max-new-tokens", "5", "--max-len", "32", "--num-draft", "2"]
+    )
+    assert len(done) == 3
+    assert all(len(toks) == 5 for _, toks in done)
