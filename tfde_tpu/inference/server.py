@@ -282,11 +282,14 @@ class SpeculativeContinuousBatcher:
     while finished rows admit queued requests mid-flight exactly like
     `ContinuousBatcher`.
 
-    Greedy only (the speculative rounds here run the deterministic
-    verifier): each request's output equals its solo greedy
-    `generate(model, params, prompt)` run. Per-round commits vary between
-    1 and num_draft+1 tokens per row with draft quality; `stats` reports
-    the realized tokens/round.
+    temperature == 0 (default): deterministic rounds — each request's
+    output equals its solo greedy `generate(model, params, prompt)` run.
+    temperature > 0: speculative SAMPLING rounds (the Leviathan
+    acceptance, inference/speculative.py) — committed tokens are
+    distributed exactly as target-model sampling at that temperature per
+    request, with draw values batch-dependent (rows share the key
+    stream). Per-round commits vary between 1 and num_draft+1 tokens per
+    row with draft quality; `stats` reports the realized tokens/round.
     """
 
     def __init__(
@@ -298,16 +301,24 @@ class SpeculativeContinuousBatcher:
         batch_size: int,
         max_len: int,
         num_draft: int = 4,
+        temperature: float = 0.0,
         eos_id: Optional[int] = None,
         pad_id: int = 0,
+        rng: Optional[jax.Array] = None,
     ):
-        from tfde_tpu.inference.speculative import _spec_round
+        from tfde_tpu.inference.speculative import (
+            _spec_round,
+            _spec_round_sampled,
+        )
 
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         if num_draft < 1:
             raise ValueError(f"num_draft must be >= 1, got {num_draft}")
         self._round = _spec_round
+        self._round_sampled = _spec_round_sampled
+        self._temperature = float(temperature)
+        self._rng = rng if rng is not None else jax.random.key(0)
         self._model = model
         self._draft = draft_model
         self._tgt = _decode_clone(model)
@@ -410,7 +421,13 @@ class SpeculativeContinuousBatcher:
                 self._drf_cache = _scatter_row(
                     self._drf_cache, drf_row, jnp.int32(r)
                 )
-                t = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+                if self._temperature > 0.0:
+                    self._rng, sub = jax.random.split(self._rng)
+                    t = int(np.asarray(sample_logits(
+                        logits, sub, temperature=self._temperature
+                    ))[0])
+                else:
+                    t = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
                 self._req[r] = rid
                 self._out[r] = []
                 self._budget[r] = budget
@@ -433,12 +450,22 @@ class SpeculativeContinuousBatcher:
         committed = self._committed.astype(np.int32)
         self._tgt_cache = _set_index_counters(self._tgt_cache, committed)
         self._drf_cache = _set_index_counters(self._drf_cache, committed)
-        (self._tgt_cache, self._drf_cache, round_toks, n_new,
-         _pending) = self._round(
-            self._tgt, self._drf, self._tgt_cache, self._drf_cache,
-            self._params, self._dparams, jnp.asarray(self._tok, jnp.int32),
-            self._nd, self._pad,
-        )
+        if self._temperature > 0.0:
+            self._rng, sub = jax.random.split(self._rng)
+            (self._tgt_cache, self._drf_cache, round_toks, n_new, _pending,
+             _rng_out) = self._round_sampled(
+                self._tgt, self._drf, self._tgt_cache, self._drf_cache,
+                self._params, self._dparams,
+                jnp.asarray(self._tok, jnp.int32), sub, self._nd, self._pad,
+                self._temperature,
+            )
+        else:
+            (self._tgt_cache, self._drf_cache, round_toks, n_new,
+             _pending) = self._round(
+                self._tgt, self._drf, self._tgt_cache, self._drf_cache,
+                self._params, self._dparams,
+                jnp.asarray(self._tok, jnp.int32), self._nd, self._pad,
+            )
         round_np = np.asarray(round_toks)
         n_np = np.asarray(n_new)
         for r in active:
