@@ -255,7 +255,12 @@ class PipelinedLM:
         layer = self._make_layer_fn(
             train, base_key, in_pipe=True, shard_axes=shard_axes,
             auto_axes=auto, seq_ring=seq_ring,
-            manual_axes=tuple(mesh.axis_names) if mesh is not None else (),
+            # >1 axes only, matching data_axes/vary conventions: promoting
+            # accumulators over a SIZE-1 axis would retype the stage-scan
+            # carry mid-loop (caught at dryrun data=1 x pipe=2 x seq=2)
+            manual_axes=tuple(
+                a for a in mesh.axis_names if mesh.shape[a] > 1
+            ) if mesh is not None else (),
         )
         lps = self.layers_per_stage
 
