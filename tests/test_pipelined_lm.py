@@ -594,3 +594,51 @@ def test_pp_sp_1f1b_refused(model, tokens):
                                   donate=False)
     with pytest.raises(NotImplementedError, match="1f1b"):
         step(state, (tokens,), jax.random.key(0))
+
+
+def test_1f1b_four_stages(tokens):
+    """S=4 (one layer per stage, M=8): the stash ring (2S-1=7 slots) and
+    deeper warmup/cooldown windows still reproduce the GPipe grads."""
+    from tfde_tpu.parallel import axes as axes_lib
+
+    m4 = pipelined_tiny_test(num_stages=4, layers_per_stage=1,
+                             microbatches=8, schedule="1f1b")
+    g4 = pipelined_tiny_test(num_stages=4, layers_per_stage=1,
+                             microbatches=8)
+    variables = m4.init(jax.random.key(0), tokens)
+    mesh = make_mesh({"data": 2, "pipe": 4}, jax.devices()[:8])
+
+    def loss(mdl):
+        def f(p):
+            with axes_lib.use_axes(mesh):
+                l, _ = mdl.loss_and_metrics({"params": p}, tokens,
+                                            train=True)
+            return l
+        return f
+
+    v1, g1 = jax.jit(jax.value_and_grad(loss(m4)))(variables["params"])
+    vg, gg = jax.jit(jax.value_and_grad(loss(g4)))(variables["params"])
+    np.testing.assert_allclose(float(v1), float(vg), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=1e-6
+        ),
+        g1, gg,
+    )
+
+
+def test_pp_sp_ring_of_four(model, tokens):
+    """seq=4 inside pipe=2: multi-hop KV rotation in the manual region."""
+    from tfde_tpu.parallel import axes as axes_lib
+
+    variables = model.init(jax.random.key(0), tokens)
+    ref = jax.jit(lambda v, t: model.apply(v, t))(variables, tokens)
+    mesh = make_mesh({"data": 1, "pipe": 2, "seq": 4}, jax.devices()[:8])
+
+    def fwd(v, t):
+        with axes_lib.use_axes(mesh):
+            return model.apply(v, t)
+
+    got = jax.jit(fwd)(variables, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
