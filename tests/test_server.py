@@ -212,3 +212,24 @@ def test_speculative_batcher_sampled_mode(lm, draft, rng):
     assert a == b          # deterministic per key
     assert a != c          # key moves the draws
     assert all(len(v) == 6 for v in a.values())
+
+
+def test_speculative_batcher_rope_gqa(rng):
+    """Per-row spec rounds + admission compose with rotary positions and
+    grouped-query caches."""
+    from tfde_tpu.inference.server import SpeculativeContinuousBatcher
+
+    m = GPT(vocab_size=97, hidden_size=32, depth=2, num_heads=4, mlp_dim=64,
+            max_position=64, dtype=jnp.float32, position="rope",
+            num_kv_heads=2)
+    params = m.init(jax.random.key(3), jnp.zeros((1, 8), jnp.int32))["params"]
+    d = GPT(vocab_size=97, hidden_size=16, depth=1, num_heads=2, mlp_dim=32,
+            max_position=64, dtype=jnp.float32)
+    dparams = d.init(jax.random.key(9), jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = SpeculativeContinuousBatcher(m, d, params, dparams, batch_size=2,
+                                       max_len=36, num_draft=3)
+    prompts = [rng.integers(0, 97, p).astype(np.int64) for p in (3, 5, 4)]
+    rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+    done = dict(srv.run())
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(done[rid], _solo(m, params, p, 6))
