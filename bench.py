@@ -667,6 +667,61 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
     return out
 
 
+def _bench_serve(clock: _Clock, smoke: bool) -> dict:
+    """Continuous-batching serving throughput (inference/server.py): a
+    stream of mixed-length requests through a fixed decode batch, rows
+    re-used mid-flight. Complements `decode_*` (steady one-shot batch):
+    this measures the throughput of the loop a server actually runs —
+    admission prefills + per-row index rewinds included."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfde_tpu.inference.server import ContinuousBatcher
+    from tfde_tpu.models.gpt import GPT, GPT2Small
+
+    if smoke:
+        batch, new, n_req, max_len = 2, 6, 4, 48
+        model = GPT(vocab_size=512, hidden_size=64, depth=2, num_heads=2,
+                    mlp_dim=128, max_position=64, dtype=jnp.float32)
+    else:
+        batch, new, n_req, max_len = 8, 96, 24, 256
+        model = GPT2Small(max_position=256, dropout_rate=0.0)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    # warm the tick/prefill compiles outside the timed window (two prompt
+    # lengths cover the bucket set below)
+    warm = ContinuousBatcher(model, params, batch_size=batch,
+                             max_len=max_len)
+    for plen in (16, 32) if not smoke else (4, 8):
+        warm.submit(rng.integers(0, model.vocab_size, plen), 2)
+    warm.run()
+
+    srv = ContinuousBatcher(model, params, batch_size=batch,
+                            max_len=max_len)
+    lens = (16, 32) if not smoke else (4, 8)
+    for i in range(n_req):
+        srv.submit(
+            rng.integers(0, model.vocab_size, lens[i % len(lens)]), new
+        )
+    t0 = _time.perf_counter()
+    done = srv.run()
+    total = sum(len(t) for _, t in done)
+    # the loop's own host round-trips are part of what's measured; the
+    # final host sync is implicit in run()'s per-step np.asarray fetches
+    dt = _time.perf_counter() - t0
+    return {
+        "serve_tokens_per_sec": round(total / max(dt, 1e-9), 1),
+        "serve_requests": len(done),
+        "serve_batch": batch,
+        "serve_total_tokens": int(total),
+    }
+
+
 def _bench_decode(clock: _Clock, smoke: bool) -> dict:
     """Serving-side decode throughput: GPT-2-small KV-cache generation
     (inference/decode.py) — tokens/sec at batch 8, prompt 128. The decode
@@ -835,6 +890,7 @@ def run_mode() -> None:
         ("gpt_long", lambda: _bench_gpt_long(clock, strategy, n_chips, peak,
                                              smoke)),
         ("decode", lambda: _bench_decode(clock, smoke)),
+        ("serve", lambda: _bench_serve(clock, smoke)),
     ]
 
     def emit(partial: bool) -> None:
