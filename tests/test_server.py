@@ -233,3 +233,21 @@ def test_speculative_batcher_rope_gqa(rng):
     done = dict(srv.run())
     for rid, p in zip(rids, prompts):
         np.testing.assert_array_equal(done[rid], _solo(m, params, p, 6))
+
+
+def test_prompt_buckets():
+    """Bucket arithmetic: defaults are powers of two capped by max_len;
+    prompts pad to the smallest fitting bucket with logits read at the
+    true last position."""
+    from tfde_tpu.inference.server import _bucketed, _normalize_buckets
+
+    assert _normalize_buckets(None, 100) == (8, 16, 32, 64, 100)
+    assert _normalize_buckets((32, 8, 64), 64) == (8, 32, 64)
+    with pytest.raises(ValueError, match="cover max_len"):
+        _normalize_buckets((8, 16), 64)
+    ids, last = _bucketed(np.asarray([5, 6, 7]), (8, 16), pad_id=0)
+    assert ids.shape == (1, 8) and last == 2
+    assert ids[0, :3].tolist() == [5, 6, 7]
+    assert ids[0, 3:].tolist() == [0] * 5
+    ids, last = _bucketed(np.arange(9), (8, 16), pad_id=0)
+    assert ids.shape == (1, 16) and last == 8

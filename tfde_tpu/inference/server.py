@@ -29,9 +29,11 @@ shares the batch (tests/test_server.py asserts it across staggered
 admissions). Temperature>0 draws ride a shared key stream —
 distributionally correct per request, draw values batch-dependent.
 
-Prompt-length compiles: `_prefill_row` retraces per distinct prompt
-length (the `generate` trade) — bucket or pad prompts upstream if your
-traffic has many lengths.
+Prompt-length compiles: prompts are right-padded to the smallest of
+`prompt_buckets` that fits (powers of two up to max_len by default), so
+the prefill compiles once per BUCKET, not per length — the first-token
+logits are read at the true prompt's last position, and the pre-tick
+index rewind makes the pad K/V unreachable.
 """
 
 from __future__ import annotations
@@ -65,15 +67,47 @@ def _decode_tick(model, cache, params, toks):
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
-def _prefill_row(model, row_cache, params, prompt):
-    """Prefill a single-row cache with a [1, P] prompt; returns the filled
-    cache and fp32 [1, V] last-position logits. Compiled per prompt
-    length."""
+def _prefill_row(model, row_cache, params, prompt, last):
+    """Prefill a single-row cache with a [1, Pbucket] (possibly right-
+    padded) prompt; returns the filled cache and fp32 [1, V] logits at
+    position `last` — the true prompt's final position, so bucketing
+    never changes the first sampled token. Compiled per BUCKET length.
+
+    Pad correctness rides the per-row index machinery: the pad tokens'
+    K/V land beyond the row's committed count, which the pre-tick rewind
+    sets to the TRUE prompt length — stale entries are unreachable, the
+    same invariant speculative rewinds rely on."""
     logits, mutated = model.apply(
         {"params": params, "cache": row_cache}, prompt, train=False,
         mutable=["cache"],
     )
-    return mutated["cache"], logits[:, -1].astype(jnp.float32)
+    return mutated["cache"], logits[:, last].astype(jnp.float32)
+
+
+def _normalize_buckets(buckets, max_len: int) -> tuple:
+    """Sorted prefill bucket lengths; default powers of two up to
+    max_len. Every prompt pads up to the smallest bucket that fits."""
+    if buckets is None:
+        buckets, b = [], 8
+        while b < max_len:
+            buckets.append(b)
+            b *= 2
+        buckets.append(max_len)
+    out = tuple(sorted(set(int(b) for b in buckets)))
+    if not out or out[-1] < max_len:
+        raise ValueError(
+            f"prompt_buckets must cover max_len {max_len}; got {out}"
+        )
+    return out
+
+
+def _bucketed(prompt: np.ndarray, buckets: tuple, pad_id: int):
+    """(padded [1, bucket] int32 prompt, true-last-position index)."""
+    p = prompt.size
+    bucket = next(b for b in buckets if b >= p)
+    padded = np.full((1, bucket), pad_id, np.int32)
+    padded[0, :p] = prompt
+    return jnp.asarray(padded), p - 1
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -129,9 +163,11 @@ class ContinuousBatcher:
         eos_id: Optional[int] = None,
         pad_id: int = 0,
         rng: Optional[jax.Array] = None,
+        prompt_buckets: Optional[tuple] = None,
     ):
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        self._buckets = _normalize_buckets(prompt_buckets, max_len)
         self._decode_model = _decode_clone(model)
         self._model = model
         self._params = params
@@ -255,9 +291,10 @@ class ContinuousBatcher:
                 if not self._queue or self._req[r] is not None:
                     continue
                 rid, prompt, budget = self._queue.popleft()
+                ids, last = _bucketed(prompt, self._buckets, self._pad)
                 row_cache, logits = _prefill_row(
                     self._decode_model, self._row_template, self._params,
-                    jnp.asarray(prompt[None, :], jnp.int32),
+                    ids, last,
                 )
                 self._cache = _scatter_row(
                     self._cache, row_cache, jnp.int32(r)
@@ -305,7 +342,9 @@ class SpeculativeContinuousBatcher:
         eos_id: Optional[int] = None,
         pad_id: int = 0,
         rng: Optional[jax.Array] = None,
+        prompt_buckets: Optional[tuple] = None,
     ):
+        self._buckets = _normalize_buckets(prompt_buckets, max_len)
         from tfde_tpu.inference.speculative import (
             _spec_round,
             _spec_round_sampled,
@@ -408,12 +447,12 @@ class SpeculativeContinuousBatcher:
                 if not self._queue or self._req[r] is not None:
                     continue
                 rid, prompt, budget = self._queue.popleft()
-                ids = jnp.asarray(prompt[None, :], jnp.int32)
+                ids, last = _bucketed(prompt, self._buckets, self._pad)
                 tgt_row, logits = _prefill_row(
-                    self._tgt, self._tgt_row, self._params, ids
+                    self._tgt, self._tgt_row, self._params, ids, last
                 )
                 drf_row, _ = _prefill_row(
-                    self._drf, self._drf_row, self._dparams, ids
+                    self._drf, self._drf_row, self._dparams, ids, last
                 )
                 self._tgt_cache = _scatter_row(
                     self._tgt_cache, tgt_row, jnp.int32(r)
