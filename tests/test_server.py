@@ -243,6 +243,9 @@ def test_prompt_buckets():
 
     assert _normalize_buckets(None, 100) == (8, 16, 32, 64, 100)
     assert _normalize_buckets((32, 8, 64), 64) == (8, 32, 64)
+    # oversized buckets clamp to max_len (a larger bucket would overflow
+    # the row cache at admission time)
+    assert _normalize_buckets((16, 128), 64) == (16, 64)
     with pytest.raises(ValueError, match="cover max_len"):
         _normalize_buckets((8, 16), 64)
     ids, last = _bucketed(np.asarray([5, 6, 7]), (8, 16), pad_id=0)

@@ -93,7 +93,9 @@ def _normalize_buckets(buckets, max_len: int) -> tuple:
             buckets.append(b)
             b *= 2
         buckets.append(max_len)
-    out = tuple(sorted(set(int(b) for b in buckets)))
+    # clamp to max_len: a larger bucket would pad past the row cache and
+    # fail at ADMISSION (after the request left the queue), not here
+    out = tuple(sorted({min(int(b), max_len) for b in buckets}))
     if not out or out[-1] < max_len:
         raise ValueError(
             f"prompt_buckets must cover max_len {max_len}; got {out}"
