@@ -158,3 +158,80 @@ def device_prefetch(
             yield out
 
     return gen_inline()
+
+
+def device_resident_feed(
+    arrays,
+    mesh: Mesh,
+    global_batch: int,
+    seed: int = 0,
+    spec: Optional[P] = None,
+    drop_remainder: bool = True,
+):
+    """Fully ON-DEVICE input pipeline for datasets that fit in HBM: stage
+    the arrays once, then every batch is a device-side gather — ZERO
+    per-step host->device traffic, the terminal answer to an input-bound
+    link (bench.py measured the MNIST e2e path 8.7x off the compute path
+    through the axon tunnel, with per-batch transfer as the attributed
+    cost).
+
+    Semantics match `Dataset.from_tensor_slices(arrays).shuffle(n, seed)
+    .repeat().batch(global_batch, drop_remainder=True)`: a fresh
+    Fisher-Yates permutation per epoch (derived on device from `seed` and
+    the epoch index), batches crossing epoch boundaries never (each epoch
+    truncates to a whole number of batches when drop_remainder — the
+    in-memory analog of the streaming loader's per-epoch windows).
+
+    Returns `feed(step) -> batch` — a jitted function of the step index;
+    call it with the training step counter. The gather output is sharded
+    by the mesh's batch spec, so it drops into the train step exactly
+    like a `device_prefetch` batch.
+    """
+    import jax.numpy as jnp
+
+    if spec is None:
+        from tfde_tpu.parallel.sharding import batch_spec
+
+        spec = batch_spec(mesh)
+    sharding = NamedSharding(mesh, spec)
+    arrays = tuple(np.ascontiguousarray(a) for a in arrays)
+    n = arrays[0].shape[0]
+    if any(a.shape[0] != n for a in arrays):
+        raise ValueError("all arrays must share the leading dimension")
+    if not drop_remainder and n % global_batch:
+        raise ValueError(
+            "device_resident_feed streams whole batches only; use "
+            "drop_remainder=True (or a divisible dataset) — a trailing "
+            "partial batch would change the compiled shape"
+        )
+    per_epoch = n // global_batch
+    if per_epoch < 1:
+        raise ValueError(
+            f"global_batch {global_batch} exceeds the dataset size {n}"
+        )
+    # replicated residency: the gather needs arbitrary rows on every
+    # shard's output row, so the source stays whole on each device (the
+    # fits-in-HBM contract this feed is for; shard the OUTPUT, not the
+    # source)
+    dev = tuple(
+        jax.device_put(a, NamedSharding(mesh, P())) for a in arrays
+    )
+
+    @jax.jit
+    def feed(step):
+        epoch = step // per_epoch
+        within = step % per_epoch
+        perm = jax.random.permutation(
+            jax.random.fold_in(jax.random.key(seed), epoch), n
+        )
+        idx = jax.lax.dynamic_slice_in_dim(
+            perm, within * global_batch, global_batch
+        )
+        out = tuple(
+            jax.lax.with_sharding_constraint(jnp.take(a, idx, axis=0),
+                                             sharding)
+            for a in dev
+        )
+        return out
+
+    return feed
