@@ -590,8 +590,8 @@ def _bench_flash(clock: _Clock, smoke: bool) -> dict:
     ref_g = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))
     fl_g = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))
 
-    # backward numerics on hardware: the Pallas dKV/dQ kernels vs autodiff
-    # through the reference einsum (qualifies TFDE_FLASH_BWD=pallas)
+    # backward numerics on hardware: the default flash backward (blockwise,
+    # TFDE_FLASH_BWD) vs autodiff through the reference einsum
     gr = ref_g(q, k, v)
     gf = fl_g(q, k, v)
     gerr = max(

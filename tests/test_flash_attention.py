@@ -83,8 +83,9 @@ def test_flash_rejects_cross_attention_shapes(rng):
 
 
 def test_auto_dispatch_flash_on_tpu_threshold(monkeypatch):
-    """Auto-dispatch (hardware-qualified 2026-07): flash on TPU from S>=4096,
-    reference below; TFDE_FLASH=0 disables, =1 lowers the threshold."""
+    """Auto-dispatch (hardware A/B r04, tools/flash_ab.py): flash on TPU
+    from S>=2048, reference below; TFDE_FLASH=0 disables, =1 lowers the
+    threshold."""
     import tfde_tpu.ops.attention as att
     import tfde_tpu.ops.flash_attention as fa
 
@@ -103,22 +104,31 @@ def test_auto_dispatch_flash_on_tpu_threshold(monkeypatch):
     monkeypatch.setattr(att, "reference_attention", fake_ref)
     monkeypatch.delenv("TFDE_FLASH", raising=False)
 
-    long = jnp.zeros((1, 4096, 1, 4), jnp.bfloat16)
-    mid = jnp.zeros((1, 2048, 1, 4), jnp.bfloat16)
-    short = jnp.zeros((1, 1024, 1, 4), jnp.bfloat16)
+    long = jnp.zeros((1, 2048, 1, 4), jnp.bfloat16)
+    # strictly between the TFDE_FLASH=1 threshold (1024) and the causal
+    # default (2048): proves the two thresholds are distinct
+    mid = jnp.zeros((1, 1536, 1, 4), jnp.bfloat16)
+    longer = jnp.zeros((1, 4096, 1, 4), jnp.bfloat16)
 
-    att.attention(long, long, long)
-    att.attention(mid, mid, mid)
+    att.attention(long, long, long, causal=True)
+    att.attention(mid, mid, mid, causal=True)
     assert chosen == ["flash", "reference"]
+
+    # non-causal: the flash win is the causal tile skip — threshold 4096
+    # (memory-motivated; r04 A/B measured 0.87-0.97x there)
+    chosen.clear()
+    att.attention(long, long, long)
+    att.attention(longer, longer, longer)
+    assert chosen == ["reference", "flash"]
 
     chosen.clear()
     monkeypatch.setenv("TFDE_FLASH", "0")
-    att.attention(long, long, long)
+    att.attention(long, long, long, causal=True)
     assert chosen == ["reference"]
 
     chosen.clear()
     monkeypatch.setenv("TFDE_FLASH", "1")
-    att.attention(short, short, short)
+    att.attention(mid, mid, mid, causal=True)
     assert chosen == ["flash"]
 
     # cross-attention shapes never auto-pick flash

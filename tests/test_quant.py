@@ -1,0 +1,199 @@
+"""Int8 quantized serving (ops/quant.py): numerics of the int8 dots, the
+structural params conversion, and end-to-end quantized generation.
+
+The fp-vs-int8 comparisons use tolerance/agreement assertions, not
+equality: W8A8 carries two rounding steps by design. The structural checks
+(conversion fills exactly the quant model's expected tree) are exact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.models.gpt import gpt_tiny_test
+from tfde_tpu.ops.quant import (
+    QuantDenseGeneral,
+    QuantEmbed,
+    absmax_quantize,
+    int8_dot_general,
+    quantize_model,
+    quantize_params,
+)
+
+
+def test_absmax_roundtrip_error_bound(rng):
+    w = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+    q, scale = absmax_quantize(w, contract_ndim=1)
+    assert q.dtype == jnp.int8 and scale.shape == (16,)
+    deq = q.astype(jnp.float32) * scale
+    # symmetric absmax: |err| <= scale/2 = amax/254 per element
+    amax = jnp.max(jnp.abs(w), axis=0)
+    assert jnp.all(jnp.abs(deq - w) <= amax / 254 + 1e-7)
+
+
+def test_int8_dot_close_to_fp(rng):
+    x = jnp.asarray(rng.normal(size=(4, 7, 32)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+    q, scale = absmax_quantize(w, 1)
+    y = int8_dot_general(x, q, scale, 1, dtype=jnp.float32)
+    ref = x @ w
+    rel = jnp.linalg.norm(y - ref) / jnp.linalg.norm(ref)
+    assert rel < 0.02, f"relative error {rel}"
+
+
+def test_int8_dot_two_axis_contraction(rng):
+    # the attention out-projection layout: [B, S, H, D] x [H, D, E]
+    x = jnp.asarray(rng.normal(size=(2, 5, 4, 8)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(4, 8, 16)), jnp.float32)
+    q, scale = absmax_quantize(w, 2)
+    assert scale.shape == (16,)
+    y = int8_dot_general(x, q, scale, 2, dtype=jnp.float32)
+    ref = jnp.einsum("bshd,hde->bse", x, w)
+    rel = jnp.linalg.norm(y - ref) / jnp.linalg.norm(ref)
+    assert rel < 0.02
+
+
+def test_quant_dense_general_param_shapes(rng):
+    m = QuantDenseGeneral(features=(3, 4, 8), axis=-1)
+    v = m.init(jax.random.key(0), jnp.zeros((2, 6, 32)))
+    p = v["params"]
+    assert p["kernel_q"].shape == (32, 3, 4, 8)
+    assert p["kernel_q"].dtype == jnp.int8
+    assert p["kernel_scale"].shape == (3, 4, 8)
+    assert p["bias"].shape == (3, 4, 8)
+
+
+def test_quant_dense_rejects_non_trailing_axis():
+    m = QuantDenseGeneral(features=8, axis=0)
+    with pytest.raises(NotImplementedError):
+        m.init(jax.random.key(0), jnp.zeros((4, 32)))
+
+
+def test_quant_embed_gather_matches_dequant(rng):
+    emb = jnp.asarray(rng.normal(size=(11, 8)), jnp.float32)
+    amax = jnp.max(jnp.abs(emb), axis=1)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(emb / scale[:, None]), -127, 127).astype(jnp.int8)
+    m = QuantEmbed(11, 8, dtype=jnp.float32)
+    ids = jnp.asarray([[0, 3, 10], [5, 5, 1]], jnp.int32)
+    out = m.apply({"params": {"embedding_q": q, "scale": scale}}, ids)
+    ref = (q.astype(jnp.float32) * scale[:, None])[ids]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_quant_embed_attend_close_to_fp(rng):
+    emb = jnp.asarray(rng.normal(size=(13, 16)), jnp.float32)
+    amax = jnp.max(jnp.abs(emb), axis=1)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(emb / scale[:, None]), -127, 127).astype(jnp.int8)
+    m = QuantEmbed(13, 16, dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(3, 16)), jnp.float32)
+    out = m.apply({"params": {"embedding_q": q, "scale": scale}}, x,
+                  method=m.attend)
+    ref = x @ emb.T
+    rel = jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref)
+    assert rel < 0.03
+
+
+def _tiny_fp_model_and_params(**kw):
+    model = gpt_tiny_test(**kw)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.key(1), tokens)["params"]
+    return model, {"params": params}
+
+
+def test_quantize_params_matches_expected_structure():
+    model, params = _tiny_fp_model_and_params()
+    qmodel, qparams = quantize_model(model, params)
+    expected = jax.eval_shape(
+        lambda: qmodel.init(jax.random.key(0), jnp.zeros((1, 2), jnp.int32))
+    )["params"]
+    got = qparams["params"]
+    exp_paths = {tuple(str(k) for k in jax.tree_util.tree_flatten_with_path(expected)[0][i][0])
+                 for i in range(len(jax.tree_util.tree_leaves(expected)))}
+    got_paths = {tuple(str(k) for k in jax.tree_util.tree_flatten_with_path(got)[0][i][0])
+                 for i in range(len(jax.tree_util.tree_leaves(got)))}
+    assert exp_paths == got_paths
+    # shapes/dtypes line up leaf by leaf
+    jax.tree_util.tree_map(
+        lambda e, g: (e.shape, jnp.dtype(e.dtype)) == (g.shape, jnp.dtype(g.dtype))
+        or (_ for _ in ()).throw(AssertionError((e.shape, e.dtype, g.shape, g.dtype))),
+        expected, got,
+    )
+
+
+def test_quant_logits_track_fp_logits(rng):
+    """Prefill logits of the quantized twin stay directionally faithful to
+    fp — cosine similarity per row, the deterministic form of 'the model
+    still computes the same function up to quantization noise'."""
+    model, params = _tiny_fp_model_and_params()
+    qmodel, qparams = quantize_model(model, params)
+    tokens = jnp.asarray(rng.integers(0, 97, size=(2, 12)), jnp.int32)
+    fp = model.apply(params, tokens, train=False)
+    q = qmodel.apply(qparams, tokens, train=False)
+    fp_flat = fp.reshape(-1, fp.shape[-1])
+    q_flat = q.reshape(-1, q.shape[-1])
+    cos = jnp.sum(fp_flat * q_flat, -1) / (
+        jnp.linalg.norm(fp_flat, axis=-1) * jnp.linalg.norm(q_flat, axis=-1)
+    )
+    assert jnp.min(cos) > 0.99, f"min cosine {jnp.min(cos)}"
+
+
+def test_quant_generate_runs_and_mostly_agrees_with_fp(rng):
+    from tfde_tpu.inference.decode import generate
+
+    model, params = _tiny_fp_model_and_params()
+    qmodel, qparams = quantize_model(model, params)
+    prompt = jnp.asarray(rng.integers(0, 97, size=(2, 4)), jnp.int32)
+    fp_toks, fp_len = generate(model, params["params"], prompt, 12)
+    q_toks, q_len = generate(qmodel, qparams["params"], prompt, 12)
+    assert q_toks.shape == fp_toks.shape == (2, 16)
+    agree = np.mean(np.asarray(fp_toks[:, 4:]) == np.asarray(q_toks[:, 4:]))
+    # a tiny random model has shallow logit margins — quantization noise may
+    # flip some argmaxes, but the sequences must stay substantially aligned
+    assert agree >= 0.5, f"greedy agreement {agree}"
+
+
+def test_quant_untied_lm_head(rng):
+    model, params = _tiny_fp_model_and_params(tie_embeddings=False)
+    qmodel, qparams = quantize_model(model, params)
+    assert "lm_head" in qparams["params"]
+    assert qparams["params"]["lm_head"]["kernel_q"].dtype == jnp.int8
+    tokens = jnp.asarray(rng.integers(0, 97, size=(1, 6)), jnp.int32)
+    out = qmodel.apply(qparams, tokens, train=False)
+    assert out.shape == (1, 6, 97) and bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_quant_refuses_train():
+    model, params = _tiny_fp_model_and_params()
+    qmodel, qparams = quantize_model(model, params)
+    with pytest.raises(ValueError, match="serving-only"):
+        qmodel.apply(qparams, jnp.zeros((1, 4), jnp.int32), train=True)
+
+
+def test_quant_submodule_refuses_train_directly():
+    """The guard must also fire one level down (direct Mlp/MHA users) —
+    a quantized projection under train would silently zero all grads."""
+    from tfde_tpu.models.transformer import Mlp
+
+    m = Mlp(mlp_dim=8, quant="int8", dtype=jnp.float32)
+    v = m.init(jax.random.key(0), jnp.zeros((1, 4)))
+    with pytest.raises(ValueError, match="serving-only"):
+        m.apply(v, jnp.zeros((1, 4)), train=True)
+
+
+def test_quantize_model_requires_quant_field():
+    from tfde_tpu.models.cnn import PlainCNN
+
+    with pytest.raises(ValueError, match="quant"):
+        quantize_model(PlainCNN(), {"params": {}})
+
+
+def test_quantize_params_missing_kernel_errors():
+    model, params = _tiny_fp_model_and_params()
+    qmodel = model.clone(quant="int8")
+    broken = jax.tree_util.tree_map(lambda x: x, params)
+    del broken["params"]["decoder"]["block_0"]["attn"]["query"]["kernel"]
+    with pytest.raises(ValueError, match="kernel"):
+        quantize_params(qmodel, broken)

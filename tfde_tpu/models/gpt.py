@@ -60,15 +60,30 @@ class GPT(nn.Module):
     # True (GPT-2): LM head = wte^T via Embed.attend; False (LLaMA):
     # separate bias-free lm_head Dense
     tie_embeddings: bool = True
+    # None (fp) | 'int8': W8A8 serving twin (ops/quant.py) — block
+    # projections, the embedding/tied head, and the untied lm_head all go
+    # int8; wpe and norms stay fp32. Build params with quantize_model.
+    quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, train: bool = False) -> jax.Array:
+        if self.quant is not None and train:
+            raise ValueError(
+                "quant='int8' is a serving-only mode (round() has zero "
+                "gradient) — train the fp model, then quantize_model it"
+            )
         b = batch_axes()
         seq = input_ids.shape[1]
-        wte = nn.Embed(
-            self.vocab_size, self.hidden_size, dtype=self.dtype,
-            param_dtype=jnp.float32, name="wte",
-        )
+        if self.quant is not None:
+            from tfde_tpu.ops.quant import QuantEmbed
+
+            wte = QuantEmbed(self.vocab_size, self.hidden_size,
+                             dtype=self.dtype, name="wte")
+        else:
+            wte = nn.Embed(
+                self.vocab_size, self.hidden_size, dtype=self.dtype,
+                param_dtype=jnp.float32, name="wte",
+            )
         if self.position not in ("learned", "rope"):
             raise ValueError(
                 f"position must be 'learned' or 'rope', got {self.position!r}"
@@ -115,6 +130,7 @@ class GPT(nn.Module):
             rope_theta=self.rope_theta,
             num_kv_heads=self.num_kv_heads,
             fused_qkv=self.fused_qkv,
+            quant=self.quant,
             norm=self.norm,
             mlp_act=self.mlp_act,
             use_bias=self.use_bias,
@@ -126,6 +142,13 @@ class GPT(nn.Module):
         )(x, train=train)
         if self.tie_embeddings:
             logits = wte.attend(x.astype(self.dtype)).astype(jnp.float32)
+        elif self.quant is not None:
+            from tfde_tpu.ops.quant import QuantDenseGeneral
+
+            logits = QuantDenseGeneral(
+                self.vocab_size, use_bias=False, dtype=self.dtype,
+                name="lm_head",
+            )(x.astype(self.dtype)).astype(jnp.float32)
         else:
             logits = nn.Dense(
                 self.vocab_size, use_bias=False, dtype=self.dtype,

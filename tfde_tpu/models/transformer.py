@@ -26,8 +26,25 @@ import jax
 import jax.numpy as jnp
 
 from tfde_tpu.ops import attention as attn_lib
+from tfde_tpu.ops.quant import QuantDenseGeneral
 from tfde_tpu.ops.rotary import apply_rotary
 from tfde_tpu.parallel.axes import batch_axes, constrain
+
+
+def _check_quant(quant, train: bool = False) -> bool:
+    """Shared `quant` field validation: None (fp) or 'int8' (serving-only
+    W8A8 twins, ops/quant.py). train=True with quant on is refused here —
+    round() has zero gradient, so a quantized projection would silently
+    block all gradient flow (GPT raises the same error at the model level;
+    this guard covers direct Encoder/Block/Mlp/MHA users)."""
+    if quant not in (None, "int8"):
+        raise ValueError(f"quant must be None or 'int8', got {quant!r}")
+    if quant is not None and train:
+        raise ValueError(
+            "quant='int8' is a serving-only mode (round() has zero "
+            "gradient) — train the fp model, then quantize_model it"
+        )
+    return quant == "int8"
 
 
 class MultiHeadAttention(nn.Module):
@@ -65,6 +82,9 @@ class MultiHeadAttention(nn.Module):
     # conversion (models/convert.py) and HF interop stay on the unfused
     # default; MHA only (GQA's k/v are shaped differently).
     fused_qkv: bool = False
+    # None (fp) | 'int8': W8A8 dynamic-quantized projections (ops/quant.py)
+    # — the serving-only decode-bandwidth lever; params via quantize_model
+    quant: Optional[str] = None
 
     @property
     def kv_heads(self) -> int:
@@ -84,12 +104,17 @@ class MultiHeadAttention(nn.Module):
                 f"num_heads={self.num_heads}"
             )
         b = batch_axes()
-        proj = functools.partial(
-            nn.DenseGeneral,
-            dtype=self.dtype,
-            param_dtype=jnp.float32,
-            use_bias=self.use_bias,
-        )
+        if _check_quant(self.quant, train):
+            proj = functools.partial(
+                QuantDenseGeneral, dtype=self.dtype, use_bias=self.use_bias,
+            )
+        else:
+            proj = functools.partial(
+                nn.DenseGeneral,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                use_bias=self.use_bias,
+            )
         if self.fused_qkv:
             if self.kv_heads != self.num_heads:
                 raise NotImplementedError(
@@ -147,14 +172,7 @@ class MultiHeadAttention(nn.Module):
                 q, k, v, mask=mask, causal=self.causal, impl=self.attn_impl
             )
         y = constrain(y, b, "seq", "tensor")
-        y = nn.DenseGeneral(
-            features=x.shape[-1],
-            axis=(-2, -1),
-            dtype=self.dtype,
-            param_dtype=jnp.float32,
-            use_bias=self.use_bias,
-            name="out",
-        )(y)
+        y = proj(features=x.shape[-1], axis=(-2, -1), name="out")(y)
         y = constrain(y, b, "seq")
         if self.dropout_rate > 0.0:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
@@ -266,14 +284,20 @@ class Mlp(nn.Module):
     dropout_rate: float = 0.0
     act: str = "gelu"  # 'gelu' (tanh approx, == GPT-2 gelu_new) | 'swiglu'
     use_bias: bool = True
+    quant: Optional[str] = None  # see MultiHeadAttention.quant
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         b = batch_axes()
-        dense = functools.partial(
-            nn.Dense, dtype=self.dtype, param_dtype=jnp.float32,
-            use_bias=self.use_bias,
-        )
+        if _check_quant(self.quant, train):
+            dense = functools.partial(
+                QuantDenseGeneral, dtype=self.dtype, use_bias=self.use_bias,
+            )
+        else:
+            dense = functools.partial(
+                nn.Dense, dtype=self.dtype, param_dtype=jnp.float32,
+                use_bias=self.use_bias,
+            )
         h = dense(self.mlp_dim, name="fc1")(x)
         if self.act == "gelu":
             h = nn.gelu(h)
@@ -308,6 +332,7 @@ class TransformerBlock(nn.Module):
     rope_theta: float = 10_000.0
     num_kv_heads: Optional[int] = None  # GQA (MultiHeadAttention)
     fused_qkv: bool = False  # one-GEMM qkv projection (MultiHeadAttention)
+    quant: Optional[str] = None  # int8 serving twins (MultiHeadAttention)
     norm_style: str = "pre"  # 'pre' | 'post'
     norm: str = "layer"  # 'layer' | 'rms' (LLaMA: scale-only, no bias)
     mlp_act: str = "gelu"  # Mlp.act
@@ -341,6 +366,7 @@ class TransformerBlock(nn.Module):
             rope_theta=self.rope_theta,
             num_kv_heads=self.num_kv_heads,
             fused_qkv=self.fused_qkv,
+            quant=self.quant,
             use_bias=self.use_bias,
             name="attn",
         )
@@ -350,6 +376,11 @@ class TransformerBlock(nn.Module):
                     "MoE expert MLPs are gelu+bias today; num_experts > 0 "
                     "with mlp_act/use_bias overrides would silently build a "
                     "different architecture than requested"
+                )
+            if self.quant is not None:
+                raise NotImplementedError(
+                    "quant='int8' does not cover MoE expert MLPs yet — "
+                    "quantize a dense model, or set num_experts=0"
                 )
             from tfde_tpu.models.moe import MoEMlp
 
@@ -368,6 +399,7 @@ class TransformerBlock(nn.Module):
                 dropout_rate=self.dropout_rate,
                 act=self.mlp_act,
                 use_bias=self.use_bias,
+                quant=self.quant,
                 name="mlp",
             )
         if self.norm_style == "pre":
@@ -418,6 +450,7 @@ class Encoder(nn.Module):
     rope_theta: float = 10_000.0
     num_kv_heads: Optional[int] = None
     fused_qkv: bool = False
+    quant: Optional[str] = None
     norm_style: str = "pre"
     norm: str = "layer"
     mlp_act: str = "gelu"
@@ -466,6 +499,7 @@ class Encoder(nn.Module):
                 rope_theta=self.rope_theta,
                 num_kv_heads=self.num_kv_heads,
                 fused_qkv=self.fused_qkv,
+                quant=self.quant,
                 norm_style=self.norm_style,
                 norm=self.norm,
                 mlp_act=self.mlp_act,

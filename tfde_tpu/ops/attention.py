@@ -140,12 +140,16 @@ def attention(
     """Dispatching attention: [B,S,H,D] -> [B,S,H,D].
 
     impl: 'auto' | 'reference' | 'flash' | 'ring'. 'auto' picks ring when the
-    active mesh shards 'seq'; on TPU it picks flash for self-attention at
-    S >= 4096 (no mask) — the regime where the hardware qualification showed
-    the O(S^2) reference einsum falling off (1.4x at 4096, 6.7x at 8192;
-    bench.py flash config on v5e) — and the reference einsum otherwise (XLA
-    fuses it optimally at short S). ``TFDE_FLASH=0`` disables the flash
-    auto-pick; ``TFDE_FLASH=1`` lowers its threshold to S >= 1024.
+    active mesh shards 'seq'; on TPU it picks flash for CAUSAL
+    self-attention at S >= 2048 (no mask) — the r04 hardware A/B
+    (tools/flash_ab.py, v5e: causal fwd+bwd 1.15x at 2048, 1.28x at 4096,
+    1.30x at 8192 with the blockwise backward; the causal whole-tile skip
+    is where the kernel wins) — and for non-causal at S >= 4096, where the
+    same A/B measured 0.87-0.97x (slightly slower) but the O(S) memory
+    replaces the reference's O(S^2) score tensor, the binding constraint at
+    long S. Below those, the reference einsum (XLA fuses it optimally).
+    ``TFDE_FLASH=0`` disables the flash auto-pick; ``TFDE_FLASH=1`` lowers
+    both thresholds to S >= 1024.
 
     Inside a fully-manual region whose 'seq' axis is manual (the pp x sp
     pipeline, parallel/axes.manual_seq), dispatch goes straight to the
@@ -179,8 +183,10 @@ def attention(
         import os
 
         flash_env = os.environ.get("TFDE_FLASH", "auto")
+        default_min = 2048 if causal else 4096
         flash_min_seq = {"0": None, "false": None, "False": None,
-                         "": 4096, "auto": 4096}.get(flash_env, 1024)
+                         "": default_min, "auto": default_min
+                         }.get(flash_env, 1024)
         if _seq_parallel_active() and _have("ring_attention"):
             impl = "ring"
         elif (

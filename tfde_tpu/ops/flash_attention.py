@@ -15,14 +15,16 @@ Forward is a Pallas kernel (per /opt/skills/guides/pallas_guide.md):
 - causal masking predicates whole future K-tiles off (pl.when), halving the
   work for causal models rather than masking it.
 
-Backward (round 3) is a pair of Pallas kernels, the FlashAttention-2
-arrangement: a dK/dV kernel (grid K-major, Q minor: each K tile's grads
-accumulate in VMEM scratch while Q tiles stream past) and a dQ kernel (grid
-Q-major, K minor) — both recompute P from the saved logsumexp, O(S) memory,
-fp32 accumulation, causal tiles predicated off. `delta = rowsum(dO*O)` is
-precomputed in JAX. The previous blockwise-JAX backward remains as
-`TFDE_FLASH_BWD=jax` (fallback + an independent numerics oracle for the
-kernel tests).
+Backward DEFAULTS to the blockwise-JAX recurrence (`_bwd_blockwise`):
+recompute P tile-by-tile from the saved logsumexp under a `lax.scan`, O(S)
+memory, XLA-scheduled matmuls. The r04 hardware A/B (tools/flash_ab.py on
+v5e) measured it at 1.15x/1.28x/1.30x of the XLA reference einsum at
+S=2048/4096/8192 (causal fwd+bwd), while the round-3 Pallas dK/dV + dQ
+kernel pair (`TFDE_FLASH_BWD=pallas`, FlashAttention-2 arrangement,
+retained below with 128-lane lse/delta layout and causal prefetch index
+maps) lands at 0.6-0.73x — XLA's own scheduling of the same recurrence
+beats the hand pipeline on this chip generation, so the kernel pair is
+opt-in until it wins a measurement.
 
 Ring attention (ops/ring_attention.py) composes with this by construction:
 its per-device block computation is the same recurrence, so the flash kernel
@@ -122,22 +124,33 @@ def _flash_forward(
             f"({block_q}, {block_k})"
         )
     scale = 1.0 / (d ** 0.5)
-    grid = (b, h, s // block_q, s // block_k)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale)
     # BSHD -> BHSD so the S/D dims are the TPU-tiled trailing pair
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     from jax.experimental.pallas import tpu as pltpu
 
+    if causal:
+        # skipped K-tiles (strictly past the Q-tile's last row) must not
+        # spend DMA: point their index map at tile 0, the one the NEXT
+        # Q-tile's first step needs — the pipeline elides repeat fetches,
+        # so masked-off steps cost ~nothing instead of a dead K/V copy
+        def kv_idx(bi, hi, qi, kb):
+            return (bi, hi,
+                    jax.lax.select(kb * block_k <= (qi + 1) * block_q - 1,
+                                   kb, 0), 0)
+    else:
+        def kv_idx(bi, hi, qi, kb):
+            return (bi, hi, kb, 0)
+
+    grid = (b, h, s // block_q, s // block_k)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, kb: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, kb: (bi, hi, kb, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, kb: (bi, hi, kb, 0)),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
@@ -223,8 +236,11 @@ def _dkv_kernel(
         k_blk = k_ref[0, 0]      # [bk, D]
         v_blk = v_ref[0, 0]
         do = do_ref[0, 0]        # [bq, D]
-        lse = lse_ref[0, 0]      # [bq, 1]
-        delta = delta_ref[0, 0]  # [bq, 1]
+        # lse/delta arrive broadcast to 128 lanes (layout, not data — a
+        # [bq, 1]-minor tile would force Mosaic's degenerate-lane path);
+        # col 0 carries the value
+        lse = lse_ref[0, 0, :, 0:1]      # [bq, 1]
+        delta = delta_ref[0, 0, :, 0:1]  # [bq, 1]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -284,8 +300,8 @@ def _dq_kernel(
         k_blk = k_ref[0, 0]
         v_blk = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0, :, 0:1]      # 128-lane broadcast, col 0 (see
+        delta = delta_ref[0, 0, :, 0:1]  # _dkv_kernel)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -329,18 +345,32 @@ def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
     delta = jnp.einsum(
         "bshd,bshd->bhs", g.astype(jnp.float32), out.astype(jnp.float32)
     )
-    # BSHD -> BHSD tiles; lse/delta -> [b,h,s,1] so the tile minor dim is 1
+    # BSHD -> BHSD tiles; lse/delta broadcast to 128 lanes (the official
+    # TPU-kernel convention, MIN_BLOCK_SIZE lanes): a [*, 1]-minor block
+    # would put every per-step load on Mosaic's degenerate-lane layout
+    lanes = 128
     qt, kt, vt, gt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v, g))
-    lse4 = lse[..., None]
-    delta4 = delta[..., None]
+    lse4 = jnp.broadcast_to(lse[..., None], (b, h, s, lanes))
+    delta4 = jnp.broadcast_to(delta[..., None], (b, h, s, lanes))
 
     def tile(n, idx):
         return pl.BlockSpec((1, 1, n, d), idx)
 
     def col(n, idx):
-        return pl.BlockSpec((1, 1, n, 1), idx)
+        return pl.BlockSpec((1, 1, n, lanes), idx)
 
-    kq_q = lambda bi, hi, kb, qi: (bi, hi, qi, 0)  # Q-streaming tiles
+    if causal:
+        # Q tiles strictly above the K tile's first row are masked off —
+        # prefetch the first contributing Q tile instead of a dead copy
+        def kq_q(bi, hi, kb, qi):
+            first = (kb * block_k) // block_q
+            return (bi, hi,
+                    jax.lax.select((qi + 1) * block_q - 1 >= kb * block_k,
+                                   qi, first), 0)
+    else:
+        def kq_q(bi, hi, kb, qi):
+            return (bi, hi, qi, 0)
+
     kq_k = lambda bi, hi, kb, qi: (bi, hi, kb, 0)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale),
@@ -366,7 +396,15 @@ def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
     )(qt, kt, vt, gt, lse4, delta4)
 
     qk_q = lambda bi, hi, qi, kb: (bi, hi, qi, 0)
-    qk_k = lambda bi, hi, qi, kb: (bi, hi, kb, 0)
+    if causal:
+        # K tiles strictly past the Q tile's last row: prefetch tile 0 (the
+        # next Q tile's first step) instead of a dead copy — mirrors forward
+        def qk_k(bi, hi, qi, kb):
+            return (bi, hi,
+                    jax.lax.select(kb * block_k <= (qi + 1) * block_q - 1,
+                                   kb, 0), 0)
+    else:
+        qk_k = lambda bi, hi, qi, kb: (bi, hi, kb, 0)
     (dq,) = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale),
         grid=(b, h, s // block_q, s // block_k),
@@ -414,10 +452,15 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 def _bwd(causal, block_q, block_k, interpret, res, g):
     import os
 
-    if os.environ.get("TFDE_FLASH_BWD", "pallas") == "jax":
-        return _bwd_blockwise(res, g, causal=causal, block_k=block_k)
-    return _bwd_pallas(res, g, causal=causal, block_q=block_q,
-                       block_k=block_k, interpret=interpret)
+    # default 'jax' (blockwise): the r04 hardware A/B (tools/flash_ab.py,
+    # v5e) times it at 1.15-1.30x of the XLA reference einsum while the
+    # Pallas dKV/dQ pair — even with 128-lane lse/delta layout and causal
+    # prefetch maps — lands at 0.6-0.73x. Same O(S) memory either way;
+    # TFDE_FLASH_BWD=pallas keeps the kernel pair selectable.
+    if os.environ.get("TFDE_FLASH_BWD", "jax") == "pallas":
+        return _bwd_pallas(res, g, causal=causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret)
+    return _bwd_blockwise(res, g, causal=causal, block_k=block_k)
 
 
 flash_attention.defvjp(_fwd, _bwd)
