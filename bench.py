@@ -849,38 +849,58 @@ def _bench_decode(clock: _Clock, smoke: bool) -> dict:
             "unmeasurable at this config"
         )
 
-    if not smoke:
-        # GQA twin (4 KV heads instead of 12): the serving memory/bandwidth
-        # knob — same dims, random init (throughput only, quality N/A).
-        # Own try/except: a failure here must not discard the classic
-        # decode numbers already measured above.
+    def twin(prefix: str, mdl, prms) -> None:
+        """One serving-lever twin, measured exactly like the base model:
+        full call, N=1 prefill baseline, decode-only delta — with the SAME
+        5% noise gate on the twin's own delta (a noise-level delta must
+        report as unmeasurable, never as an absurd tokens/sec; the trust
+        rule every config follows). Speedup is decode-only vs decode-only:
+        the full call is prefill-diluted, which would understate the
+        bandwidth effect the twins measure. Own try/except — a twin
+        failure must not discard the numbers already measured."""
         try:
-            gqa = GPT2Small(max_position=prompt_len + new, dropout_rate=0.0,
-                            num_kv_heads=4)
-            gparams = gqa.init(
-                jax.random.key(0),
-                jnp.zeros((batch, prompt_len + new), jnp.int32),
-            )["params"]
-            g_call, _ = time_call(gqa, gparams, new)
-            g_prefill, _ = time_call(gqa, gparams, 1)
-            g_delta = g_call - g_prefill
-            out["decode_gqa_kv_heads"] = 4
-            out["decode_gqa_gen_tokens_per_sec"] = round(
-                batch * new / g_call, 1
+            t_call, _ = time_call(mdl, prms, new)
+            t_prefill, _ = time_call(mdl, prms, 1)
+            t_delta = t_call - t_prefill
+            out[f"{prefix}_gen_tokens_per_sec"] = round(
+                batch * new / t_call, 1
             )
-            # decode-only vs decode-only: the full call is prefill-diluted,
-            # which would understate the KV-bandwidth effect this measures
-            if new > 1 and delta > 0.05 * per_call and g_delta > 0:
-                out["decode_gqa_tokens_per_sec"] = round(
-                    batch * (new - 1) / g_delta, 1
+            if (new > 1 and delta > 0.05 * per_call
+                    and t_delta > 0.05 * t_call):
+                out[f"{prefix}_tokens_per_sec"] = round(
+                    batch * (new - 1) / t_delta, 1
                 )
-                out["decode_gqa_speedup"] = round(delta / g_delta, 3)
+                out[f"{prefix}_speedup"] = round(delta / t_delta, 3)
             else:
-                out["decode_gqa_error"] = (
-                    "decode-only delta unmeasurable for the GQA twin"
+                out[f"{prefix}_error"] = (
+                    f"decode-only delta unmeasurable for the {prefix} twin"
                 )
         except Exception as e:
-            out["decode_gqa_error"] = f"{type(e).__name__}: {e}"[:300]
+            out[f"{prefix}_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    if not smoke:
+        # GQA twin (4 KV heads instead of 12): the serving memory/bandwidth
+        # knob — same dims, random init (throughput only, quality N/A)
+        gqa = GPT2Small(max_position=prompt_len + new, dropout_rate=0.0,
+                        num_kv_heads=4)
+        gparams = gqa.init(
+            jax.random.key(0),
+            jnp.zeros((batch, prompt_len + new), jnp.int32),
+        )["params"]
+        out["decode_gqa_kv_heads"] = 4
+        twin("decode_gqa", gqa, gparams)
+
+    # int8 W8A8 twin (ops/quant.py): weight HBM traffic halves and the
+    # matmuls ride the v5e's double-rate int8 MXU — the quantization
+    # serving lever. Runs in smoke mode too (unlike GQA) so CI exercises
+    # the quantized decode path end to end.
+    try:
+        from tfde_tpu.ops.quant import quantize_model
+
+        qmodel, qparams = quantize_model(model, params)
+        twin("decode_int8", qmodel, qparams["params"])
+    except Exception as e:
+        out["decode_int8_error"] = f"{type(e).__name__}: {e}"[:300]
     return out
 
 
