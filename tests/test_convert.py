@@ -173,3 +173,47 @@ def test_convert_cli_round_trip(tmp_path, hf_gpt2, rng):
     with torch.no_grad():
         ref = hf_gpt2(torch.tensor(ids.astype(np.int64))).logits.numpy()
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.fixture(scope="module")
+def hf_mistral():
+    cfg = transformers.MistralConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, attention_dropout=0.0,
+        sliding_window=8, tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    m = transformers.MistralForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_mistral_logits_match(hf_mistral, rng):
+    """Mistral = the LLaMA stack + sliding-window attention; a sequence
+    LONGER than the window makes the band mask load-bearing in the
+    comparison (transformers applies its own sliding-window mask)."""
+    from tfde_tpu.models.convert import mistral_from_hf
+
+    model, params = mistral_from_hf(hf_mistral, dtype=jnp.float32)
+    assert model.sliding_window == 8
+    ids = rng.integers(0, 101, (2, 24)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_mistral(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_mistral_converted_generates_like_hf(hf_mistral, rng):
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import mistral_from_hf
+
+    model, params = mistral_from_hf(hf_mistral, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_mistral.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)

@@ -190,6 +190,47 @@ def test_quantize_model_requires_quant_field():
         quantize_model(PlainCNN(), {"params": {}})
 
 
+def test_quant_llama_family_config(rng):
+    """The LLaMA-shaped config (rope + GQA + swiglu + RMSNorm + bias-free
+    + untied head) quantizes end to end: every projection kind the family
+    adds (gate, grouped k/v, lm_head) gets an int8 kernel."""
+    from tfde_tpu.inference.decode import generate
+
+    model, params = _tiny_fp_model_and_params(
+        position="rope", num_kv_heads=2, mlp_act="swiglu", norm="rms",
+        use_bias=False, tie_embeddings=False,
+    )
+    qmodel, qparams = quantize_model(model, params)
+    blk = qparams["params"]["decoder"]["block_0"]
+    assert blk["mlp"]["gate"]["kernel_q"].dtype == jnp.int8
+    assert blk["attn"]["key"]["kernel_q"].shape == (32, 2, 8)  # GQA kv heads
+    assert qparams["params"]["lm_head"]["kernel_q"].dtype == jnp.int8
+    prompt = jnp.asarray(rng.integers(0, 97, size=(2, 4)), jnp.int32)
+    toks, _ = generate(qmodel, qparams["params"], prompt, 8)
+    assert toks.shape == (2, 12)
+    fp = model.apply(params, prompt, train=False)
+    q = qmodel.apply(qparams, prompt, train=False)
+    cos = jnp.sum(fp * q) / (jnp.linalg.norm(fp) * jnp.linalg.norm(q))
+    assert cos > 0.99
+
+
+def test_quant_model_through_continuous_server(rng):
+    """A quantized model drives the continuous-batching server unchanged —
+    the serving stack is model-agnostic, so int8 composes for free."""
+    from tfde_tpu.inference.server import ContinuousBatcher
+
+    model, params = _tiny_fp_model_and_params()
+    qmodel, qparams = quantize_model(model, params)
+    srv = ContinuousBatcher(qmodel, qparams["params"], batch_size=2,
+                            max_len=24)
+    for _ in range(3):
+        srv.submit(np.asarray(rng.integers(0, 97, size=(5,)), np.int32), 6)
+    done = srv.run()
+    assert len(done) == 3
+    for _rid, toks in done:
+        assert toks.ndim == 1 and toks.shape == (6,)  # no EOS: full budget
+
+
 def test_quantize_params_missing_kernel_errors():
     model, params = _tiny_fp_model_and_params()
     qmodel = model.clone(quant="int8")

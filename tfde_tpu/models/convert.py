@@ -189,6 +189,21 @@ def llama_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def mistral_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers MistralForCausalLM.
+
+    Mistral is the LLaMA architecture (rope + GQA + swiglu + RMSNorm +
+    bias-free) plus sliding-window attention; the HF state-dict layout is
+    identical, so this delegates the weight mapping to `llama_from_hf` and
+    sets `sliding_window` from the config (None in the config means full
+    attention — some later Mistral checkpoints disable the window)."""
+    model, params = llama_from_hf(hf_model, dtype=dtype)
+    window = getattr(hf_model.config, "sliding_window", None)
+    if window is not None:
+        model = model.clone(sliding_window=int(window))
+    return model, params
+
+
 def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(Bert, params) from a transformers BertForMaskedLM (or BertModel —
     then the MLM head params initialize to the identity transform)."""
@@ -305,6 +320,7 @@ _FAMILIES = {
     "gpt2": ("GPT2LMHeadModel", "gpt2_from_hf"),
     "bert": ("BertForMaskedLM", "bert_from_hf"),
     "llama": ("LlamaForCausalLM", "llama_from_hf"),
+    "mistral": ("MistralForCausalLM", "mistral_from_hf"),
 }
 
 
@@ -335,7 +351,7 @@ def load_converted(artifact_dir: str, dtype=None):
     from tfde_tpu.models.bert import Bert
     from tfde_tpu.models.gpt import GPT
 
-    cls = {"gpt2": GPT, "llama": GPT, "bert": Bert}[family]
+    cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "bert": Bert}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
         z = np.load(io.BytesIO(f.read()))

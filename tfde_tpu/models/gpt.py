@@ -64,6 +64,12 @@ class GPT(nn.Module):
     # projections, the embedding/tied head, and the untied lm_head all go
     # int8; wpe and norms stay fp32. Build params with quantize_model.
     quant: Optional[str] = None
+    # sliding-window attention (the Mistral family): each position attends
+    # the last `sliding_window` positions. The flash FORWARD skips
+    # out-of-band tiles (compute and DMA drop to O(S * window)); the
+    # backward currently masks but still scans all tiles (full-causal
+    # cost). The decode cache mask carries the band. None = full causal.
+    sliding_window: Optional[int] = None
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, train: bool = False) -> jax.Array:
@@ -131,6 +137,7 @@ class GPT(nn.Module):
             num_kv_heads=self.num_kv_heads,
             fused_qkv=self.fused_qkv,
             quant=self.quant,
+            window=self.sliding_window,
             norm=self.norm,
             mlp_act=self.mlp_act,
             use_bias=self.use_bias,

@@ -85,6 +85,11 @@ class MultiHeadAttention(nn.Module):
     # None (fp) | 'int8': W8A8 dynamic-quantized projections (ops/quant.py)
     # — the serving-only decode-bandwidth lever; params via quantize_model
     quant: Optional[str] = None
+    # sliding-window attention (Mistral convention): position i attends the
+    # last `window` positions inclusive. Requires causal; composes with the
+    # decode cache (the validity mask carries the band) and the flash
+    # kernel (windowed tile skip); refused under the 'seq' ring
+    window: Optional[int] = None
 
     @property
     def kv_heads(self) -> int:
@@ -102,6 +107,11 @@ class MultiHeadAttention(nn.Module):
             raise ValueError(
                 f"num_kv_heads={self.kv_heads} must be positive and divide "
                 f"num_heads={self.num_heads}"
+            )
+        if self.window is not None and not self.causal:
+            raise ValueError(
+                f"window={self.window} requires causal attention (the "
+                f"sliding window is a band below the causal diagonal)"
             )
         b = batch_axes()
         if _check_quant(self.quant, train):
@@ -166,10 +176,12 @@ class MultiHeadAttention(nn.Module):
                     f"num_kv_heads=None"
                 )
             y = attn_lib.grouped_attention(q, k, v, mask=mask,
-                                           causal=self.causal)
+                                           causal=self.causal,
+                                           window=self.window)
         else:
             y = attn_lib.attention(
-                q, k, v, mask=mask, causal=self.causal, impl=self.attn_impl
+                q, k, v, mask=mask, causal=self.causal, impl=self.attn_impl,
+                window=self.window,
             )
         y = constrain(y, b, "seq", "tensor")
         y = proj(features=x.shape[-1], axis=(-2, -1), name="out")(y)
@@ -218,7 +230,8 @@ class MultiHeadAttention(nn.Module):
             # init pass: variables were just created from this call's shapes
             # (the [B, max_len] budget input) — plain causal attention.
             q, k = self._rotate(q, k, jnp.zeros((), jnp.int32))
-            return attn_lib.grouped_attention(q, k, v, causal=True)
+            return attn_lib.grouped_attention(q, k, v, causal=True,
+                                              window=self.window)
         sq = q.shape[1]
         max_len = cached_key.value.shape[1]
         if sq > max_len:
@@ -241,8 +254,14 @@ class MultiHeadAttention(nn.Module):
             )
             # [1, 1, Sq, max_len]: query (position idx+i) sees kv j<=idx+i
             pos_q = idx + jnp.arange(sq, dtype=jnp.int32)
-            valid = (jnp.arange(max_len, dtype=jnp.int32)[None, :]
-                     <= pos_q[:, None])[None, None]
+            cols = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+            valid = cols <= pos_q[:, None]
+            if self.window is not None:
+                # sliding band over the cache: j in (pos - window, pos]
+                valid = jnp.logical_and(
+                    valid, pos_q[:, None] - cols < self.window
+                )
+            valid = valid[None, None]
         else:
             # per-row indices [B] (batched speculation, inference/
             # speculative.py: acceptance lengths diverge across rows, so
@@ -261,8 +280,13 @@ class MultiHeadAttention(nn.Module):
                           v.astype(cached_value.value.dtype), idx)
             # [B, 1, Sq, max_len]: row b's query i sits at idx[b]+i
             pos_w = idx[:, None] + jnp.arange(sq, dtype=jnp.int32)  # [B,sq]
-            valid = (jnp.arange(max_len, dtype=jnp.int32)[None, None, :]
-                     <= pos_w[:, :, None])[:, None]
+            colsb = jnp.arange(max_len, dtype=jnp.int32)[None, None, :]
+            valid = colsb <= pos_w[:, :, None]
+            if self.window is not None:
+                valid = jnp.logical_and(
+                    valid, pos_w[:, :, None] - colsb < self.window
+                )
+            valid = valid[:, None]
         cached_key.value = constrain(k_all, batch, None, "tensor")
         cached_value.value = constrain(v_all, batch, None, "tensor")
         cache_index.value = idx + sq
@@ -333,6 +357,7 @@ class TransformerBlock(nn.Module):
     num_kv_heads: Optional[int] = None  # GQA (MultiHeadAttention)
     fused_qkv: bool = False  # one-GEMM qkv projection (MultiHeadAttention)
     quant: Optional[str] = None  # int8 serving twins (MultiHeadAttention)
+    window: Optional[int] = None  # sliding window (MultiHeadAttention)
     norm_style: str = "pre"  # 'pre' | 'post'
     norm: str = "layer"  # 'layer' | 'rms' (LLaMA: scale-only, no bias)
     mlp_act: str = "gelu"  # Mlp.act
@@ -367,6 +392,7 @@ class TransformerBlock(nn.Module):
             num_kv_heads=self.num_kv_heads,
             fused_qkv=self.fused_qkv,
             quant=self.quant,
+            window=self.window,
             use_bias=self.use_bias,
             name="attn",
         )
@@ -451,6 +477,7 @@ class Encoder(nn.Module):
     num_kv_heads: Optional[int] = None
     fused_qkv: bool = False
     quant: Optional[str] = None
+    window: Optional[int] = None
     norm_style: str = "pre"
     norm: str = "layer"
     mlp_act: str = "gelu"
@@ -500,6 +527,7 @@ class Encoder(nn.Module):
                 num_kv_heads=self.num_kv_heads,
                 fused_qkv=self.fused_qkv,
                 quant=self.quant,
+                window=self.window,
                 norm_style=self.norm_style,
                 norm=self.norm,
                 mlp_act=self.mlp_act,

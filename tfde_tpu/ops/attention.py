@@ -9,13 +9,13 @@ Three implementations behind one dispatcher:
 
 - ``reference``: einsum + fp32 softmax. The numerics oracle; also what XLA
   fuses perfectly well at short sequence lengths.
-- ``flash``: Pallas TPU kernel (ops/flash_attention.py) — blockwise online
-  softmax, O(S) memory, MXU-shaped tiles. Hardware-qualified on TPU v5e
-  (bench.py flash config, 2026-07: numerics match the reference within bf16
-  tolerance; fwd+bwd speedup 1.02x at S=2048, 1.39x at S=4096, 6.65x at
-  S=8192) — auto-dispatch uses it on TPU from S>=4096, where XLA's fused
-  attention falls off. ``TFDE_FLASH=0`` disables; ``TFDE_FLASH=1`` lowers
-  the threshold to S>=1024.
+- ``flash``: Pallas TPU forward + blockwise backward (ops/flash_attention.py)
+  — online softmax, O(S) memory, MXU-shaped tiles. Hardware-qualified on
+  TPU v5e (r04 A/B, tools/flash_ab.py: causal fwd+bwd 1.15x/1.28x/1.30x
+  over the reference einsum at S=2048/4096/8192) — auto-dispatch uses it
+  on TPU from S>=2048 causal / S>=4096 non-causal (where its O(S) memory,
+  not speed, is the win). ``TFDE_FLASH=0`` disables; ``TFDE_FLASH=1``
+  lowers both thresholds to S>=1024.
 - ``ring``: sequence-parallel blockwise attention over the mesh's 'seq' axis
   (ops/ring_attention.py) — KV blocks rotate around the ring via ppermute
   while compute overlaps, so sequence length scales with the number of chips.
@@ -39,18 +39,22 @@ def reference_attention(
     v: jax.Array,
     mask: Optional[jax.Array] = None,
     causal: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Plain softmax(QK^T/sqrt(d))V with fp32 accumulation.
 
     mask: broadcastable to [B, H, Sq, Sk]; True/1 = attend. Additive -inf
-    masking in fp32 keeps bf16 inputs numerically safe.
+    masking in fp32 keeps bf16 inputs numerically safe. window: sliding-
+    window (Mistral-style) band — position i attends [i-window+1, i];
+    requires causal=True.
 
     The numerics oracle every other kernel is tested against. Internally
     the degenerate (groups == 1) case of `grouped_attention` — ONE
     scale/mask/fp32-softmax implementation, so the oracle and the GQA
     decode path cannot drift.
     """
-    return grouped_attention(q, k, v, mask=mask, causal=causal)
+    return grouped_attention(q, k, v, mask=mask, causal=causal,
+                             window=window)
 
 
 def grouped_attention(
@@ -59,6 +63,7 @@ def grouped_attention(
     v: jax.Array,
     mask: Optional[jax.Array] = None,
     causal: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Grouped-query attention: q [B,Sq,H,D] against k/v [B,Sk,Kv,D] with
     H = Kv * groups — each KV head serves a contiguous group of query heads.
@@ -75,6 +80,11 @@ def grouped_attention(
     kv = k.shape[2]
     if h % kv:
         raise ValueError(f"query heads {h} must be a multiple of kv heads {kv}")
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1 — the "
+            f"sliding window is a band below the causal diagonal"
+        )
     g = h // kv
     sk = k.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -84,6 +94,13 @@ def grouped_attention(
     ) * scale
     if causal:
         cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        if window is not None:
+            # rows are the LAST sq absolute positions (offset sk - sq, the
+            # same alignment the causal tril uses): row i sees cols in
+            # (i - window, i]
+            rows = (sk - sq) + jnp.arange(sq)[:, None]
+            cols = jnp.arange(sk)[None, :]
+            cm = jnp.logical_and(cm, rows - cols < window)
         mask = cm if mask is None else jnp.logical_and(mask, cm)
     if mask is not None:
         if mask.ndim == 2:  # [Sq, Sk]
@@ -136,8 +153,16 @@ def attention(
     mask: Optional[jax.Array] = None,
     causal: bool = False,
     impl: str = "auto",
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Dispatching attention: [B,S,H,D] -> [B,S,H,D].
+
+    window: sliding-window band (Mistral convention — position i attends
+    the last `window` positions inclusive, requires causal). Composes with
+    'reference' and 'flash' (whose forward skips out-of-band tiles —
+    compute and DMA O(S * window); the backward masks but scans all
+    tiles); 'ring' refuses it loudly for now (a band that spans shard
+    boundaries needs windowed ring rotation).
 
     impl: 'auto' | 'reference' | 'flash' | 'ring'. 'auto' picks ring when the
     active mesh shards 'seq'; on TPU it picks flash for CAUSAL
@@ -156,6 +181,12 @@ def attention(
     per-shard ring body — there is no mesh to consult in there, and local
     attention over a seq shard would silently be the wrong math.
     """
+    if window is not None and _seq_parallel_active():
+        raise NotImplementedError(
+            "sliding-window attention does not compose with the 'seq' ring "
+            "yet (the band spans shard boundaries); run sliding-window "
+            "models without SequenceParallelStrategy / pp x sp"
+        )
     manual = axes_lib.manual_seq_info()
     if manual is not None:
         if impl not in ("auto", "ring"):
@@ -208,7 +239,8 @@ def attention(
         else:
             impl = "reference"
     if impl == "reference":
-        return reference_attention(q, k, v, mask=mask, causal=causal)
+        return reference_attention(q, k, v, mask=mask, causal=causal,
+                                   window=window)
     if impl == "flash":
         if mask is not None:
             raise NotImplementedError(
@@ -216,8 +248,13 @@ def attention(
                 "impl='reference' (or 'auto', which refuses flash when a "
                 "mask is present)"
             )
-        return _flash_sharded(q, k, v, causal)
+        return _flash_sharded(q, k, v, causal, window)
     if impl == "ring":
+        if window is not None:
+            raise NotImplementedError(
+                "ring attention does not support sliding windows yet; use "
+                "impl='reference'/'flash' without a 'seq' mesh axis"
+            )
         from tfde_tpu.ops import ring_attention
 
         return ring_attention.ring_attention(
@@ -227,7 +264,7 @@ def attention(
 
 
 def _flash_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
-                   causal: bool) -> jax.Array:
+                   causal: bool, window=None) -> jax.Array:
     """Call the Pallas flash kernel batch-parallel over the active mesh.
 
     A pallas_call under plain jit with sharded operands is NOT partitioned
@@ -258,7 +295,8 @@ def _flash_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
             "which picks it automatically) for pipelined models"
         )
     if not isinstance(mesh, jax.sharding.Mesh):
-        return fa.flash_attention(q, k, v, causal=causal, interpret=interpret)
+        return fa.flash_attention(q, k, v, causal=causal, window=window,
+                                  interpret=interpret)
     from jax.sharding import PartitionSpec as P
 
     from tfde_tpu.parallel.sharding import data_axes as _data_axes
@@ -274,11 +312,12 @@ def _flash_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     if q.shape[0] % max(d, 1):
         batch_axes, d = (), 1
     if d <= 1 and heads is None:
-        return fa.flash_attention(q, k, v, causal=causal, interpret=interpret)
+        return fa.flash_attention(q, k, v, causal=causal, window=window,
+                                  interpret=interpret)
     spec = P(batch_axes if batch_axes else None, None, heads, None)
     fn = jax.shard_map(
         lambda q, k, v: fa.flash_attention(
-            q, k, v, causal=causal, interpret=interpret
+            q, k, v, causal=causal, window=window, interpret=interpret
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -45,7 +45,7 @@ _NEG = -1e30
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, causal, scale,
+    *, causal, scale, window,
 ):
     # BHSD layout, grid (B, H, Sq/bq, Sk/bk) with the K dimension minor:
     # q_ref [1, 1, bq, D]; k_ref/v_ref [1, 1, bk, D] — only one K/V tile is
@@ -75,7 +75,11 @@ def _fwd_kernel(
         if causal:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, _NEG)
+            keep = rows >= cols
+            if window is not None:
+                # sliding band: row i sees cols in (i - window, i]
+                keep = jnp.logical_and(keep, rows - cols < window)
+            s = jnp.where(keep, s, _NEG)
         m_prev = m_ref[:, 0:1]  # [bq, 1]
         l_prev = l_ref[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -91,8 +95,14 @@ def _fwd_kernel(
         )
 
     if causal:
-        # K-tiles strictly past this Q-tile's last row contribute nothing
-        pl.when(kb * bk <= (qi + 1) * bq - 1)(_step)
+        # K-tiles strictly past this Q-tile's last row contribute nothing;
+        # with a sliding window, neither do tiles entirely older than the
+        # oldest position the tile's first row can see
+        run = kb * bk <= (qi + 1) * bq - 1
+        if window is not None:
+            run = jnp.logical_and(run,
+                                  kb * bk + bk - 1 >= qi * bq - (window - 1))
+        pl.when(run)(_step)
     else:
         _step()
 
@@ -106,6 +116,7 @@ def _fwd_kernel(
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool, block_q: int, block_k: int, interpret: bool,
+    window=None,
 ) -> Tuple[jax.Array, jax.Array]:
     b, s, h, d = q.shape
     if k.shape != q.shape or v.shape != q.shape:
@@ -123,21 +134,36 @@ def _flash_forward(
             f"sequence length {s} must be divisible by block sizes "
             f"({block_q}, {block_k})"
         )
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1"
+        )
     scale = 1.0 / (d ** 0.5)
-    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale)
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               window=window)
     # BSHD -> BHSD so the S/D dims are the TPU-tiled trailing pair
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     from jax.experimental.pallas import tpu as pltpu
 
     if causal:
-        # skipped K-tiles (strictly past the Q-tile's last row) must not
-        # spend DMA: point their index map at tile 0, the one the NEXT
-        # Q-tile's first step needs — the pipeline elides repeat fetches,
-        # so masked-off steps cost ~nothing instead of a dead K/V copy
+        # skipped K-tiles (strictly past the Q-tile's last row, or — with a
+        # sliding window — entirely older than the band) must not spend
+        # DMA: point their index map at an in-band tile the pipeline will
+        # need anyway; repeat fetches are elided, so masked-off steps cost
+        # ~nothing instead of a dead K/V copy
         def kv_idx(bi, hi, qi, kb):
-            return (bi, hi,
-                    jax.lax.select(kb * block_k <= (qi + 1) * block_q - 1,
-                                   kb, 0), 0)
+            run = kb * block_k <= (qi + 1) * block_q - 1
+            if window is None:
+                first = 0
+            else:
+                run = jnp.logical_and(
+                    run,
+                    kb * block_k + block_k - 1 >= qi * block_q - (window - 1),
+                )
+                first = jnp.maximum(
+                    (qi * block_q - (window - 1)) // block_k, 0
+                )
+            return (bi, hi, jax.lax.select(run, kb, first), 0)
     else:
         def kv_idx(bi, hi, qi, kb):
             return (bi, hi, kb, 0)
@@ -172,7 +198,7 @@ def _flash_forward(
     return jnp.swapaxes(out, 1, 2), lse[..., 0]
 
 
-def _bwd_blockwise(res, g, *, causal: bool, block_k: int):
+def _bwd_blockwise(res, g, *, causal: bool, block_k: int, window=None):
     """Blockwise JAX backward: recompute P tile-by-tile from the saved
     logsumexp (standard flash-attention backward), O(S) memory."""
     q, k, v, out, lse = res
@@ -196,7 +222,12 @@ def _bwd_blockwise(res, g, *, causal: bool, block_k: int):
                             preferred_element_type=jnp.float32) * scale
         if causal:
             cols = kb * block_k + jnp.arange(block_k)
-            logits = jnp.where(q_pos[:, None] >= cols[None, :], logits, _NEG)
+            keep = q_pos[:, None] >= cols[None, :]
+            if window is not None:
+                keep = jnp.logical_and(
+                    keep, q_pos[:, None] - cols[None, :] < window
+                )
+            logits = jnp.where(keep, logits, _NEG)
         p = jnp.exp(logits - lse[..., None])  # [b,h,Sq,bk]
         dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
         dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vl)
@@ -215,7 +246,7 @@ def _bwd_blockwise(res, g, *, causal: bool, block_k: int):
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, causal, scale,
+    dk_acc, dv_acc, *, causal, scale, window,
 ):
     # grid (B, H, Sk/bk, Sq/bq) with the Q dimension minor: one K/V tile's
     # gradient accumulators live in VMEM scratch while every Q tile streams
@@ -248,7 +279,10 @@ def _dkv_kernel(
         if causal:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, _NEG)
+            keep = rows >= cols
+            if window is not None:
+                keep = jnp.logical_and(keep, rows - cols < window)
+            s = jnp.where(keep, s, _NEG)
         p = jnp.exp(s - lse)  # [bq, bk]
         # dV += P^T dO
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -268,8 +302,14 @@ def _dkv_kernel(
         )
 
     if causal:
-        # Q tiles strictly above this K tile's first column see none of it
-        pl.when((qi + 1) * bq - 1 >= kb * bk)(_step)
+        # Q tiles strictly above this K tile's first column see none of it;
+        # with a window, neither do Q tiles entirely past the band
+        run = (qi + 1) * bq - 1 >= kb * bk
+        if window is not None:
+            run = jnp.logical_and(
+                run, qi * bq <= kb * bk + bk - 1 + (window - 1)
+            )
+        pl.when(run)(_step)
     else:
         _step()
 
@@ -281,7 +321,7 @@ def _dkv_kernel(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, causal, scale,
+    *, causal, scale, window,
 ):
     # grid (B, H, Sq/bq, Sk/bk) with K minor: one Q tile's dQ accumulates in
     # VMEM scratch while K/V tiles stream past (same traversal as forward).
@@ -309,7 +349,10 @@ def _dq_kernel(
         if causal:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, _NEG)
+            keep = rows >= cols
+            if window is not None:
+                keep = jnp.logical_and(keep, rows - cols < window)
+            s = jnp.where(keep, s, _NEG)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -322,7 +365,11 @@ def _dq_kernel(
         )
 
     if causal:
-        pl.when(kb * bk <= (qi + 1) * bq - 1)(_step)
+        run = kb * bk <= (qi + 1) * bq - 1
+        if window is not None:
+            run = jnp.logical_and(run,
+                                  kb * bk + bk - 1 >= qi * bq - (window - 1))
+        pl.when(run)(_step)
     else:
         _step()
 
@@ -332,7 +379,7 @@ def _dq_kernel(
 
 
 def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
-                interpret: bool):
+                interpret: bool, window=None):
     """FlashAttention-2 backward: dK/dV kernel + dQ kernel, O(S) memory."""
     q, k, v, out, lse = res
     b, s, h, d = q.shape
@@ -373,7 +420,8 @@ def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
 
     kq_k = lambda bi, hi, kb, qi: (bi, hi, kb, 0)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, scale=scale),
+        functools.partial(_dkv_kernel, causal=causal, scale=scale,
+                          window=window),
         grid=(b, h, s // block_k, s // block_q),
         in_specs=[
             tile(block_q, kq_q),   # q
@@ -406,7 +454,8 @@ def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
     else:
         qk_k = lambda bi, hi, qi, kb: (bi, hi, kb, 0)
     (dq,) = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, scale=scale),
+        functools.partial(_dq_kernel, causal=causal, scale=scale,
+                          window=window),
         grid=(b, h, s // block_q, s // block_k),
         in_specs=[
             tile(block_q, qk_q),
@@ -429,7 +478,7 @@ def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -438,18 +487,25 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    window=None,
 ) -> jax.Array:
-    """softmax(QK^T/sqrt(d))V over [B, S, H, D], O(S) memory."""
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    """softmax(QK^T/sqrt(d))V over [B, S, H, D], O(S) memory.
+
+    window: sliding-window band (requires causal) — position i attends the
+    last `window` positions inclusive; out-of-band K tiles are skipped
+    entirely (compute AND DMA), so cost drops to O(S * window)."""
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                            window)
     return out
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                              window)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, interpret, window, res, g):
     import os
 
     # default 'jax' (blockwise): the r04 hardware A/B (tools/flash_ab.py,
@@ -459,8 +515,10 @@ def _bwd(causal, block_q, block_k, interpret, res, g):
     # TFDE_FLASH_BWD=pallas keeps the kernel pair selectable.
     if os.environ.get("TFDE_FLASH_BWD", "jax") == "pallas":
         return _bwd_pallas(res, g, causal=causal, block_q=block_q,
-                           block_k=block_k, interpret=interpret)
-    return _bwd_blockwise(res, g, causal=causal, block_k=block_k)
+                           block_k=block_k, interpret=interpret,
+                           window=window)
+    return _bwd_blockwise(res, g, causal=causal, block_k=block_k,
+                          window=window)
 
 
 flash_attention.defvjp(_fwd, _bwd)
