@@ -51,6 +51,7 @@ SMOKE = {
     "test_streaming.py::test_one_epoch_exact_multiset",   # streaming input
     "test_pipelined_lm.py::test_1f1b_single_stage_direct",  # 1F1B schedule
     "test_rotary.py",  # whole file: tiny pure-math checks            (RoPE)
+    "test_lora.py::test_zero_init_is_identity",            # LoRA adapters
 }
 
 

@@ -156,12 +156,22 @@ def _flash_forward(
             if window is None:
                 first = 0
             else:
-                run = jnp.logical_and(
-                    run,
-                    kb * block_k + block_k - 1 >= qi * block_q - (window - 1),
+                pre_band = (
+                    kb * block_k + block_k - 1 < qi * block_q - (window - 1)
                 )
                 first = jnp.maximum(
                     (qi * block_q - (window - 1)) // block_k, 0
+                )
+                # post-diagonal skipped steps park on the just-used diagonal
+                # tile (fetch elided), NOT on first(qi) — that tile already
+                # passed, so pointing back at it would issue one dead
+                # block_k x d DMA per Q-row; pre-band skipped steps park on
+                # first(qi), the tile the first in-band step needs anyway
+                diag = ((qi + 1) * block_q - 1) // block_k
+                return (
+                    bi, hi,
+                    jnp.where(run, jnp.where(pre_band, first, kb), diag),
+                    0,
                 )
             return (bi, hi, jax.lax.select(run, kb, first), 0)
     else:

@@ -3,6 +3,13 @@ train-and-evaluate driver."""
 
 from tfde_tpu.training.train_state import TrainState  # noqa: F401
 from tfde_tpu.training.step import make_train_step, make_eval_step, init_state  # noqa: F401
+from tfde_tpu.training.lora import (  # noqa: F401
+    LoraConfig,
+    init_lora,
+    init_lora_state,
+    make_lora_loss,
+    merge_lora,
+)
 from tfde_tpu.training.lifecycle import (  # noqa: F401
     Estimator,
     RunConfig,

@@ -1,0 +1,209 @@
+"""LoRA — low-rank adapter fine-tuning with the base model frozen.
+
+Parameter-efficient fine-tuning for the converted-checkpoint workflow
+(models/convert.py brings a pretrained GPT-2/BERT/LLaMA in; this trains
+it on a downstream objective while touching ~1% of the parameters).
+Beyond-reference scale-up scope, like distillation (training/distill.py):
+the reference trains every variable every step (its optimizer applies to
+the full var list, /root/reference/tf2_mnist_distributed.py:85-90); at
+converted-LLM size that is neither necessary nor cheap, and LoRA is the
+standard alternative.
+
+Design — adapters ARE the TrainState, the base is a frozen closure:
+
+- `init_lora(params, config, rng)` builds a tiny tree of `{a, b}` pairs
+  mirroring the targeted kernels. `b` starts at zero, so the adapted
+  model is EXACTLY the base model at step 0.
+- `merge_lora(base, lora, config)` returns base-shaped params with
+  `W + (alpha/rank) * a @ b` folded in. It runs *inside* the compiled
+  step (XLA fuses the rank-r outer product into the surrounding graph),
+  and again at export time to produce a plain checkpoint any consumer of
+  the base architecture can load (`merge_lora` output feeds
+  export/serving.py unchanged).
+- `make_lora_loss(base_params, loss_fn, config)` adapts any existing
+  loss (classification, MLM, distillation, ...) to take the adapter tree
+  as its `params`. The result drives the untouched custom-objective
+  machinery (training/step.py make_custom_train_step, or
+  Estimator(loss_fn=...)), so LoRA inherits every strategy, grad
+  accumulation, checkpointing, and the lifecycle for free — the
+  optimizer state (AdamW mu/nu) is rank-r too, which is the actual
+  memory win.
+
+Base-params memory: the captured `base_params` become constants of the
+compiled step and KEEP whatever sharding they carry (same contract as
+the distillation teacher, training/distill.py) — `jax.device_put` them
+onto the layout you want before calling.
+
+Targeting: `config.target` is a regex tested against the '/'-joined
+param path; the default hits every `kernel` leaf of rank >= 2. Kernels
+are factorized as the matrix of their actual contraction: the attention
+stack's DenseGeneral layouts (transformer.py — `query`/`key`/`value`/
+fused `qkv` contract axis 0 into multi-head features; `out` contracts
+the leading (heads, head_dim) axes) split accordingly, everything else
+(Dense 2-D, conv [h, w, cin, cout]) splits as [prod(leading), last] —
+in every case `a @ b` is rank-r with respect to the true input->output
+map, the standard LoRA semantics. Restrict HF-style with e.g.
+`target=r"attn/(query|value)/kernel$"`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import traverse_util
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """rank: adapter rank r. alpha: scale numerator (delta is scaled by
+    alpha/rank, so tuning rank does not retune the LR). target: regex over
+    the '/'-joined param path; default hits every 2-D `kernel`."""
+
+    rank: int = 8
+    alpha: float = 16.0
+    target: str = r"kernel$"
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+# Modules whose kernel contracts over axis 0 into multi-axis features
+# (flax DenseGeneral with tuple `features`): the attention projections of
+# models/transformer.py. Everything else contracts [prod(leading), last].
+_AXIS0_CONTRACTION = frozenset({"query", "key", "value", "qkv"})
+
+
+def _matrix_shape(path, w) -> tuple:
+    """(in_features, out_features) of the kernel's true contraction map."""
+    if w.ndim == 2:
+        return w.shape[0], w.shape[1]
+    if len(path) >= 2 and path[-2] in _AXIS0_CONTRACTION:
+        return w.shape[0], int(np.prod(w.shape[1:]))
+    return int(np.prod(w.shape[:-1])), w.shape[-1]
+
+
+def lora_target_paths(params: Any, config: LoraConfig) -> list:
+    """The param paths (tuples of names) the config adapts: rank >= 2
+    leaves whose '/'-joined path matches `config.target`."""
+    pat = re.compile(config.target)
+    flat = traverse_util.flatten_dict(params)
+    return [
+        path
+        for path, w in sorted(flat.items())
+        if getattr(w, "ndim", 0) >= 2 and pat.search("/".join(path))
+    ]
+
+
+def init_lora(params: Any, config: LoraConfig, rng: jax.Array) -> Any:
+    """Build the adapter tree: for each targeted kernel [in, out], a pair
+    `a` [in, r] ~ N(0, 1/sqrt(in)) and `b` [r, out] = 0 (standard LoRA
+    init: the delta starts at exactly zero). Adapters take the kernel's
+    dtype. Raises if the target regex matches nothing — a silent no-op
+    fine-tune is never what the caller meant."""
+    paths = lora_target_paths(params, config)
+    if not paths:
+        raise ValueError(
+            f"LoRA target regex {config.target!r} matches no rank>=2 kernel "
+            f"in the param tree — check the path names "
+            f"(e.g. {['/'.join(p) for p in list(traverse_util.flatten_dict(params))[:3]]})"
+        )
+    flat = traverse_util.flatten_dict(params)
+    out = {}
+    for i, path in enumerate(paths):
+        w = flat[path]
+        d_in, d_out = _matrix_shape(path, w)
+        key = jax.random.fold_in(rng, i)
+        a = (
+            jax.random.normal(key, (d_in, config.rank), jnp.float32)
+            / jnp.sqrt(d_in)
+        ).astype(w.dtype)
+        out[path + ("a",)] = a
+        out[path + ("b",)] = jnp.zeros((config.rank, d_out), w.dtype)
+    return traverse_util.unflatten_dict(out)
+
+
+def merge_lora(base_params: Any, lora_params: Any, config: LoraConfig) -> Any:
+    """base-shaped params with each adapted kernel replaced by
+    W + (alpha/rank) * a @ b. The a@b product runs in fp32 and casts back
+    to W's dtype (rank-r GEMMs are tiny; bf16 accumulation there would be
+    pure noise). Used both inside the compiled step and at export time."""
+    flat = dict(traverse_util.flatten_dict(base_params))
+    flat_lora = traverse_util.flatten_dict(lora_params)
+    pairs = {}
+    for path, leaf in flat_lora.items():
+        pairs.setdefault(path[:-1], {})[path[-1]] = leaf
+    for path, ab in pairs.items():
+        if path not in flat:
+            raise ValueError(
+                f"LoRA adapter at {'/'.join(path)} has no matching base kernel"
+            )
+        w = flat[path]
+        delta = (
+            ab["a"].astype(jnp.float32) @ ab["b"].astype(jnp.float32)
+        ) * config.scale
+        flat[path] = (
+            w.astype(jnp.float32) + delta.reshape(w.shape)
+        ).astype(w.dtype)
+    return traverse_util.unflatten_dict(flat)
+
+
+def lora_param_count(lora_params: Any) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(lora_params))
+
+
+def make_lora_loss(
+    base_params: Any,
+    loss_fn: Callable,
+    config: LoraConfig,
+) -> Callable:
+    """Adapt `loss_fn(state, params, batch, rng)` so `params` is the adapter
+    tree: merges into the frozen base, then delegates. Feed the result to
+    make_custom_train_step / Estimator(loss_fn=...) with a TrainState whose
+    `params` are `init_lora(...)` output — gradients (and optimizer slots)
+    exist only for the adapters."""
+
+    def lora_loss(state, lora_params, batch, rng):
+        merged = merge_lora(base_params, lora_params, config)
+        return loss_fn(state, merged, batch, rng)
+
+    return lora_loss
+
+
+def init_lora_state(
+    model,
+    tx,
+    strategy,
+    base_params: Any,
+    config: LoraConfig,
+    seed: int = 0,
+    batch_stats: Any = None,
+):
+    """A TrainState whose `params` (and optimizer state) are the rank-r
+    adapters, sharded per the strategy (adapters replicate under every DP
+    strategy — they are small by construction). Returns (state, shardings);
+    drive it with `make_custom_train_step(strategy, state,
+    make_lora_loss(base_params, your_loss, config))`."""
+    from tfde_tpu.training.step import _state_shardings
+    from tfde_tpu.training.train_state import TrainState
+
+    def init_fn(rng):
+        lora = init_lora(base_params, config, rng)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=lora,
+            batch_stats=batch_stats or {},
+            opt_state=tx.init(lora),
+            apply_fn=model.apply,
+            tx=tx,
+        )
+
+    abstract = jax.eval_shape(init_fn, jax.random.key(seed))
+    shardings = _state_shardings(strategy, abstract)
+    state = jax.jit(init_fn, out_shardings=shardings)(jax.random.key(seed))
+    return state, shardings
