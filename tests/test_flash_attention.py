@@ -54,6 +54,49 @@ def test_flash_gradients_match_reference(rng, causal):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("causal,window", [(False, None), (True, None),
+                                           (True, 64)])
+def test_flash_gqa_matches_grouped_reference(rng, causal, window):
+    """GQA shapes (k/v with fewer heads): forward and all three gradients
+    must match the grouped-einsum oracle — the K/V index maps fold each q
+    head onto its serving KV head, the kernel body is unchanged."""
+    from tfde_tpu.ops.attention import grouped_attention
+
+    b, s, h, kv, d = 2, 128, 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, kv, d)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal, 64, 32, True, window) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            grouped_attention(q, k, v, causal=causal, window=window) ** 2
+        )
+
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal, 64, 32, True, window)),
+        np.asarray(grouped_attention(q, k, v, causal=causal, window=window)),
+        rtol=2e-5, atol=2e-5,
+    )
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert gf[1].shape == (b, s, kv, d) and gf[2].shape == (b, s, kv, d)
+    for a, bb in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_rejects_bad_gqa_heads(rng):
+    q = jnp.zeros((1, 128, 4, 8), jnp.float32)
+    k = v = jnp.zeros((1, 128, 3, 8), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, k, v, False, 64, 64, True)
+
+
 def test_flash_rejects_indivisible_seq(rng):
     q, k, v = _qkv(rng, s=100)
     with pytest.raises(ValueError, match="divisible"):

@@ -158,27 +158,11 @@ class MultiHeadAttention(nn.Module):
                     "generation is a causal-LM capability)"
                 )
             y = self._decode_attention(q, k, v, b)
-        elif self.kv_heads != self.num_heads:
-            # grouped einsum path: K/V stay kv_heads-shaped end to end.
-            # flash/ring dispatch is MHA-only today — refuse the combos
-            # loudly instead of silently falling off the O(S) memory path
-            if attn_lib._seq_parallel_active():
-                raise NotImplementedError(
-                    "GQA does not compose with the 'seq' ring yet: the "
-                    "grouped einsum would materialize the O(S^2) logits the "
-                    "seq axis exists to avoid — use num_kv_heads=None "
-                    "(classic MHA) under SequenceParallelStrategy"
-                )
-            if self.attn_impl not in ("auto", "reference"):
-                raise NotImplementedError(
-                    f"attn_impl={self.attn_impl!r} does not support GQA; "
-                    f"use 'auto'/'reference' (the grouped einsum) or "
-                    f"num_kv_heads=None"
-                )
-            y = attn_lib.grouped_attention(q, k, v, mask=mask,
-                                           causal=self.causal,
-                                           window=self.window)
         else:
+            # GQA included: K/V stay kv_heads-shaped end to end — the
+            # dispatcher routes to the flash kernel (GQA head-folding index
+            # maps) or the grouped einsum, never a repeat-then-attend
+            # expansion, and refuses the MHA-only ring combos loudly
             y = attn_lib.attention(
                 q, k, v, mask=mask, causal=self.causal, impl=self.attn_impl,
                 window=self.window,

@@ -119,7 +119,10 @@ def _flash_forward(
     window=None,
 ) -> Tuple[jax.Array, jax.Array]:
     b, s, h, d = q.shape
-    if k.shape != q.shape or v.shape != q.shape:
+    if k.shape != v.shape:
+        raise ValueError(f"k {k.shape} and v {v.shape} must match")
+    kv = k.shape[2]
+    if k.shape[0] != b or k.shape[1] != s or k.shape[3] != d:
         # All tiling below derives from q.shape; a cross-attention call with
         # longer K/V would silently attend over the wrong range (ADVICE r1).
         raise ValueError(
@@ -127,6 +130,15 @@ def _flash_forward(
             f"k {k.shape}, v {v.shape}; use impl='reference' for "
             f"cross-attention (Sk != Sq)"
         )
+    if h % kv:
+        raise ValueError(
+            f"query heads {h} must be a multiple of kv heads {kv} (GQA)"
+        )
+    # GQA: the grid stays per-QUERY-head; each q head's K/V index map folds
+    # onto its serving KV head (hi // group). The kernel body never sees the
+    # grouping, and the [B,S,H,D] K/V expansion of a repeat-then-attend
+    # formulation never exists in HBM — the bandwidth saving GQA is for.
+    group = h // kv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
@@ -169,14 +181,14 @@ def _flash_forward(
                 # first(qi), the tile the first in-band step needs anyway
                 diag = ((qi + 1) * block_q - 1) // block_k
                 return (
-                    bi, hi,
+                    bi, hi // group,
                     jnp.where(run, jnp.where(pre_band, first, kb), diag),
                     0,
                 )
-            return (bi, hi, jax.lax.select(run, kb, first), 0)
+            return (bi, hi // group, jax.lax.select(run, kb, first), 0)
     else:
         def kv_idx(bi, hi, qi, kb):
-            return (bi, hi, kb, 0)
+            return (bi, hi // group, kb, 0)
 
     grid = (b, h, s // block_q, s // block_k)
     out, lse = pl.pallas_call(
@@ -215,6 +227,9 @@ def _bwd_blockwise(res, g, *, causal: bool, block_k: int, window=None):
     b, s, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     block_k = min(block_k, s)
+    if k.shape[2] != h:
+        return _bwd_blockwise_grouped(res, g, causal=causal,
+                                      block_k=block_k, window=window)
 
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
@@ -252,6 +267,68 @@ def _bwd_blockwise(res, g, *, causal: bool, block_k: int, window=None):
     dk = dks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
     dv = dvs.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _bwd_blockwise_grouped(res, g, *, causal: bool, block_k: int,
+                           window=None):
+    """GQA twin of `_bwd_blockwise`: q [B,S,H,D] against k/v [B,S,Kv,D]
+    with H = Kv * groups. Query heads carry an explicit group axis through
+    the einsums (`c` = kv head, `g` = group member), so dK/dV sum over
+    each KV head's query group inside the contraction and the [B,S,H,D]
+    K/V expansion never materializes — mirroring grouped_attention
+    (ops/attention.py). Kept separate from the MHA recurrence so the
+    hardware-qualified path stays byte-identical."""
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    kv = k.shape[2]
+    grp = h // kv
+    scale = 1.0 / (d ** 0.5)
+    block_k = min(block_k, s)
+
+    qf = q.astype(jnp.float32).reshape(b, s, kv, grp, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32).reshape(b, s, kv, grp, d)
+    # delta[b,c,g,i] = rowsum(dO * O); lse arrives [b,h,s] -> [b,c,g,s]
+    delta = jnp.einsum(
+        "bscgd,bscgd->bcgs", gf,
+        out.astype(jnp.float32).reshape(b, s, kv, grp, d),
+    )
+    lse5 = lse.reshape(b, kv, grp, s)
+    q_pos = jnp.arange(s)
+
+    def step(carry, kb):
+        dq = carry
+        sl = jax.lax.dynamic_slice_in_dim(kf, kb * block_k, block_k, axis=1)
+        vl = jax.lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, axis=1)
+        logits = jnp.einsum("bqcgd,bkcd->bcgqk", qf, sl,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            cols = kb * block_k + jnp.arange(block_k)
+            keep = q_pos[:, None] >= cols[None, :]
+            if window is not None:
+                keep = jnp.logical_and(
+                    keep, q_pos[:, None] - cols[None, :] < window
+                )
+            logits = jnp.where(keep, logits, _NEG)
+        p = jnp.exp(logits - lse5[..., None])  # [b,c,g,Sq,bk]
+        dv = jnp.einsum("bcgqk,bqcgd->bkcd", p, gf)
+        dp = jnp.einsum("bqcgd,bkcd->bcgqk", gf, vl)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bcgqk,bkcd->bqcgd", ds, sl) * scale
+        dk = jnp.einsum("bcgqk,bqcgd->bkcd", ds, qf) * scale
+        return dq, (dk, dv)
+
+    n_kb = s // block_k
+    dq0 = jnp.zeros_like(qf)
+    dq, (dks, dvs) = jax.lax.scan(step, dq0, jnp.arange(n_kb))
+    dk = dks.transpose(1, 0, 2, 3, 4).reshape(b, s, kv, d)
+    dv = dvs.transpose(1, 0, 2, 3, 4).reshape(b, s, kv, d)
+    return (
+        dq.reshape(b, s, h, d).astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+    )
 
 
 def _dkv_kernel(
@@ -501,6 +578,10 @@ def flash_attention(
 ) -> jax.Array:
     """softmax(QK^T/sqrt(d))V over [B, S, H, D], O(S) memory.
 
+    GQA: k/v may carry fewer heads [B, S, Kv, D] with H a multiple of Kv —
+    the grid stays per-query-head and each q head's K/V DMA folds onto its
+    serving KV head, so the repeat-expanded K/V never exists in HBM.
+
     window: sliding-window band (requires causal) — position i attends the
     last `window` positions inclusive; out-of-band K tiles are skipped
     entirely (compute AND DMA), so cost drops to O(S * window)."""
@@ -523,7 +604,12 @@ def _bwd(causal, block_q, block_k, interpret, window, res, g):
     # Pallas dKV/dQ pair — even with 128-lane lse/delta layout and causal
     # prefetch maps — lands at 0.6-0.73x. Same O(S) memory either way;
     # TFDE_FLASH_BWD=pallas keeps the kernel pair selectable.
-    if os.environ.get("TFDE_FLASH_BWD", "jax") == "pallas":
+    q, k = res[0], res[1]
+    if (os.environ.get("TFDE_FLASH_BWD", "jax") == "pallas"
+            and k.shape[2] == q.shape[2]):
+        # the kernel pair is MHA-only (its dK/dV out specs are per-q-head;
+        # GQA would need a cross-head reduction) — GQA always takes the
+        # blockwise recurrence, which is also the measured-faster default
         return _bwd_pallas(res, g, causal=causal, block_q=block_q,
                            block_k=block_k, interpret=interpret,
                            window=window)
