@@ -649,10 +649,20 @@ def gpt_train_flops_per_token(hidden: int, mlp: int, depth: int,
 
 
 def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
-                    smoke: bool) -> dict:
-    """Long-context config: GPT-2-small fwd+bwd at S=4096 — the regime where
-    attention auto-dispatches to the Pallas flash kernel (ops/attention.py).
-    The long-context training capability measured, not just qualified."""
+                    smoke: bool, prefix: str = "gpt_long") -> dict:
+    """GPT training MFU configs on the flash-attention path:
+
+    - ``gpt_long``: GPT-2-small at S=4096, per-chip batch 1 — the
+      long-context regime where attention auto-dispatches to the Pallas
+      flash kernel (ops/attention.py). Capability measured, not just
+      qualified.
+    - ``gpt_medium``: GPT-2-medium (h=1024, 24 layers) at S=1024, batch 8,
+      attn_impl='flash' explicitly (below the auto threshold) — the
+      model-width axis of the MFU story: the BERT roofline (BASELINE.md)
+      attributes the 42%-vs-73% gap to h=768 GEMM efficiency, and this
+      config measures what wider GEMMs recover (36.6% at first light vs
+      20% for gpt_long: width + shorter S both lift it).
+    """
     import jax
     import numpy as np
     import optax
@@ -660,13 +670,20 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
     from tfde_tpu.models.gpt import GPT, next_token_loss
     from tfde_tpu.training.step import init_state, make_custom_train_step
 
+    medium = prefix == "gpt_medium"
     if smoke:
         import jax.numpy as jnp
 
         seq, per_chip_batch = 128, 1
         model = GPT(vocab_size=512, hidden_size=64, depth=2, num_heads=2,
-                    mlp_dim=128, max_position=seq, dtype=jnp.float32)
+                    mlp_dim=128, max_position=seq, dtype=jnp.float32,
+                    attn_impl="flash" if medium else "auto")
         warmup = 1
+    elif medium:
+        seq, per_chip_batch = 1024, 8
+        model = GPT(hidden_size=1024, depth=24, num_heads=16, mlp_dim=4096,
+                    max_position=seq, dropout_rate=0.0, attn_impl="flash")
+        warmup = 2
     else:
         seq, per_chip_batch = 4096, 1
         model = GPT(max_position=seq, dropout_rate=0.0)  # GPT-2 small dims
@@ -704,17 +721,17 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
     )
     achieved = tokens_per_step * flops_per_token / step_s / n_chips
     out = {
-        "gpt_long_seq": seq,
-        "gpt_long_step_ms": round(step_s * 1e3, 2),
-        "gpt_long_loss_moved": bool(abs(loss_end - loss_start) > 1e-9),
+        f"{prefix}_seq": seq,
+        f"{prefix}_step_ms": round(step_s * 1e3, 2),
+        f"{prefix}_loss_moved": bool(abs(loss_end - loss_start) > 1e-9),
     }
-    if _gate(out, "gpt_long", achieved, peak):
+    if _gate(out, prefix, achieved, peak):
         out.update({
-            "gpt_long_mfu": round(achieved / peak, 4),
-            "gpt_long_tokens_per_sec_per_chip": round(
+            f"{prefix}_mfu": round(achieved / peak, 4),
+            f"{prefix}_tokens_per_sec_per_chip": round(
                 tokens_per_step / step_s / n_chips, 1
             ),
-            "gpt_long_achieved_tflops_per_chip": round(achieved / 1e12, 2),
+            f"{prefix}_achieved_tflops_per_chip": round(achieved / 1e12, 2),
         })
     return out
 
@@ -963,6 +980,9 @@ def run_mode() -> None:
                                                fused_qkv=True)),
         ("gpt_long", lambda: _bench_gpt_long(clock, strategy, n_chips, peak,
                                              smoke)),
+        ("gpt_medium", lambda: _bench_gpt_long(clock, strategy, n_chips,
+                                               peak, smoke,
+                                               prefix="gpt_medium")),
         ("decode", lambda: _bench_decode(clock, smoke)),
         ("serve", lambda: _bench_serve(clock, smoke)),
     ]

@@ -67,6 +67,13 @@ def _auto_block(s: int) -> int:
     return min(s, 128)
 
 
+def _resolve_block(block, s: int) -> int:
+    """The one resolution rule for every kernel entry point: None -> the
+    measured auto default; explicit -> clamped to S. Keeping this single
+    prevents forward/backward tile defaults from silently diverging."""
+    return _auto_block(s) if block is None else min(block, s)
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, causal, scale, window,
@@ -166,12 +173,16 @@ def _flash_forward(
     # grouping, and the [B,S,H,D] K/V expansion of a repeat-then-attend
     # formulation never exists in HBM — the bandwidth saving GQA is for.
     group = h // kv
-    block_q = _auto_block(s) if block_q is None else min(block_q, s)
-    block_k = _auto_block(s) if block_k is None else min(block_k, s)
+    auto_blocks = block_q is None and block_k is None
+    block_q = _resolve_block(block_q, s)
+    block_k = _resolve_block(block_k, s)
     if s % block_q or s % block_k:
         raise ValueError(
-            f"sequence length {s} must be divisible by block sizes "
-            f"({block_q}, {block_k})"
+            f"sequence length {s} is not divisible by the kernel tile "
+            f"sizes ({block_q}, {block_k})"
+            + (" chosen automatically — flash attention needs S to be a "
+               "multiple of 128; pad the sequence or use impl='reference'"
+               if auto_blocks else " — pass block_q/block_k that divide S")
         )
     if window is not None and (not causal or window < 1):
         raise ValueError(
@@ -253,7 +264,7 @@ def _bwd_blockwise(res, g, *, causal: bool, block_k: int, window=None):
     q, k, v, out, lse = res
     b, s, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    block_k = _auto_block(s) if block_k is None else min(block_k, s)
+    block_k = _resolve_block(block_k, s)
     if k.shape[2] != h:
         return _bwd_blockwise_grouped(res, g, causal=causal,
                                       block_k=block_k, window=window)
@@ -498,8 +509,8 @@ def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
     q, k, v, out, lse = res
     b, s, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    block_q = _auto_block(s) if block_q is None else min(block_q, s)
-    block_k = _auto_block(s) if block_k is None else min(block_k, s)
+    block_q = _resolve_block(block_q, s)
+    block_k = _resolve_block(block_k, s)
     from jax.experimental.pallas import tpu as pltpu
 
     # delta[b,h,s] = rowsum(dO * O), fp32 — cheap elementwise, stays in JAX
