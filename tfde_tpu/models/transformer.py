@@ -161,8 +161,8 @@ class MultiHeadAttention(nn.Module):
         else:
             # GQA included: K/V stay kv_heads-shaped end to end — the
             # dispatcher routes to the flash kernel (GQA head-folding index
-            # maps) or the grouped einsum, never a repeat-then-attend
-            # expansion, and refuses the MHA-only ring combos loudly
+            # maps), the seq ring (kv_heads-sized shards rotate), or the
+            # grouped einsum; never a repeat-then-attend expansion
             y = attn_lib.attention(
                 q, k, v, mask=mask, causal=self.causal, impl=self.attn_impl,
                 window=self.window,

@@ -22,6 +22,8 @@ Three implementations behind one dispatcher:
 - ``ring``: sequence-parallel blockwise attention over the mesh's 'seq' axis
   (ops/ring_attention.py) — KV blocks rotate around the ring via ppermute
   while compute overlaps, so sequence length scales with the number of chips.
+  Takes GQA shapes too: the rotating KV shards stay kv_heads-sized, so the
+  per-hop ICI transfer shrinks by the group factor.
 
 Shapes follow the Flax convention: q/k/v are [batch, length, heads, head_dim].
 """
@@ -190,12 +192,6 @@ def attention(
             "yet (the band spans shard boundaries); run sliding-window "
             "models without SequenceParallelStrategy / pp x sp"
         )
-    if k.shape[2] != q.shape[2] and _seq_parallel_active():
-        raise NotImplementedError(
-            "GQA does not compose with the 'seq' ring yet (the ring body "
-            "is MHA-only); use matching head counts under "
-            "SequenceParallelStrategy / pp x sp"
-        )
     manual = axes_lib.manual_seq_info()
     if manual is not None:
         if impl not in ("auto", "ring"):
@@ -262,11 +258,6 @@ def attention(
             )
         return _flash_sharded(q, k, v, causal, window)
     if impl == "ring":
-        if k.shape[2] != q.shape[2]:
-            raise NotImplementedError(
-                "ring attention does not support GQA; use 'auto'/"
-                "'reference'/'flash' or matching head counts"
-            )
         if window is not None:
             raise NotImplementedError(
                 "ring attention does not support sliding windows yet; use "

@@ -22,6 +22,12 @@ Masks: `causal` and key-padding masks ([B,1,1,S], ops/attention.padding_mask)
 are supported — the padding row rotates with its KV shard; arbitrary dense
 [B,H,Sq,Sk] masks are not (they would have to be sharded along two axes at
 once).
+
+GQA: k/v may carry fewer heads than q (H = Kv * groups) — the grouped ring
+body rotates kv_heads-sized KV shards, shrinking the per-hop ICI transfer
+by the group factor; numerics match ops/attention.grouped_attention
+(tests/test_ring_attention.py). Long-context Mistral/LLaMA-class training
+composes with the 'seq' axis out of the box.
 """
 
 from __future__ import annotations
@@ -37,10 +43,28 @@ _NEG = -1e30  # finite -inf stand-in: keeps exp() NaN-free on fully-masked block
 
 
 def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal):
-    """One online-softmax accumulation step against one KV chunk."""
+    """One online-softmax accumulation step against one KV chunk.
+
+    GQA: k/v may carry fewer heads [B, Sk, Kv, D] than q (H = Kv * groups)
+    — the score/value einsums index the KV head directly, mirroring
+    ops/attention.grouped_attention, so the KV shards that rotate around
+    the ring stay kv_heads-sized (the ICI transfer shrinks by the group
+    factor, on top of the HBM saving). Accumulators stay per-QUERY-head,
+    so the carries and every ring/block caller are unchanged."""
     o, m, l = carry
-    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    b, sq, h, d = q.shape
+    kv_heads = k.shape[2]
+    sk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if kv_heads != h:
+        g = h // kv_heads  # head index = c * g + group member (h-major)
+        qg = q.reshape(b, sq, kv_heads, g, d)
+        s = jnp.einsum(
+            "bqcgd,bkcd->bcgqk", qg, k, preferred_element_type=jnp.float32
+        ).reshape(b, h, sq, sk)
+    else:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32)
     s = s * scale
     if kv_valid is not None:
         s = jnp.where(kv_valid[:, None, None, :], s, _NEG)
@@ -51,10 +75,17 @@ def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal):
     p = jnp.exp(s - m_new[..., None])                      # [b,h,sq,sk]
     corr = jnp.exp(m - m_new)                              # [b,h,sq]
     l_new = l * corr + jnp.sum(p, axis=-1)
-    pv = jnp.einsum(
-        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
+    if kv_heads != h:
+        pv = jnp.einsum(
+            "bcgqk,bkcd->bqcgd",
+            p.reshape(b, kv_heads, h // kv_heads, sq, sk).astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        ).reshape(b, sq, h, d)
+    else:
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
     o_new = o * corr.transpose(0, 2, 1)[..., None] + pv    # [b,sq,h,d]
     return o_new, m_new, l_new
 
@@ -194,9 +225,23 @@ def ring_attention(
     # composition instead runs the extracted `ring_attention_manual` body
     # directly inside the pipe's FULLY-manual region (models/pipelined.py
     # via parallel/axes.manual_seq), one flat region, AD straight through.
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"query heads {q.shape[2]} must be a multiple of kv heads "
+            f"{k.shape[2]} (GQA)"
+        )
     batch = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
     batch = batch if batch else None
     heads = "tensor" if "tensor" in mesh.axis_names else None
+    if heads is not None and (q.shape[2] % mesh.shape["tensor"]
+                              or k.shape[2] % mesh.shape["tensor"]):
+        # GQA under TP: both head counts must divide so each shard keeps
+        # whole query groups beside their serving KV heads
+        raise NotImplementedError(
+            f"ring attention with a 'tensor' axis of {mesh.shape['tensor']} "
+            f"needs both q heads ({q.shape[2]}) and kv heads "
+            f"({k.shape[2]}) divisible by it"
+        )
     qkv_spec = P(batch, axis, heads, None)
     valid_spec = P(batch, axis)
 
