@@ -87,8 +87,8 @@ class MultiHeadAttention(nn.Module):
     quant: Optional[str] = None
     # sliding-window attention (Mistral convention): position i attends the
     # last `window` positions inclusive. Requires causal; composes with the
-    # decode cache (the validity mask carries the band) and the flash
-    # kernel (windowed tile skip); refused under the 'seq' ring
+    # decode cache (the validity mask carries the band), the flash kernel
+    # (windowed tile skip), and the 'seq' ring (band on global positions)
     window: Optional[int] = None
 
     @property

@@ -164,10 +164,11 @@ def attention(
 
     window: sliding-window band (Mistral convention — position i attends
     the last `window` positions inclusive, requires causal). Composes with
-    'reference' and 'flash' (whose forward skips out-of-band tiles —
-    compute and DMA O(S * window); the backward masks but scans all
-    tiles); 'ring' refuses it loudly for now (a band that spans shard
-    boundaries needs windowed ring rotation).
+    every impl: 'reference' masks, 'flash' skips out-of-band tiles
+    (compute and DMA O(S * window); the backward masks but scans all
+    tiles), and 'ring' masks on global positions — the band is exact
+    across shard boundaries, so sliding-window models train under
+    sequence parallelism and pp x sp.
 
     impl: 'auto' | 'reference' | 'flash' | 'ring'. 'auto' picks ring when the
     active mesh shards 'seq'; on TPU it picks flash for CAUSAL
@@ -186,12 +187,6 @@ def attention(
     per-shard ring body — there is no mesh to consult in there, and local
     attention over a seq shard would silently be the wrong math.
     """
-    if window is not None and _seq_parallel_active():
-        raise NotImplementedError(
-            "sliding-window attention does not compose with the 'seq' ring "
-            "yet (the band spans shard boundaries); run sliding-window "
-            "models without SequenceParallelStrategy / pp x sp"
-        )
     manual = axes_lib.manual_seq_info()
     if manual is not None:
         if impl not in ("auto", "ring"):
@@ -213,7 +208,7 @@ def attention(
 
         return ra.ring_attention_manual(
             q, k, v, causal=causal, ring_size=ring_size,
-            vary_axes=vary_axes,
+            vary_axes=vary_axes, window=window,
         )
     if impl == "auto":
         import os
@@ -258,15 +253,11 @@ def attention(
             )
         return _flash_sharded(q, k, v, causal, window)
     if impl == "ring":
-        if window is not None:
-            raise NotImplementedError(
-                "ring attention does not support sliding windows yet; use "
-                "impl='reference'/'flash' without a 'seq' mesh axis"
-            )
         from tfde_tpu.ops import ring_attention
 
         return ring_attention.ring_attention(
-            q, k, v, mask=mask, causal=causal, mesh=axes_lib.current_mesh()
+            q, k, v, mask=mask, causal=causal, mesh=axes_lib.current_mesh(),
+            window=window,
         )
     raise ValueError(f"unknown attention impl {impl!r}")
 

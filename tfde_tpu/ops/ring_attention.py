@@ -42,7 +42,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG = -1e30  # finite -inf stand-in: keeps exp() NaN-free on fully-masked blocks
 
 
-def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal):
+def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
+                     window=None):
     """One online-softmax accumulation step against one KV chunk.
 
     GQA: k/v may carry fewer heads [B, Sk, Kv, D] than q (H = Kv * groups)
@@ -70,6 +71,12 @@ def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal):
         s = jnp.where(kv_valid[:, None, None, :], s, _NEG)
     if causal:
         allowed = q_pos[:, None] >= k_pos[None, :]  # [sq, sk] global positions
+        if window is not None:
+            # sliding band on GLOBAL positions: row i sees (i - window, i] —
+            # exact across shard boundaries because q_pos/k_pos are global
+            allowed = jnp.logical_and(
+                allowed, q_pos[:, None] - k_pos[None, :] < window
+            )
         s = jnp.where(allowed[None, None], s, _NEG)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))            # [b,h,sq]
     p = jnp.exp(s - m_new[..., None])                      # [b,h,sq,sk]
@@ -91,7 +98,7 @@ def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal):
 
 
 def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
-                     block_k: int = 1024):
+                     block_k: int = 1024, window=None):
     """Online-softmax accumulation against the current KV shard, blockwise:
     the shard is scanned in `block_k` chunks so per-device score memory is
     O(sq * block_k), never O(sq * sk_shard) — the 'blockwise' half of ring
@@ -101,7 +108,8 @@ def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
     take the single-chunk path unchanged."""
     sk = k.shape[1]
     if sk <= block_k or sk % block_k:
-        return _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal)
+        return _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos,
+                                causal, window=window)
 
     def chunk(carry, i):
         start = i * block_k
@@ -113,7 +121,8 @@ def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
         )
         kpc = jax.lax.dynamic_slice_in_dim(k_pos, start, block_k, axis=0)
         return (
-            _chunk_attention(carry, q, kc, vc, kvc, q_pos, kpc, causal),
+            _chunk_attention(carry, q, kc, vc, kvc, q_pos, kpc, causal,
+                             window=window),
             None,
         )
 
@@ -131,6 +140,7 @@ def ring_attention_manual(
     ring_size: int = 1,
     block_k: int = 1024,
     vary_axes: tuple = (),
+    window=None,
 ) -> jax.Array:
     """The per-shard ring body, for callers ALREADY inside a manual region
     where `axis` is a manual mesh axis — e.g. a stage of the fully-manual
@@ -145,6 +155,13 @@ def ring_attention_manual(
     varying over (normally every manual axis in play). Returns the
     local shard of softmax(QK^T)V over the GLOBAL sequence.
     """
+    if window is not None and (not causal or window < 1):
+        # the funnel both the public ring and the pp x sp manual dispatch
+        # flow through — siblings (grouped/flash) validate identically, and
+        # a silently ignored band would be wrong math, not an error
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1"
+        )
     idx = jax.lax.axis_index(axis)
     sq = q.shape[1]
     out_dtype = q.dtype
@@ -164,9 +181,30 @@ def ring_attention_manual(
         o_m_l, k, v, kv_valid = carry
         src = (idx - t) % n  # whose KV shard we hold at step t
         k_pos = src * sq + jnp.arange(sq)
-        o_m_l = _block_attention(
-            o_m_l, q, k, v, kv_valid, q_pos, k_pos, causal, block_k=block_k
-        )
+
+        def accumulate(c):
+            return _block_attention(
+                c, q, k, v, kv_valid, q_pos, k_pos, causal,
+                block_k=block_k, window=window,
+            )
+
+        if causal:
+            # hop skip: a source shard entirely in this shard's future
+            # (or, with a window, entirely older than the band) is fully
+            # masked — its accumulation is an exact no-op, so skip the
+            # compute and let only the rotation run. The long-context
+            # windowed case is the payoff: with S >> window each query
+            # shard overlaps O(window / S_local + 1) of the n hops, the
+            # ring analog of the flash forward's O(S * window) tile skip.
+            in_band = idx * sq + sq - 1 >= src * sq  # q_hi >= k_lo
+            if window is not None:
+                in_band = jnp.logical_and(
+                    in_band,
+                    idx * sq - (src * sq + sq - 1) < window,  # q_lo-k_hi
+                )
+            o_m_l = jax.lax.cond(in_band, accumulate, lambda c: c, o_m_l)
+        else:
+            o_m_l = accumulate(o_m_l)
 
         # rotate KV one hop; skipped after the last accumulation
         def rotate(args):
@@ -199,6 +237,7 @@ def ring_attention(
     mesh: Optional[Mesh] = None,
     axis: str = "seq",
     block_k: int = 1024,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """[B, S, H, D] attention with S sharded over `axis` of `mesh`.
 
@@ -211,6 +250,10 @@ def ring_attention(
         raise ValueError(
             f"ring_attention needs a mesh with a {axis!r} axis; use "
             "ops.attention.attention(impl='reference') otherwise"
+        )
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1"
         )
     kv_valid = None
     if mask is not None:
@@ -254,6 +297,7 @@ def ring_attention(
         return ring_attention_manual(
             q, k, v, kv_valid, causal=causal, axis=axis, ring_size=n,
             block_k=block_k, vary_axes=tuple(mesh.axis_names),
+            window=window,
         )
 
     if kv_valid is None:
