@@ -85,6 +85,25 @@ def test_gpt_lm_example_3d_and_moe_smoke():
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
 
 
+def test_lora_finetune_example():
+    """The LoRA entrypoint end to end: inline base pretrain, q/v-adapter
+    fine-tune, merge, generate from the merged params — all on the fake
+    mesh. The merged tree must be base-shaped (the export contract)."""
+    from examples import lora_finetune
+
+    base, merged = lora_finetune.main(
+        ["--tiny", "--max-steps", "5", "--pretrain-steps", "5",
+         "--seq-len", "16", "--batch-size", "16", "--generate", "4"]
+    )
+    # base-shaped: same tree structure and leaf shapes as the frozen base
+    assert (jax.tree_util.tree_structure(merged)
+            == jax.tree_util.tree_structure(base))
+    for mb, bb in zip(jax.tree_util.tree_leaves(merged),
+                      jax.tree_util.tree_leaves(base)):
+        assert mb.shape == bb.shape
+        assert np.isfinite(np.asarray(mb)).all()
+
+
 def test_serve_gpt_example():
     """The continuous-batching serving demo drains its queue with every
     request completed at full budget."""
