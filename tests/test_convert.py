@@ -95,6 +95,72 @@ def hf_llama():
     return m
 
 
+@pytest.fixture(scope="module")
+def hf_gemma():
+    # head_dim 16 with hidden 32 / 4 heads: attention width 64 != hidden —
+    # the gemma-7b-shaped decoupling (GPT(head_dim=...))
+    cfg = transformers.GemmaConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=64, attention_dropout=0.0,
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(3)
+    m = transformers.GemmaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_gemma_logits_match(hf_gemma, rng):
+    """Gemma = LLaMA shape + geglu MLP + sqrt(h)-scaled embeddings +
+    zero-centered RMSNorm (folded to 1+w at conversion) + tied head +
+    decoupled head_dim (7b-shaped) — one converted forward checks all of
+    it against transformers."""
+    from tfde_tpu.models.convert import gemma_from_hf
+
+    model, params = gemma_from_hf(hf_gemma, dtype=jnp.float32)
+    assert model.mlp_act == "geglu" and model.tie_embeddings
+    assert model.embed_scale == pytest.approx(32 ** 0.5)
+    assert model.head_dim == 16  # != hidden // heads
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_gemma(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma_untied_refused():
+    """An untied Gemma-arch checkpoint carries a distinct lm_head.weight
+    this converter would silently drop — refuse loudly instead."""
+    from tfde_tpu.models.convert import gemma_from_hf
+
+    cfg = transformers.GemmaConfig(
+        vocab_size=51, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+        head_dim=8, max_position_embeddings=32,
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    m = transformers.GemmaForCausalLM(cfg)
+    with pytest.raises(NotImplementedError, match="untied"):
+        gemma_from_hf(m, dtype=jnp.float32)
+
+
+def test_gemma_converted_generates_like_hf(hf_gemma, rng):
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import gemma_from_hf
+
+    model, params = gemma_from_hf(hf_gemma, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_gemma.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
 def test_llama_logits_match(hf_llama, rng):
     """LLaMA = RoPE + GQA + RMSNorm + SwiGLU + bias-free + untied head —
     one converted forward checks all five against transformers."""
@@ -121,13 +187,16 @@ def test_llama_converted_generates_like_hf(hf_llama, rng):
     np.testing.assert_array_equal(np.asarray(ours), ref)
 
 
-def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama):
+def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma):
     """Converted trees must match the models' own init structure exactly —
     a missing/extra leaf means a silently unconverted weight."""
+    from tfde_tpu.models.convert import gemma_from_hf
+
     for hf, conv, sample in (
         (hf_gpt2, gpt2_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_bert, bert_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_llama, llama_from_hf, jnp.zeros((1, 8), jnp.int32)),
+        (hf_gemma, gemma_from_hf, jnp.zeros((1, 8), jnp.int32)),
     ):
         model, params = conv(hf, dtype=jnp.float32)
         ref = model.init(jax.random.key(0), sample)["params"]

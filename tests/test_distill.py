@@ -93,10 +93,20 @@ print(json.dumps({
     import os
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    proc = subprocess.run(
-        [sys.executable, "-c", script], capture_output=True, text=True,
-        timeout=800, cwd=repo,
-    )
+    for attempt in range(3):
+        proc = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True,
+            timeout=800, cwd=repo,
+        )
+        if proc.returncode == 0:
+            break
+        if "rendezvous" not in proc.stderr:
+            break
+        # XLA's in-process CPU collective rendezvous times out when the
+        # box is oversubscribed (8 virtual devices on few cores under a
+        # loaded CI: "Expected 8 threads to join ... only N arrived") and
+        # SIGABRTs the subprocess — a load flake, not a code defect.
+        # Retry; a real failure reproduces.
     assert proc.returncode == 0, proc.stderr[-1500:]
     r = json.loads(proc.stdout.strip().splitlines()[-1])
     assert r["kl"] < r["first_kl"]

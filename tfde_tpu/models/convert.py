@@ -132,7 +132,9 @@ def llama_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
         )
     heads = cfg.num_attention_heads
     hidden = cfg.hidden_size
-    hd = hidden // heads
+    # Gemma-7b-class checkpoints decouple the per-head width from
+    # hidden/heads; honor the config's head_dim when present
+    hd = getattr(cfg, "head_dim", None) or hidden // heads
     kv = cfg.num_key_value_heads
     tied = bool(getattr(cfg, "tie_word_embeddings", False))
     model = GPT(
@@ -152,6 +154,7 @@ def llama_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
         use_bias=False,
         tie_embeddings=tied,
         ln_eps=cfg.rms_norm_eps,
+        head_dim=None if hd == hidden // heads else hd,
     )
     sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     pre = "model." if any(k.startswith("model.") for k in sd) else ""
@@ -201,6 +204,50 @@ def mistral_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     window = getattr(hf_model.config, "sliding_window", None)
     if window is not None:
         model = model.clone(sliding_window=int(window))
+    return model, params
+
+
+def gemma_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers GemmaForCausalLM.
+
+    Gemma is LLaMA-shaped (rope + GQA + RMSNorm + bias-free + gated MLP
+    + decoupled head_dim on 7b), so the weight mapping delegates to
+    `llama_from_hf` — like `mistral_from_hf` — and this function handles
+    the three Gemma deltas:
+
+    - gelu-gated MLP (`mlp_act='geglu'`, HF gelu_pytorch_tanh);
+    - token embeddings scaled by sqrt(hidden) (`GPT(embed_scale=...)`);
+    - zero-centered RMSNorm weights (the HF module computes `x * (1 + w)`)
+      — folded into the stored scales as `1 + w` at conversion, so the
+      model's plain RMSNorm reproduces the math with no runtime branch.
+    """
+    cfg = hf_model.config
+    act = (getattr(cfg, "hidden_activation", None)
+           or getattr(cfg, "hidden_act", None))
+    if act not in ("gelu_pytorch_tanh", "gelu_tanh", None):
+        raise NotImplementedError(
+            f"hidden activation {act!r} is not supported (expected the "
+            f"Gemma tanh-gelu); converting would silently change the math"
+        )
+    if not bool(getattr(cfg, "tie_word_embeddings", True)):
+        # every Gemma release ties; an untied fine-tune would carry a
+        # distinct lm_head.weight this path would silently drop
+        raise NotImplementedError(
+            "untied Gemma-architecture checkpoints are not supported "
+            "(lm_head.weight would be silently dropped)"
+        )
+    model, params = llama_from_hf(hf_model, dtype=dtype)
+    model = model.clone(
+        mlp_act="geglu",
+        tie_embeddings=True,
+        embed_scale=float(cfg.hidden_size) ** 0.5,
+    )
+    dec = params["decoder"]
+    dec["ln_final"]["scale"] = 1.0 + dec["ln_final"]["scale"]
+    for i in range(cfg.num_hidden_layers):
+        blk = dec[f"block_{i}"]
+        blk["ln_attn"]["scale"] = 1.0 + blk["ln_attn"]["scale"]
+        blk["ln_mlp"]["scale"] = 1.0 + blk["ln_mlp"]["scale"]
     return model, params
 
 
@@ -321,6 +368,7 @@ _FAMILIES = {
     "bert": ("BertForMaskedLM", "bert_from_hf"),
     "llama": ("LlamaForCausalLM", "llama_from_hf"),
     "mistral": ("MistralForCausalLM", "mistral_from_hf"),
+    "gemma": ("GemmaForCausalLM", "gemma_from_hf"),
 }
 
 
@@ -351,7 +399,8 @@ def load_converted(artifact_dir: str, dtype=None):
     from tfde_tpu.models.bert import Bert
     from tfde_tpu.models.gpt import GPT
 
-    cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "bert": Bert}[family]
+    cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
+           "bert": Bert}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
         z = np.load(io.BytesIO(f.read()))

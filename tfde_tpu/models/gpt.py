@@ -55,8 +55,15 @@ class GPT(nn.Module):
     # KV cache shrinks by num_heads/num_kv_heads — the serving memory knob
     num_kv_heads: Optional[int] = None
     norm: str = "layer"      # 'layer' | 'rms' (LLaMA)
-    mlp_act: str = "gelu"    # 'gelu' | 'swiglu' (LLaMA)
+    mlp_act: str = "gelu"    # 'gelu' | 'swiglu' (LLaMA) | 'geglu' (Gemma)
     use_bias: bool = True    # False: LLaMA bias-free projections
+    # token embeddings are multiplied by this after lookup (Gemma:
+    # sqrt(hidden_size)); None = no scaling (every other family)
+    embed_scale: Optional[float] = None
+    # per-head width; None = hidden_size // num_heads. Gemma-7b-style
+    # checkpoints decouple it (attention width heads*head_dim != hidden;
+    # the out projection maps back to hidden either way)
+    head_dim: Optional[int] = None
     # True (GPT-2): LM head = wte^T via Embed.attend; False (LLaMA):
     # separate bias-free lm_head Dense
     tie_embeddings: bool = True
@@ -95,6 +102,8 @@ class GPT(nn.Module):
                 f"position must be 'learned' or 'rope', got {self.position!r}"
             )
         x = wte(input_ids)
+        if self.embed_scale is not None:
+            x = x * jnp.asarray(self.embed_scale, self.dtype)
         if self.position == "learned":
             wpe = nn.Embed(
                 self.max_position, self.hidden_size, dtype=self.dtype,
@@ -125,7 +134,7 @@ class GPT(nn.Module):
         x = Encoder(
             depth=self.depth,
             num_heads=self.num_heads,
-            head_dim=self.hidden_size // self.num_heads,
+            head_dim=self.head_dim or self.hidden_size // self.num_heads,
             mlp_dim=self.mlp_dim,
             dtype=self.dtype,
             dropout_rate=self.dropout_rate,

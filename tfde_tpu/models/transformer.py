@@ -312,8 +312,15 @@ class Mlp(nn.Module):
         elif self.act == "swiglu":
             gate = dense(self.mlp_dim, name="gate")(x)
             h = nn.silu(gate) * h
+        elif self.act == "geglu":
+            # gelu-gated (the Gemma family): tanh-approximate gelu on the
+            # gate, matching HF's gelu_pytorch_tanh
+            gate = dense(self.mlp_dim, name="gate")(x)
+            h = nn.gelu(gate, approximate=True) * h
         else:
-            raise ValueError(f"act must be 'gelu' or 'swiglu', got {self.act!r}")
+            raise ValueError(
+                f"act must be 'gelu', 'swiglu' or 'geglu', got {self.act!r}"
+            )
         h = constrain(h, b, "seq", "tensor")
         h = dense(x.shape[-1], name="fc2")(h)
         h = constrain(h, b, "seq")
