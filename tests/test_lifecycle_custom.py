@@ -42,6 +42,45 @@ def _token_input_fn(seed, n=256, batch=16, seq=16, repeat=None):
     return input_fn
 
 
+def test_lora_estimator_lifecycle(tmp_path):
+    """LoRA through the FULL lifecycle: adapters-only TrainState (tiny
+    checkpoints), resume-by-default, eval/predict on the MERGED params,
+    base frozen throughout."""
+    from tfde_tpu.training.lora import LoraConfig
+
+    model = gpt_tiny_test()
+    base = model.init(jax.random.key(5), jnp.zeros((2, 8), jnp.int32),
+                      train=False)["params"]
+    n_base = sum(x.size for x in jax.tree_util.tree_leaves(base))
+    cfg = RunConfig(model_dir=str(tmp_path), save_checkpoints_steps=10)
+    mk = lambda: Estimator(
+        model, optax.adamw(5e-3), config=cfg, loss_fn=next_token_loss,
+        eval_fn=lm_eval_fn, lora=LoraConfig(rank=4),
+        lora_base_params=base,
+    )
+    est = mk()
+    state = est.train(_token_input_fn(0), max_steps=20)
+    # the TrainState holds adapters, not the base — the checkpoint is tiny
+    n_train = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
+    assert n_train < n_base / 5
+    first = est.evaluate(_token_input_fn(1, repeat=1), name="eval")
+    assert np.isfinite(first["loss"])
+    est.close()
+
+    # resume: a fresh estimator restores the adapters and continues
+    est2 = mk()
+    state = est2.train(_token_input_fn(2), max_steps=70)
+    assert int(jax.device_get(state.step)) == 70
+    second = est2.evaluate(_token_input_fn(1, repeat=1), name="eval")
+    assert second["loss"] < first["loss"]
+    # the frozen base never changed
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(est2._lora_base)[0]),
+        np.asarray(jax.tree_util.tree_leaves(base)[0]),
+    )
+    est2.close()
+
+
 def test_lm_estimator_lifecycle_and_resume(tmp_path):
     cfg = RunConfig(model_dir=str(tmp_path), save_summary_steps=5,
                     save_checkpoints_steps=10, log_step_count_steps=10)

@@ -222,12 +222,15 @@ def gemma_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
       model's plain RMSNorm reproduces the math with no runtime branch.
     """
     cfg = hf_model.config
-    act = (getattr(cfg, "hidden_activation", None)
-           or getattr(cfg, "hidden_act", None))
+    # hidden_act is what the installed GemmaMLP actually runs
+    # (ACT2FN[config.hidden_act]); hidden_activation is a config-era alias
+    # that GemmaConfig folds into it — validating the alias could pass a
+    # checkpoint whose live field says something else
+    act = getattr(cfg, "hidden_act", None)
     if act not in ("gelu_pytorch_tanh", "gelu_tanh", None):
         raise NotImplementedError(
-            f"hidden activation {act!r} is not supported (expected the "
-            f"Gemma tanh-gelu); converting would silently change the math"
+            f"hidden_act {act!r} is not supported (expected the Gemma "
+            f"tanh-gelu); converting would silently change the math"
         )
     if not bool(getattr(cfg, "tie_word_embeddings", True)):
         # every Gemma release ties; an untied fine-tune would carry a

@@ -106,6 +106,8 @@ class Estimator:
         loss_fn=None,
         eval_fn=None,
         grad_accum: int = 1,
+        lora=None,
+        lora_base_params=None,
     ):
         """eval_strategy: evaluate under a *different* strategy than training
         — the reference's `DistributeConfig(train_distribute=
@@ -123,7 +125,16 @@ class Estimator:
         per-batch mean}` (+ optional reserved "weight"); required for
         evaluate()/train_and_evaluate() when loss_fn is set — eval must be
         deterministic, which the rng-taking loss_fn cannot promise.
-        grad_accum: sequential microbatches per update (step.py)."""
+        grad_accum: sequential microbatches per update (step.py).
+
+        lora + lora_base_params: parameter-efficient fine-tuning through
+        the FULL lifecycle (training/lora.py). The TrainState — and so
+        every checkpoint — holds only the rank-r adapters and their
+        optimizer slots (tiny, fast saves); the frozen base is a constant
+        of the compiled step. evaluate()/predict()/export run on the
+        MERGED base-shaped params, so eval_fn, the serving signature, and
+        exporters see a plain model. loss_fn/eval_fn keep their normal
+        signatures (their `params` argument arrives merged)."""
         self.model = model
         self.tx = optimizer
         self.strategy = strategy or MultiWorkerMirroredStrategy()
@@ -131,6 +142,13 @@ class Estimator:
         self.loss_fn = loss_fn
         self.eval_fn = eval_fn
         self.grad_accum = grad_accum
+        if (lora is None) != (lora_base_params is None):
+            raise ValueError(
+                "lora and lora_base_params come together: the LoraConfig "
+                "says what to adapt, the base params are what stays frozen"
+            )
+        self.lora = lora
+        self._lora_base = lora_base_params
         self.config = config or RunConfig()
         self._state: Optional[TrainState] = None
         self._ckpt: Optional[CheckpointManager] = None
@@ -171,9 +189,36 @@ class Estimator:
             # order) — the init contract for custom batch structures
             leaf = jax.tree_util.tree_leaves(sample_batch)[0]
             sample = jnp.zeros(np.asarray(leaf).shape, np.asarray(leaf).dtype)
-            self._state, _ = init_state(
-                self.model, self.tx, self.strategy, sample, seed=self.config.seed
-            )
+            if self.lora is not None:
+                from tfde_tpu.training.lora import init_lora_state
+
+                # BatchNorm models carry mutable batch_stats the adapter
+                # state doesn't hold — refuse loudly rather than crash
+                # with a missing-collection error inside the jitted step
+                abstract = jax.eval_shape(
+                    self.model.init, jax.random.key(0), sample
+                )
+                if abstract.get("batch_stats"):
+                    raise NotImplementedError(
+                        "LoRA through the Estimator does not support "
+                        "BatchNorm models yet (the frozen base's "
+                        "batch_stats would need to thread through the "
+                        "adapter state); fine-tune a norm-free model or "
+                        "use the full-training path"
+                    )
+                self._lora_base = jax.device_put(
+                    self._lora_base,
+                    self.strategy.params_sharding(self._lora_base),
+                )
+                self._state, _ = init_lora_state(
+                    self.model, self.tx, self.strategy, self._lora_base,
+                    self.lora, seed=self.config.seed,
+                )
+            else:
+                self._state, _ = init_state(
+                    self.model, self.tx, self.strategy, sample,
+                    seed=self.config.seed,
+                )
             self._from_checkpoint = False
             mngr = self._ckpt_mngr()
             if mngr is not None:
@@ -182,6 +227,19 @@ class Estimator:
                     self._state = restored  # resume-by-default (SURVEY.md §5)
                     self._from_checkpoint = True
         return self._state
+
+    def _merged(self, state: TrainState) -> TrainState:
+        """For evaluate/predict/export under LoRA: a base-shaped state with
+        the adapters folded in (training/lora.merge_lora) — downstream
+        paths (eval steps, serving signature, exporters) see a plain
+        model. No-op otherwise."""
+        if self.lora is None:
+            return state
+        from tfde_tpu.training.lora import merge_lora
+
+        return state.replace(
+            params=merge_lora(self._lora_base, state.params, self.lora)
+        )
 
     def _state_for_inference(self, input_fn, what: str) -> TrainState:
         """State for evaluate/predict/export: live if this process trained,
@@ -219,7 +277,18 @@ class Estimator:
             log.info("global step %d >= max_steps %d; nothing to do", start_step, max_steps)
             return state
         if self._train_step is None:
-            if self.loss_fn is not None:
+            if self.lora is not None:
+                from tfde_tpu.training.lora import make_lora_loss
+                from tfde_tpu.training.step import _classification_loss
+
+                self._train_step = make_custom_train_step(
+                    self.strategy, state,
+                    make_lora_loss(self._lora_base,
+                                   self.loss_fn or _classification_loss,
+                                   self.lora),
+                    grad_accum=self.grad_accum,
+                )
+            elif self.loss_fn is not None:
                 self._train_step = make_custom_train_step(
                     self.strategy, state, self.loss_fn,
                     grad_accum=self.grad_accum,
@@ -298,7 +367,7 @@ class Estimator:
                 "a deterministic eval — pass eval_fn=(state, params, batch) "
                 "-> {metric: batch mean}"
             )
-        state = self._state_for_inference(input_fn, "evaluate()")
+        state = self._merged(self._state_for_inference(input_fn, "evaluate()"))
         strat = self.eval_strategy or self.strategy
         if self.eval_strategy is not None:
             # eval_distribute: re-lay the state out per the eval strategy
@@ -413,7 +482,7 @@ class Estimator:
     # -- predict -------------------------------------------------------------
     def predict(self, input_fn: Callable[[], Iterable]):
         """Yield per-batch softmax probabilities (serving signature §3.4)."""
-        state = self._state_for_inference(input_fn, "predict()")
+        state = self._merged(self._state_for_inference(input_fn, "predict()"))
 
         variables = {"params": state.params}
         if state.batch_stats:
@@ -444,6 +513,7 @@ class Estimator:
             state = self._state
         if not self._is_chief or self.config.model_dir is None:
             return None
+        state = self._merged(state)
         variables = {"params": state.params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
