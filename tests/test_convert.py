@@ -161,6 +161,67 @@ def test_gemma_converted_generates_like_hf(hf_gemma, rng):
     np.testing.assert_array_equal(np.asarray(ours), ref)
 
 
+@pytest.fixture(scope="module")
+def hf_qwen2():
+    cfg = transformers.Qwen2Config(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, attention_dropout=0.0,
+        tie_word_embeddings=False, use_sliding_window=False,
+    )
+    torch.manual_seed(4)
+    m = transformers.Qwen2ForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_qwen2_logits_match(hf_qwen2, rng):
+    """Qwen2 = LLaMA shape + biased q/k/v beside bias-free out/MLP
+    (GPT(qkv_bias=True)) — converted logits must match transformers."""
+    from tfde_tpu.models.convert import qwen2_from_hf
+
+    model, params = qwen2_from_hf(hf_qwen2, dtype=jnp.float32)
+    assert model.qkv_bias and not model.use_bias
+    attn = params["decoder"]["block_0"]["attn"]
+    assert attn["query"]["bias"].shape == (4, 8)
+    assert "bias" not in attn["out"]
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_qwen2(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_converted_generates_like_hf(hf_qwen2, rng):
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import qwen2_from_hf
+
+    model, params = qwen2_from_hf(hf_qwen2, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_qwen2.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_qwen2_sliding_window_refused():
+    from tfde_tpu.models.convert import qwen2_from_hf
+
+    cfg = transformers.Qwen2Config(
+        vocab_size=51, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        max_position_embeddings=32, use_sliding_window=True,
+        sliding_window=16, max_window_layers=1,
+    )
+    torch.manual_seed(0)
+    m = transformers.Qwen2ForCausalLM(cfg)
+    with pytest.raises(NotImplementedError, match="use_sliding_window"):
+        qwen2_from_hf(m, dtype=jnp.float32)
+
+
 def test_llama_logits_match(hf_llama, rng):
     """LLaMA = RoPE + GQA + RMSNorm + SwiGLU + bias-free + untied head —
     one converted forward checks all five against transformers."""
@@ -187,16 +248,18 @@ def test_llama_converted_generates_like_hf(hf_llama, rng):
     np.testing.assert_array_equal(np.asarray(ours), ref)
 
 
-def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma):
+def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma,
+                                  hf_qwen2):
     """Converted trees must match the models' own init structure exactly —
     a missing/extra leaf means a silently unconverted weight."""
-    from tfde_tpu.models.convert import gemma_from_hf
+    from tfde_tpu.models.convert import gemma_from_hf, qwen2_from_hf
 
     for hf, conv, sample in (
         (hf_gpt2, gpt2_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_bert, bert_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_llama, llama_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_gemma, gemma_from_hf, jnp.zeros((1, 8), jnp.int32)),
+        (hf_qwen2, qwen2_from_hf, jnp.zeros((1, 8), jnp.int32)),
     ):
         model, params = conv(hf, dtype=jnp.float32)
         ref = model.init(jax.random.key(0), sample)["params"]

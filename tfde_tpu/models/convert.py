@@ -254,6 +254,44 @@ def gemma_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def qwen2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers Qwen2ForCausalLM.
+
+    Qwen2 is the LLaMA architecture with biased q/k/v projections beside
+    a bias-free out projection and MLP (`GPT(qkv_bias=True)`); the HF
+    modeling code hardcodes those biases, so the weight mapping delegates
+    to `llama_from_hf` and this function adds the three bias tensors per
+    layer. Sliding-window Qwen2 configs interleave windowed and full
+    layers (`layer_types`), which the single-window GPT cannot express —
+    refused loudly; every mainline release ships use_sliding_window=False.
+    """
+    cfg = hf_model.config
+    if bool(getattr(cfg, "use_sliding_window", False)):
+        raise NotImplementedError(
+            "use_sliding_window=True interleaves windowed and full "
+            "attention per layer (max_window_layers), which the "
+            "single-window model cannot express; mainline Qwen2 releases "
+            "ship with it disabled"
+        )
+    model, params = llama_from_hf(hf_model, dtype=dtype)
+    model = model.clone(qkv_bias=True)
+    heads = cfg.num_attention_heads
+    hd = getattr(cfg, "head_dim", None) or cfg.hidden_size // heads
+    kv = cfg.num_key_value_heads
+    # pull ONLY the bias tensors — llama_from_hf already materialized the
+    # full state dict once; a second full-checkpoint fp32 copy to read
+    # O(layers * 3 * width) floats would double peak host memory at 7B
+    sd = hf_model.state_dict()
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}layers.{i}.self_attn."
+        attn = params["decoder"][f"block_{i}"]["attn"]
+        attn["query"]["bias"] = _np(sd[h + "q_proj.bias"]).reshape(heads, hd)
+        attn["key"]["bias"] = _np(sd[h + "k_proj.bias"]).reshape(kv, hd)
+        attn["value"]["bias"] = _np(sd[h + "v_proj.bias"]).reshape(kv, hd)
+    return model, params
+
+
 def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(Bert, params) from a transformers BertForMaskedLM (or BertModel —
     then the MLM head params initialize to the identity transform)."""
@@ -372,6 +410,7 @@ _FAMILIES = {
     "llama": ("LlamaForCausalLM", "llama_from_hf"),
     "mistral": ("MistralForCausalLM", "mistral_from_hf"),
     "gemma": ("GemmaForCausalLM", "gemma_from_hf"),
+    "qwen2": ("Qwen2ForCausalLM", "qwen2_from_hf"),
 }
 
 
@@ -403,7 +442,7 @@ def load_converted(artifact_dir: str, dtype=None):
     from tfde_tpu.models.gpt import GPT
 
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
-           "bert": Bert}[family]
+           "qwen2": GPT, "bert": Bert}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
         z = np.load(io.BytesIO(f.read()))

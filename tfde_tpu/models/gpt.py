@@ -57,6 +57,8 @@ class GPT(nn.Module):
     norm: str = "layer"      # 'layer' | 'rms' (LLaMA)
     mlp_act: str = "gelu"    # 'gelu' | 'swiglu' (LLaMA) | 'geglu' (Gemma)
     use_bias: bool = True    # False: LLaMA bias-free projections
+    # Qwen2: biased q/k/v projections beside bias-free out/MLP
+    qkv_bias: bool = False
     # token embeddings are multiplied by this after lookup (Gemma:
     # sqrt(hidden_size)); None = no scaling (every other family)
     embed_scale: Optional[float] = None
@@ -150,6 +152,7 @@ class GPT(nn.Module):
             norm=self.norm,
             mlp_act=self.mlp_act,
             use_bias=self.use_bias,
+            qkv_bias=self.qkv_bias,
             ln_eps=self.ln_eps,
             remat=self.remat,
             num_experts=self.num_experts,

@@ -75,6 +75,9 @@ class MultiHeadAttention(nn.Module):
     # the same factor — the reason every modern serving stack uses GQA.
     num_kv_heads: Optional[int] = None
     use_bias: bool = True  # False: the LLaMA bias-free projections
+    # True: bias on q/k/v (and fused qkv) even when use_bias=False — the
+    # Qwen2 arrangement (qkv biased, out projection and MLP bias-free)
+    qkv_bias: bool = False
     # one [embed, 3, heads, head_dim] projection instead of three
     # [embed, heads, head_dim] GEMMs: a 3x-wider matmul keeps the MXU
     # busier at small per-chip batch (the training MFU knob). Parameter
@@ -125,6 +128,7 @@ class MultiHeadAttention(nn.Module):
                 param_dtype=jnp.float32,
                 use_bias=self.use_bias,
             )
+        in_bias = self.use_bias or self.qkv_bias
         if self.fused_qkv:
             if self.kv_heads != self.num_heads:
                 raise NotImplementedError(
@@ -133,15 +137,17 @@ class MultiHeadAttention(nn.Module):
                     "cannot stack into one kernel"
                 )
             qkv = proj(
-                features=(3, self.num_heads, self.head_dim), name="qkv"
+                features=(3, self.num_heads, self.head_dim), name="qkv",
+                use_bias=in_bias,
             )(x)  # [B, S, 3, H, D] from ONE GEMM
             q, k, v = (qkv[..., i, :, :] for i in range(3))
         else:
             q = proj(features=(self.num_heads, self.head_dim),
-                     name="query")(x)
-            k = proj(features=(self.kv_heads, self.head_dim), name="key")(x)
+                     name="query", use_bias=in_bias)(x)
+            k = proj(features=(self.kv_heads, self.head_dim), name="key",
+                     use_bias=in_bias)(x)
             v = proj(features=(self.kv_heads, self.head_dim),
-                     name="value")(x)
+                     name="value", use_bias=in_bias)(x)
         if self.rope and not self.decode:
             q, k = self._rotate(q, k, jnp.zeros((), jnp.int32))
         # [B, S, H, D]: heads carry the tensor-parallel shard.
@@ -353,6 +359,7 @@ class TransformerBlock(nn.Module):
     norm: str = "layer"  # 'layer' | 'rms' (LLaMA: scale-only, no bias)
     mlp_act: str = "gelu"  # Mlp.act
     use_bias: bool = True
+    qkv_bias: bool = False  # Qwen2: biased q/k/v beside bias-free out/MLP
     ln_eps: float = 1e-6  # checkpoint fidelity: GPT-2 1e-5, BERT 1e-12
     num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
     experts_per_token: int = 2
@@ -385,6 +392,7 @@ class TransformerBlock(nn.Module):
             quant=self.quant,
             window=self.window,
             use_bias=self.use_bias,
+            qkv_bias=self.qkv_bias,
             name="attn",
         )
         if self.num_experts > 0:
@@ -473,6 +481,7 @@ class Encoder(nn.Module):
     norm: str = "layer"
     mlp_act: str = "gelu"
     use_bias: bool = True
+    qkv_bias: bool = False
     ln_eps: float = 1e-6
     remat: Any = False
     num_experts: int = 0   # > 0: MoE MLP in every `moe_every`-th block
@@ -523,6 +532,7 @@ class Encoder(nn.Module):
                 norm=self.norm,
                 mlp_act=self.mlp_act,
                 use_bias=self.use_bias,
+                qkv_bias=self.qkv_bias,
                 ln_eps=self.ln_eps,
                 num_experts=self.num_experts if is_moe else 0,
                 experts_per_token=self.experts_per_token,

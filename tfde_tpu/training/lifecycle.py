@@ -674,6 +674,11 @@ def _train_with_continuous_eval(
         config=cfg,
         loss_fn=estimator.loss_fn,
         eval_fn=estimator.eval_fn,
+        # LoRA: the trainer checkpoints adapters-only state — the evaluator
+        # must build the same adapter template to restore it, and merge
+        # before evaluating
+        lora=estimator.lora,
+        lora_base_params=estimator._lora_base,
     )
     stop = threading.Event()
     box: dict = {}
