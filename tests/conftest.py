@@ -6,7 +6,21 @@ the axon site shim imports jax at interpreter start, so we override via
 jax.config (backend creation is lazy) rather than env vars.
 """
 
-import jax
+import os
+
+# XLA's in-process CPU collective rendezvous SIGABRTs the whole pytest
+# process when the box is oversubscribed (8 virtual devices on 1-2 cores
+# under a loaded CI: "Expected 8 threads to join ... only N arrived").
+# Raise the warn/terminate timeouts well past any scheduler hiccup; the
+# backend is created lazily, so setting the env here (before first device
+# use) takes effect, and subprocess-isolated tests inherit it.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
+)
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)

@@ -81,6 +81,35 @@ def test_lora_estimator_lifecycle(tmp_path):
     est2.close()
 
 
+def test_lora_continuous_eval_from_checkpoint(tmp_path):
+    """LoRA + eval_mode='from_checkpoint': the background evaluator must
+    build the same adapters-only state template to restore the trainer's
+    tiny checkpoints, and evaluate MERGED params — the regression case
+    for the evaluator inheriting lora/lora_base_params."""
+    from tfde_tpu.training.lora import LoraConfig
+
+    model = gpt_tiny_test()
+    base = model.init(jax.random.key(5), jnp.zeros((2, 8), jnp.int32),
+                      train=False)["params"]
+    cfg = RunConfig(model_dir=str(tmp_path), save_checkpoints_steps=5,
+                    save_summary_steps=100)
+    est = Estimator(model, optax.adamw(5e-3), config=cfg,
+                    loss_fn=next_token_loss, eval_fn=lm_eval_fn,
+                    lora=LoraConfig(rank=4), lora_base_params=base)
+    from tfde_tpu.training.lifecycle import train_and_evaluate
+
+    state, metrics = train_and_evaluate(
+        est,
+        TrainSpec(_token_input_fn(0), max_steps=15),
+        EvalSpec(_token_input_fn(1, repeat=1), start_delay_secs=0,
+                 throttle_secs=0.2),
+        eval_mode="from_checkpoint",
+    )
+    est.close()
+    assert int(jax.device_get(state.step)) == 15
+    assert np.isfinite(metrics["loss"])
+
+
 def test_lm_estimator_lifecycle_and_resume(tmp_path):
     cfg = RunConfig(model_dir=str(tmp_path), save_summary_steps=5,
                     save_checkpoints_steps=10, log_step_count_steps=10)
