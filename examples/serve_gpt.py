@@ -55,6 +55,17 @@ def main(argv=None):
     parser.add_argument("--hf-dir", type=str, default=None,
                         help="load GPT-2 weights converted by "
                              "`python -m tfde_tpu.models.convert`")
+    parser.add_argument("--tokenizer", type=str, default=None,
+                        metavar="DIR",
+                        help="local save_pretrained() tokenizer directory "
+                             "(offline, transformers.AutoTokenizer): serve "
+                             "--prompt TEXT requests and print decoded "
+                             "text instead of token ids")
+    parser.add_argument("--prompt", action="append", default=None,
+                        metavar="TEXT",
+                        help="with --tokenizer: a text prompt to serve "
+                             "(repeatable); replaces the synthetic "
+                             "random-token requests")
     parser.add_argument("--tiny", action="store_true")
     parser.add_argument("--fake-devices", type=int, default=None)
     args, _ = parser.parse_known_args(argv)
@@ -111,22 +122,54 @@ def main(argv=None):
             model, params, batch_size=args.batch_size, max_len=args.max_len,
             temperature=args.temperature, eos_id=args.eos_id,
         )
+    tok = None
+    if args.tokenizer:
+        # offline by construction, like the conversion CLI: a local
+        # save_pretrained() directory, nothing downloaded
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(args.tokenizer,
+                                            local_files_only=True)
+    if args.prompt and tok is None:
+        raise SystemExit("--prompt TEXT needs --tokenizer DIR to encode it")
+
     rng = np.random.default_rng(0)
     lengths = {}
-    for _ in range(args.requests):
-        plen = int(rng.integers(2, 9))
-        rid = srv.submit(
-            rng.integers(0, model.vocab_size, plen), args.max_new_tokens
-        )
-        lengths[rid] = plen
+    prompts = {}
+    if args.prompt:
+        for text in args.prompt:
+            ids = np.asarray(tok(text)["input_ids"], np.int32)
+            if ids.size and int(ids.max()) >= model.vocab_size:
+                # the embedding gather clamps inside jit — garbage output
+                # with no error; refuse a mismatched tokenizer loudly
+                raise SystemExit(
+                    f"tokenizer id {int(ids.max())} >= model vocab "
+                    f"{model.vocab_size}: this tokenizer does not belong "
+                    f"to the served model"
+                )
+            rid = srv.submit(ids, args.max_new_tokens)
+            lengths[rid] = len(ids)
+            prompts[rid] = text
+    else:
+        for _ in range(args.requests):
+            plen = int(rng.integers(2, 9))
+            rid = srv.submit(
+                rng.integers(0, model.vocab_size, plen), args.max_new_tokens
+            )
+            lengths[rid] = plen
 
     t0 = time.time()
     done = srv.run()
     dt = time.time() - t0
     total = sum(len(toks) for _, toks in done)
     for rid, toks in done:
-        log.info("req %d: prompt %d -> %d tokens", rid, lengths[rid],
-                 len(toks))
+        if tok is not None and rid in prompts:
+            log.info("req %d (%d prompt tokens): %r -> %r", rid,
+                     lengths[rid], prompts[rid],
+                     tok.decode(np.asarray(toks).tolist()))
+        else:
+            log.info("req %d: prompt %d -> %d tokens", rid, lengths[rid],
+                     len(toks))
     log.info("served %d requests / %d tokens in %.2fs (%.1f tok/s, "
              "batch %d)", len(done), total, dt, total / max(dt, 1e-9),
              args.batch_size)

@@ -104,6 +104,34 @@ def test_lora_finetune_example():
         assert np.isfinite(np.asarray(mb)).all()
 
 
+def test_serve_gpt_text_requests(tmp_path):
+    """--tokenizer + --prompt: text requests ride the continuous batcher
+    end to end — encoded offline, decoded back to text. The tokenizer is
+    built programmatically (hermetic; nothing downloaded)."""
+    pytest.importorskip("tokenizers")
+    transformers = pytest.importorskip("transformers")
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    PreTrainedTokenizerFast = transformers.PreTrainedTokenizerFast
+
+    from examples import serve_gpt
+
+    vocab = {w: i for i, w in enumerate(
+        ["[UNK]", "the", "cat", "sat", "on", "mat"]
+    )}
+    t = Tokenizer(models.WordLevel(vocab, unk_token="[UNK]"))
+    t.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok = PreTrainedTokenizerFast(tokenizer_object=t, unk_token="[UNK]")
+    tok.save_pretrained(str(tmp_path))
+
+    done = serve_gpt.main(
+        ["--tiny", "--tokenizer", str(tmp_path),
+         "--prompt", "the cat sat", "--prompt", "on the mat",
+         "--max-new-tokens", "4", "--batch-size", "2", "--max-len", "32"]
+    )
+    assert len(done) == 2 and all(len(toks) for _, toks in done)
+
+
 def test_serve_gpt_example():
     """The continuous-batching serving demo drains its queue with every
     request completed at full budget."""
