@@ -139,7 +139,12 @@ def main(argv=None):
     if args.prompt:
         for text in args.prompt:
             ids = np.asarray(tok(text)["input_ids"], np.int32)
-            if ids.size and int(ids.max()) >= model.vocab_size:
+            if not ids.size:
+                raise SystemExit(
+                    f"prompt {text!r} tokenized to zero ids — nothing to "
+                    f"serve"
+                )
+            if int(ids.max()) >= model.vocab_size:
                 # the embedding gather clamps inside jit — garbage output
                 # with no error; refuse a mismatched tokenizer loudly
                 raise SystemExit(

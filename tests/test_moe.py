@@ -30,6 +30,39 @@ def test_moe_output_shape_and_aux_loss(rng):
     assert 0.0 < float(aux[0]) < 1.0
 
 
+def test_moe_router_z_loss(rng):
+    """ST-MoE z-loss: off by default (one sown loss — the numerics every
+    existing test pins); when enabled, a second sown loss appears, equal
+    to weight * mean(logsumexp(router logits)^2), and scaling the router
+    weights up increases it (the drift it exists to penalize)."""
+    x = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+    m0 = MoEMlp(num_experts=4, mlp_dim=32, dtype=jnp.float32)
+    v = m0.init(jax.random.key(0), x)
+    _, mut = m0.apply({"params": v["params"]}, x, mutable=["losses"])
+    assert len(jax.tree_util.tree_leaves(mut["losses"])) == 1  # off
+
+    mz = MoEMlp(num_experts=4, mlp_dim=32, dtype=jnp.float32,
+                router_z_loss_weight=1e-3)
+    _, mut = mz.apply({"params": v["params"]}, x, mutable=["losses"])
+    losses = mut["losses"]
+    assert "moe_z" in losses and "moe_aux" in losses
+    (z,) = jax.tree_util.tree_leaves(losses["moe_z"])
+    logits = x.reshape(2, 8, 16).astype(jnp.float32) @ np.asarray(
+        v["params"]["router"]["kernel"]
+    )
+    expect = 1e-3 * float(jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2))
+    np.testing.assert_allclose(float(z), expect, rtol=1e-5)
+
+    # bigger router logits -> bigger z penalty
+    import flax
+
+    v2 = flax.core.unfreeze(jax.tree_util.tree_map(lambda a: a, v["params"]))
+    v2["router"]["kernel"] = v2["router"]["kernel"] * 5.0
+    _, mut2 = mz.apply({"params": v2}, x, mutable=["losses"])
+    (z2,) = jax.tree_util.tree_leaves(mut2["losses"]["moe_z"])
+    assert float(z2) > float(z)
+
+
 def test_moe_full_capacity_top1_is_lossless_combine(rng):
     """With capacity >= all tokens and k=1, every token is processed by its
     top expert: output must equal the hand-computed per-expert MLP."""

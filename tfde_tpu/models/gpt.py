@@ -42,6 +42,7 @@ class GPT(nn.Module):
     # (models/moe.py) — train under ExpertParallelStrategy to shard experts
     num_experts: int = 0
     moe_every: int = 2
+    router_z_loss_weight: float = 0.0  # ST-MoE stabilizer (models/moe.py)
     # autoregressive serving mode (inference/decode.py): KV caches in the
     # "cache" collection; positions continue from the cached prefix
     decode: bool = False
@@ -157,6 +158,7 @@ class GPT(nn.Module):
             remat=self.remat,
             num_experts=self.num_experts,
             moe_every=self.moe_every,
+            router_z_loss_weight=self.router_z_loss_weight,
             name="decoder",
         )(x, train=train)
         if self.tie_embeddings:

@@ -77,6 +77,10 @@ class MoEMlp(nn.Module):
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    # router z-loss (ST-MoE): penalizes mean(logsumexp(router logits)^2),
+    # keeping logit magnitudes bounded so fp32 routing stays stable over
+    # long runs. 0 = off (the Switch default); 1e-3 is the ST-MoE setting.
+    router_z_loss_weight: float = 0.0
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
     num_groups: Optional[int] = None
@@ -129,6 +133,10 @@ class MoEMlp(nn.Module):
         p = jnp.mean(probs, axis=(0, 1))
         aux = self.aux_loss_weight * e * jnp.sum(f * p)
         self.sow("losses", "moe_aux", aux)  # default tuple-append reduce
+        if self.router_z_loss_weight > 0.0:
+            z = jax.nn.logsumexp(logits, axis=-1)  # [g, m]
+            self.sow("losses", "moe_z",
+                     self.router_z_loss_weight * jnp.mean(z * z))
 
         w1 = self.param(
             "experts_fc1",

@@ -363,6 +363,7 @@ class TransformerBlock(nn.Module):
     ln_eps: float = 1e-6  # checkpoint fidelity: GPT-2 1e-5, BERT 1e-12
     num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
     experts_per_token: int = 2
+    router_z_loss_weight: float = 0.0  # ST-MoE stabilizer (models/moe.py)
 
     @nn.compact
     def __call__(
@@ -413,6 +414,7 @@ class TransformerBlock(nn.Module):
                 num_experts=self.num_experts,
                 mlp_dim=self.mlp_dim,
                 experts_per_token=self.experts_per_token,
+                router_z_loss_weight=self.router_z_loss_weight,
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
                 name="moe",
@@ -486,6 +488,7 @@ class Encoder(nn.Module):
     remat: Any = False
     num_experts: int = 0   # > 0: MoE MLP in every `moe_every`-th block
     experts_per_token: int = 2
+    router_z_loss_weight: float = 0.0
     moe_every: int = 2     # GShard convention: alternate dense / MoE
 
     @nn.compact
@@ -536,6 +539,7 @@ class Encoder(nn.Module):
                 ln_eps=self.ln_eps,
                 num_experts=self.num_experts if is_moe else 0,
                 experts_per_token=self.experts_per_token,
+                router_z_loss_weight=self.router_z_loss_weight,
                 name=f"block_{i}",
             )
             x = body(block, x)
