@@ -71,6 +71,46 @@ class BertEmbeddings(nn.Module):
         return self.dropout(x, deterministic=not train)
 
 
+def _trunk(m, input_ids, attention_mask, token_type_ids, train):
+    """Shared embeddings+encoder trunk of `Bert` and `BertClassifier` —
+    ONE construction site, so the param-tree names/shapes the weight-graft
+    and HF-conversion paths depend on cannot diverge between the two
+    heads. `m` is either module (identical trunk fields by construction).
+    Returns (hidden states [B, S, H], the embeddings module for head
+    weight-tying)."""
+    b = batch_axes()
+    emb = BertEmbeddings(
+        vocab_size=m.padded_vocab,
+        hidden_size=m.hidden_size,
+        max_position=m.max_position,
+        type_vocab_size=m.type_vocab_size,
+        dropout_rate=m.dropout_rate,
+        dtype=m.dtype,
+        ln_eps=m.ln_eps,
+        name="embeddings",
+    )
+    x = emb(input_ids, token_type_ids, train=train)
+    x = constrain(x, b, "seq")
+    mask = None
+    if attention_mask is not None:
+        mask = padding_mask(attention_mask)
+    x = Encoder(
+        depth=m.depth,
+        num_heads=m.num_heads,
+        head_dim=m.hidden_size // m.num_heads,
+        mlp_dim=m.mlp_dim,
+        dtype=m.dtype,
+        dropout_rate=m.dropout_rate,
+        attn_impl=m.attn_impl,
+        fused_qkv=m.fused_qkv,
+        norm_style="post",
+        ln_eps=m.ln_eps,
+        remat=m.remat,
+        name="encoder",
+    )(x, mask=mask, train=train)
+    return x, emb
+
+
 class Bert(nn.Module):
     """BERT encoder with tied masked-LM head over [B, S] int token ids."""
 
@@ -105,35 +145,8 @@ class Bert(nn.Module):
     ) -> jax.Array:
         """Returns MLM logits [B, S, vocab] (fp32)."""
         b = batch_axes()
-        emb = BertEmbeddings(
-            vocab_size=self.padded_vocab,
-            hidden_size=self.hidden_size,
-            max_position=self.max_position,
-            type_vocab_size=self.type_vocab_size,
-            dropout_rate=self.dropout_rate,
-            dtype=self.dtype,
-            ln_eps=self.ln_eps,
-            name="embeddings",
-        )
-        x = emb(input_ids, token_type_ids, train=train)
-        x = constrain(x, b, "seq")
-        mask = None
-        if attention_mask is not None:
-            mask = padding_mask(attention_mask)
-        x = Encoder(
-            depth=self.depth,
-            num_heads=self.num_heads,
-            head_dim=self.hidden_size // self.num_heads,
-            mlp_dim=self.mlp_dim,
-            dtype=self.dtype,
-            dropout_rate=self.dropout_rate,
-            attn_impl=self.attn_impl,
-            fused_qkv=self.fused_qkv,
-            norm_style="post",
-            ln_eps=self.ln_eps,
-            remat=self.remat,
-            name="encoder",
-        )(x, mask=mask, train=train)
+        x, emb = _trunk(self, input_ids, attention_mask, token_type_ids,
+                        train)
 
         # MLM transform head (dense + gelu + LN), then tied decoder.
         h = nn.Dense(
@@ -151,6 +164,88 @@ class Bert(nn.Module):
         )
         logits = logits.astype(jnp.float32) + bias
         return constrain(logits, b, "seq", "tensor")
+
+
+class BertClassifier(nn.Module):
+    """BERT encoder + pooler + sequence-classification head — the
+    fine-tuning workflow every BERT deployment actually runs (GLUE-style:
+    pretrain MLM, classify on [CLS]).
+
+    The embeddings/encoder submodules carry the SAME names and shapes as
+    `Bert`'s, so MLM-pretrained params (or an HF conversion,
+    models/convert.py bert_from_hf) transfer directly —
+    `classifier_params_from_mlm` grafts them under freshly initialized
+    pooler/classifier heads. Pooler = tanh(Dense(hidden)) on the [CLS]
+    position, the original BERT arrangement; logits are fp32.
+    """
+
+    num_labels: int
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "auto"
+    remat: Any = False
+    fused_qkv: bool = False
+    pad_vocab: bool = False
+    ln_eps: float = 1e-6
+
+    @property
+    def padded_vocab(self) -> int:
+        if not self.pad_vocab:
+            return self.vocab_size
+        return -(-self.vocab_size // 128) * 128
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        token_type_ids: Optional[jax.Array] = None,
+        train: bool = False,
+    ) -> jax.Array:
+        """Returns classification logits [B, num_labels] (fp32)."""
+        b = batch_axes()
+        x, _ = _trunk(self, input_ids, attention_mask, token_type_ids,
+                      train)
+
+        pooled = jnp.tanh(
+            nn.Dense(
+                self.hidden_size, dtype=self.dtype, param_dtype=jnp.float32,
+                name="pooler",
+            )(x[:, 0])
+        )
+        if self.dropout_rate > 0.0:
+            pooled = nn.Dropout(
+                self.dropout_rate, deterministic=not train
+            )(pooled)
+        logits = nn.Dense(
+            self.num_labels, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="classifier",
+        )(pooled.astype(jnp.float32))
+        return constrain(logits, b)
+
+
+def classifier_params_from_mlm(classifier: BertClassifier, mlm_params,
+                               rng, sample_ids) -> dict:
+    """Classifier params with the embeddings/encoder grafted from an
+    MLM-pretrained `Bert` tree (models/convert.py bert_from_hf output or a
+    Bert training run); the pooler/classifier heads keep their fresh
+    initialization — the standard fine-tuning starting point."""
+    params = dict(classifier.init(rng, sample_ids, train=False)["params"])
+    for k in ("embeddings", "encoder"):
+        if k not in mlm_params:
+            raise ValueError(
+                f"MLM params carry no {k!r} subtree — pass a Bert (or "
+                f"bert_from_hf) param tree"
+            )
+        params[k] = mlm_params[k]
+    return params
 
 
 BertBase = functools.partial(

@@ -400,6 +400,42 @@ def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def bert_classifier_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(BertClassifier, params) from a transformers
+    BertForSequenceClassification — the fine-tuned-classifier import path.
+    Delegates the encoder mapping to `bert_from_hf` (identical layout under
+    the 'bert.' prefix) and adds the pooler + classification head."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.bert import BertClassifier
+
+    cfg = hf_model.config
+    _, mlm_params = bert_from_hf(hf_model, dtype=dtype)
+    model = BertClassifier(
+        num_labels=cfg.num_labels,
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        depth=cfg.num_hidden_layers,
+        num_heads=cfg.num_attention_heads,
+        mlp_dim=cfg.intermediate_size,
+        max_position=cfg.max_position_embeddings,
+        dropout_rate=0.0,
+        pad_vocab=False,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        ln_eps=cfg.layer_norm_eps,
+    )
+    sd = hf_model.state_dict()
+    params = {
+        "embeddings": mlm_params["embeddings"],
+        "encoder": mlm_params["encoder"],
+        "pooler": {"kernel": _np(sd["bert.pooler.dense.weight"]).T,
+                   "bias": _np(sd["bert.pooler.dense.bias"])},
+        "classifier": {"kernel": _np(sd["classifier.weight"]).T,
+                       "bias": _np(sd["classifier.bias"])},
+    }
+    return model, params
+
+
 # --------------------------------------------------------------------------
 # CLI: python -m tfde_tpu.models.convert <family> <hf_path> <out_dir>
 # --------------------------------------------------------------------------
@@ -411,6 +447,8 @@ _FAMILIES = {
     "mistral": ("MistralForCausalLM", "mistral_from_hf"),
     "gemma": ("GemmaForCausalLM", "gemma_from_hf"),
     "qwen2": ("Qwen2ForCausalLM", "qwen2_from_hf"),
+    "bert-classifier": ("BertForSequenceClassification",
+                        "bert_classifier_from_hf"),
 }
 
 
@@ -438,11 +476,12 @@ def load_converted(artifact_dir: str, dtype=None):
     kwargs = dict(conf)
     kwargs["dtype"] = jnp.dtype(dtype if dtype is not None else recorded)
 
-    from tfde_tpu.models.bert import Bert
+    from tfde_tpu.models.bert import Bert, BertClassifier
     from tfde_tpu.models.gpt import GPT
 
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
-           "qwen2": GPT, "bert": Bert}[family]
+           "qwen2": GPT, "bert": Bert,
+           "bert-classifier": BertClassifier}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
         z = np.load(io.BytesIO(f.read()))
