@@ -15,9 +15,11 @@ TPU-native decode loop:
 - **KV cache in the flax "cache" collection** (cached_key/cached_value/
   cache_index per attention layer + the model's position_index), threaded
   through the scan as ordinary carry state.
-- **Sampling on device**: greedy (temperature=0), temperature, top-k
-  (`lax.top_k` threshold), nucleus/top-p (sort + exclusive-cumsum mask) —
-  composed in that order, then `jax.random.categorical`.
+- **Sampling on device**: repetition penalty first (CTRL rule over a
+  [B, V] presence mask carried through the scan), then greedy
+  (temperature=0) or temperature, top-k (`lax.top_k` threshold) and
+  nucleus/top-p (sort + exclusive-cumsum mask) — composed in that order,
+  then `jax.random.categorical`.
 - **EOS with static shapes**: generation always runs the full
   `max_new_tokens` scan; finished rows emit `pad_id` and stop changing. The
   returned `lengths` tells the caller where each row actually ended. (A
@@ -120,12 +122,30 @@ def sample_logits(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    repetition_penalty: float = 1.0,
+    seen: Optional[jax.Array] = None,
 ) -> jax.Array:
     """[B, V] logits -> [B] sampled token ids. temperature=0 is greedy
-    (argmax); top_k and top_p filters compose (k first, then nucleus)."""
+    (argmax); top_k and top_p filters compose (k first, then nucleus).
+
+    repetition_penalty > 1 with `seen` (a [B, V] bool presence mask of
+    already-emitted ids) applies the CTRL/HF rule before any other
+    processing — positive logits of seen tokens divide by the penalty,
+    negative ones multiply — discouraging loops for greedy and sampled
+    decoding alike."""
+    if repetition_penalty <= 0.0:
+        raise ValueError(
+            f"repetition_penalty must be > 0 (1.0 = off), got "
+            f"{repetition_penalty} — 0 would divide seen logits to inf"
+        )
+    logits = logits.astype(jnp.float32)
+    if repetition_penalty != 1.0 and seen is not None:
+        penalized = jnp.where(logits > 0, logits / repetition_penalty,
+                              logits * repetition_penalty)
+        logits = jnp.where(seen, penalized, logits)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits.astype(jnp.float32) / temperature
+    logits = logits / temperature
     neg = jnp.finfo(jnp.float32).min
     if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
@@ -151,7 +171,7 @@ def sample_logits(
 @functools.partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "top_p", "eos_id", "pad_id"),
+                     "top_p", "eos_id", "pad_id", "repetition_penalty"),
 )
 def generate(
     model,
@@ -164,6 +184,7 @@ def generate(
     top_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
+    repetition_penalty: float = 1.0,
 ):
     """Generate `max_new_tokens` continuations of `prompt` [B, P] int32.
 
@@ -187,28 +208,44 @@ def generate(
     prompt = prompt.astype(jnp.int32)
     model_step = _make_model_step(decode_model, params)
     sample = functools.partial(sample_logits, temperature=temperature,
-                               top_k=top_k, top_p=top_p)
+                               top_k=top_k, top_p=top_p,
+                               repetition_penalty=repetition_penalty)
+    penalize = repetition_penalty != 1.0
+    # presence mask of everything emitted so far (prompt included, the HF
+    # convention); updated per step via a [B, V] scatter — only built when
+    # the penalty is on
+    vocab = model.vocab_size
+    seen = (
+        jnp.zeros((b, vocab), jnp.bool_).at[
+            jnp.arange(b)[:, None], prompt
+        ].set(True)
+        if penalize else None
+    )
 
     # prefill: the prompt in one fixed-shape forward
     cache, last_logits = model_step(cache, prompt)
     rng, sub = jax.random.split(rng)
-    tok = sample(last_logits, sub)
+    tok = sample(last_logits, sub, seen=seen)
+    if penalize:
+        seen = seen.at[jnp.arange(b), tok].set(True)
     done = jnp.zeros((b,), jnp.bool_)
     if eos_id is not None:
         done = tok == eos_id
 
     def step(carry, _):
-        cache, tok, rng, done = carry
+        cache, tok, rng, done, seen = carry
         cache, logits = model_step(cache, tok[:, None])
         rng, sub = jax.random.split(rng)
-        nxt = sample(logits, sub)
+        nxt = sample(logits, sub, seen=seen)
         if eos_id is not None:
             nxt = jnp.where(done, pad_id, nxt)
             done = done | (nxt == eos_id)
-        return (cache, nxt, rng, done), nxt
+        if penalize:
+            seen = seen.at[jnp.arange(b), nxt].set(True)
+        return (cache, nxt, rng, done, seen), nxt
 
-    (_, _, _, done), rest = jax.lax.scan(
-        step, (cache, tok, rng, done), length=max_new_tokens - 1
+    (_, _, _, done, _), rest = jax.lax.scan(
+        step, (cache, tok, rng, done, seen), length=max_new_tokens - 1
     )
     new_tokens = jnp.concatenate(
         [tok[:, None], jnp.moveaxis(rest, 0, 1)], axis=1

@@ -405,25 +405,22 @@ def bert_classifier_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     BertForSequenceClassification — the fine-tuned-classifier import path.
     Delegates the encoder mapping to `bert_from_hf` (identical layout under
     the 'bert.' prefix) and adds the pooler + classification head."""
-    import jax.numpy as jnp
+    import dataclasses
 
     from tfde_tpu.models.bert import BertClassifier
 
     cfg = hf_model.config
-    _, mlm_params = bert_from_hf(hf_model, dtype=dtype)
-    model = BertClassifier(
-        num_labels=cfg.num_labels,
-        vocab_size=cfg.vocab_size,
-        hidden_size=cfg.hidden_size,
-        depth=cfg.num_hidden_layers,
-        num_heads=cfg.num_attention_heads,
-        mlp_dim=cfg.intermediate_size,
-        max_position=cfg.max_position_embeddings,
-        dropout_rate=0.0,
-        pad_vocab=False,
-        dtype=dtype if dtype is not None else jnp.bfloat16,
-        ln_eps=cfg.layer_norm_eps,
-    )
+    bert, mlm_params = bert_from_hf(hf_model, dtype=dtype)
+    # one cfg->constructor mapping site: rebuild from the Bert that
+    # bert_from_hf returned, so the classifier config can never drift
+    # from the encoder params grafted below
+    shared = {
+        f.name: getattr(bert, f.name)
+        for f in dataclasses.fields(BertClassifier)
+        if f.name not in ("parent", "name", "num_labels")
+        and hasattr(bert, f.name)
+    }
+    model = BertClassifier(num_labels=cfg.num_labels, **shared)
     sd = hf_model.state_dict()
     params = {
         "embeddings": mlm_params["embeddings"],
