@@ -324,3 +324,54 @@ def test_profiler_window_validation():
     # disabled tuples pass through quietly
     p = StepWindowProfiler("/tmp/x", ("every", 0, 10))
     assert not p.enabled
+
+
+def test_best_exporter_gates_on_metric(tmp_path):
+    """BestExporter (tf.estimator.BestExporter analog): exports only when
+    the monitored metric improves; the bar persists in best_metric.json,
+    so a worse later eval leaves the artifact set unchanged."""
+    import json
+
+    from tfde_tpu.export.serving import BestExporter
+
+    train_fn, eval_fn = _input_fns()
+    cfg = RunConfig(model_dir=str(tmp_path / "run"),
+                    save_checkpoints_steps=100, save_summary_steps=100)
+    est = Estimator(PlainCNN(), optax.sgd(0.1), config=cfg)
+    best = BestExporter("best", (None, 784), metric="loss")
+    state, metrics = train_and_evaluate(
+        est,
+        TrainSpec(train_fn, max_steps=10),
+        EvalSpec(eval_fn, exporters=[best], start_delay_secs=0,
+                 throttle_secs=0.0),
+    )
+    export_dir = tmp_path / "run" / "export" / "best"
+    stamps = [d for d in os.listdir(export_dir) if d.isdigit()]
+    assert stamps, "an improving first eval must export"
+    bar = json.loads((export_dir / "best_metric.json").read_text())
+    assert bar["metric"] == "loss" and np.isfinite(bar["value"])
+    n_before = len(stamps)
+
+    # a fresh maybe_export with a WORSE metric must refuse
+    out = est.export_saved_model(
+        best, metrics={"loss": bar["value"] + 100.0}
+    )
+    assert out is None
+    stamps = [d for d in os.listdir(export_dir) if d.isdigit()]
+    assert len(stamps) == n_before
+    # and a better one exports again and moves the bar
+    out = est.export_saved_model(best, metrics={"loss": bar["value"] - 1.0})
+    assert out is not None
+    bar2 = json.loads((export_dir / "best_metric.json").read_text())
+    assert bar2["value"] == bar["value"] - 1.0
+    est.close()
+
+    # monitoring a nonexistent metric is loud
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="monitors"):
+        est2 = Estimator(PlainCNN(), optax.sgd(0.1), config=cfg)
+        est2.export_saved_model(
+            BestExporter("best2", (None, 784), metric="nope"),
+            metrics={"loss": 1.0},
+        )

@@ -4,4 +4,9 @@ from tfde_tpu.export.generative import (  # noqa: F401
     export_generate,
     load_generate,
 )
-from tfde_tpu.export.serving import export_serving, load_serving, FinalExporter  # noqa: F401
+from tfde_tpu.export.serving import (  # noqa: F401
+    BestExporter,
+    FinalExporter,
+    export_serving,
+    load_serving,
+)

@@ -79,6 +79,13 @@ def _write_artifact(directory: str, exported, host_vars, signature: dict) -> str
     params.npz + signature.json (export_serving and export_generate)."""
     stamp = datetime.datetime.now().strftime("%Y%m%d%H%M%S")
     out_dir = fs.join(directory, stamp)
+    # two exports in one wall-clock second (per-eval BestExporter cadence)
+    # must not overwrite each other in place — bump to the next free
+    # stamp; numeric ordering keeps "newest resolves last" intact
+    bump = 0
+    while fs.exists(out_dir):
+        bump += 1
+        out_dir = fs.join(directory, str(int(stamp) + bump))
     fs.makedirs(out_dir, exist_ok=True)
     with fs.fs_open(fs.join(out_dir, "model.stablehlo"), "wb") as f:
         f.write(exported.serialize())
@@ -222,4 +229,61 @@ class FinalExporter:
                 input_dtype=np.dtype(jnp.dtype(self.input_dtype).name),
                 apply_softmax=self.apply_softmax,
             )
+        return out
+
+
+class BestExporter(FinalExporter):
+    """Metric-gated exporter — the `tf.estimator.BestExporter` analog:
+    exports only when the monitored eval metric improves on the best seen
+    so far. The bar persists in `<export dir>/best_metric.json`, so a
+    resumed run keeps comparing against its own history. Runs after every
+    throttled eval in `train_and_evaluate` (inline mode) and once more at
+    the final eval; the timestamped layout matches FinalExporter, newest
+    == best."""
+
+    def __init__(
+        self,
+        name: str,
+        input_shape,
+        metric: str = "loss",
+        higher_is_better: bool = False,
+        **kw,
+    ):
+        super().__init__(name, input_shape, **kw)
+        self.metric = metric
+        self.higher_is_better = higher_is_better
+
+    def maybe_export(self, model_dir: str, apply_fn: Callable,
+                     variables: dict, metrics: dict):
+        """Export iff metrics[self.metric] beats the persisted best;
+        returns the artifact dir or None."""
+        import json
+
+        if self.metric not in metrics:
+            raise ValueError(
+                f"BestExporter({self.name!r}) monitors {self.metric!r} but "
+                f"the eval produced {sorted(metrics)} — set metric= to one "
+                f"of those"
+            )
+        val = float(metrics[self.metric])
+        if not np.isfinite(val):
+            # a NaN written as the bar would compare False against every
+            # future value, silently disabling the exporter for the run's
+            # lifetime — a diverged eval is never "best"
+            return None
+        bar_path = fs.join(model_dir, "export", self.name,
+                           "best_metric.json")
+        best = None
+        if fs.exists(bar_path):
+            with fs.fs_open(bar_path, "r") as f:
+                best = json.load(f)["value"]
+        improved = best is None or not np.isfinite(best) or (
+            val > best if self.higher_is_better else val < best
+        )
+        if not improved:
+            return None
+        out = self.export(model_dir, apply_fn, variables)
+        with fs.fs_open(bar_path, "w") as f:
+            json.dump({"metric": self.metric, "value": val,
+                       "artifact": out}, f)
         return out

@@ -502,9 +502,11 @@ class Estimator:
             yield np.asarray(jax.device_get(self._predict_fn(variables, jnp.asarray(x))))
 
     # -- export --------------------------------------------------------------
-    def export_saved_model(self, exporter) -> Optional[str]:
-        """Run a FinalExporter against the current (or checkpointed) state
-        (chief only)."""
+    def export_saved_model(self, exporter, metrics=None) -> Optional[str]:
+        """Run an exporter against the current (or checkpointed) state
+        (chief only). A metric-gated exporter (BestExporter — anything
+        with `maybe_export`) receives `metrics` and decides for itself;
+        without metrics it falls back to an unconditional export."""
         if self._state is None:
             shape = [1 if d is None else d for d in exporter.input_shape]
             sample = np.zeros(shape, np.dtype(exporter.input_dtype))
@@ -521,6 +523,10 @@ class Estimator:
         def apply_fn(variables, x):
             return self.model.apply(variables, x, train=False)
 
+        if metrics is not None and hasattr(exporter, "maybe_export"):
+            return exporter.maybe_export(
+                self.config.model_dir, apply_fn, variables, metrics
+            )
         return exporter.export(self.config.model_dir, apply_fn, variables)
 
     def close(self) -> None:
@@ -633,7 +639,14 @@ def train_and_evaluate(
         if now - last_eval["t"] < eval_spec.throttle_secs:
             return
         last_eval["t"] = now
-        estimator.evaluate(eval_spec.input_fn, eval_spec.steps, eval_spec.name)
+        m = estimator.evaluate(eval_spec.input_fn, eval_spec.steps,
+                               eval_spec.name)
+        # metric-gated exporters run after EVERY throttled eval (the
+        # tf.estimator contract: BestExporter compares per eval); plain
+        # FinalExporters wait for the end
+        for exporter in eval_spec.exporters:
+            if hasattr(exporter, "maybe_export"):
+                estimator.export_saved_model(exporter, metrics=m)
 
     state = estimator.train(
         train_spec.input_fn,
@@ -643,7 +656,7 @@ def train_and_evaluate(
     )
     metrics = estimator.evaluate(eval_spec.input_fn, eval_spec.steps, eval_spec.name)
     for exporter in eval_spec.exporters:
-        estimator.export_saved_model(exporter)
+        estimator.export_saved_model(exporter, metrics=metrics)
     return state, metrics
 
 
@@ -712,7 +725,21 @@ def _train_with_continuous_eval(
         raise RuntimeError(
             "continuous evaluator failed during training"
         ) from box["error"]
-    for exporter in eval_spec.exporters:
-        estimator.export_saved_model(exporter)
     _, metrics = box.get("result", (-1, {}))
+    for exporter in eval_spec.exporters:
+        # from_checkpoint mode: gated exporters see the evaluator's final
+        # metrics (per-eval gating would need the exporter inside the
+        # evaluator thread; the final-improvement check keeps semantics).
+        # No metrics (evaluator never completed an eval) -> a gated
+        # exporter must SKIP, not export a never-evaluated model.
+        if hasattr(exporter, "maybe_export"):
+            if metrics:
+                estimator.export_saved_model(exporter, metrics=metrics)
+            else:
+                log.warning(
+                    "skipping metric-gated exporter %r: the continuous "
+                    "evaluator produced no metrics", exporter.name,
+                )
+        else:
+            estimator.export_saved_model(exporter)
     return state, metrics
