@@ -349,3 +349,65 @@ def test_mistral_converted_generates_like_hf(hf_mistral, rng):
         ).numpy()
     ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama", "qwen2", "mistral"])
+def test_roundtrip_to_hf_logits_exact(family, hf_gpt2, hf_llama, hf_qwen2,
+                                      rng):
+    """from_hf -> to_hf reconstructs a transformers model with IDENTICAL
+    logits — the deploy-anywhere half of the migration story (fine-tune
+    here, export back)."""
+    from tfde_tpu.models.convert import (
+        gpt2_to_hf,
+        llama_to_hf,
+        mistral_from_hf,
+        qwen2_from_hf,
+    )
+
+    if family == "gpt2":
+        hf = hf_gpt2
+        model, params = gpt2_from_hf(hf, dtype=jnp.float32)
+        hf2 = gpt2_to_hf(model, params)
+    elif family == "llama":
+        hf = hf_llama
+        model, params = llama_from_hf(hf, dtype=jnp.float32)
+        hf2 = llama_to_hf(model, params)
+    elif family == "qwen2":
+        hf = hf_qwen2
+        model, params = qwen2_from_hf(hf, dtype=jnp.float32)
+        hf2 = llama_to_hf(model, params)
+    else:  # mistral: llama shape + sliding window in the config
+        cfg = transformers.MistralConfig(
+            vocab_size=101, hidden_size=32, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            attention_dropout=0.0, sliding_window=16,
+        )
+        torch.manual_seed(5)
+        hf = transformers.MistralForCausalLM(cfg)
+        hf.eval()
+        model, params = mistral_from_hf(hf, dtype=jnp.float32)
+        assert model.sliding_window == 16
+        hf2 = llama_to_hf(model, params)
+        assert hf2.config.sliding_window == 16
+
+    vocab = hf.config.vocab_size
+    ids = torch.tensor(rng.integers(0, vocab, (2, 12)).astype(np.int64))
+    with torch.no_grad():
+        a = hf(ids).logits
+        b = hf2(ids).logits
+    assert float((a - b).abs().max()) < 1e-4
+
+
+def test_to_hf_refuses_foreign_arrangements():
+    from tfde_tpu.models.convert import gpt2_to_hf, llama_to_hf
+    from tfde_tpu.models.gpt import GPT
+
+    rope = GPT(vocab_size=51, hidden_size=16, depth=1, num_heads=2,
+               mlp_dim=32, max_position=32, position="rope", norm="rms",
+               mlp_act="swiglu", use_bias=False)
+    with pytest.raises(NotImplementedError, match="GPT-2 arrangement"):
+        gpt2_to_hf(rope, {})
+    gemma_ish = rope.clone(mlp_act="geglu", embed_scale=4.0)
+    with pytest.raises(NotImplementedError, match="LLaMA arrangement"):
+        llama_to_hf(gemma_ish, {})

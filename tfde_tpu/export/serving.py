@@ -257,8 +257,6 @@ class BestExporter(FinalExporter):
                      variables: dict, metrics: dict):
         """Export iff metrics[self.metric] beats the persisted best;
         returns the artifact dir or None."""
-        import json
-
         if self.metric not in metrics:
             raise ValueError(
                 f"BestExporter({self.name!r}) monitors {self.metric!r} but "

@@ -434,6 +434,194 @@ def bert_classifier_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
 
 
 # --------------------------------------------------------------------------
+# Reverse conversion: this framework's params -> transformers checkpoints.
+# The OTHER half of the migration story: fine-tune here (full, LoRA-merged,
+# distilled), deploy anywhere transformers runs. Exact inverses of the
+# *_from_hf mappings above, verified by round-trip state-dict equality and
+# logit matching (tests/test_convert.py).
+# --------------------------------------------------------------------------
+
+
+def _t(a) -> "object":
+    import torch
+
+    return torch.from_numpy(np.ascontiguousarray(np.asarray(a, np.float32)))
+
+
+def gpt2_to_hf(model, params):
+    """A transformers GPT2LMHeadModel carrying `params` — the inverse of
+    `gpt2_from_hf`. Requires the GPT-2 arrangement (learned positions,
+    gelu MLP, LayerNorm, tied head, biased projections)."""
+    import transformers
+
+    if (model.position != "learned" or model.norm != "layer"
+            or model.mlp_act != "gelu" or not model.tie_embeddings
+            or not model.use_bias or model.sliding_window is not None
+            or model.head_dim is not None):
+        raise NotImplementedError(
+            "gpt2_to_hf requires the GPT-2 arrangement (learned positions, "
+            "LayerNorm, gelu, tied head, biased projections, full causal "
+            "attention) — other families export via llama_to_hf or stay "
+            "native"
+        )
+    cfg = transformers.GPT2Config(
+        vocab_size=model.vocab_size, n_embd=model.hidden_size,
+        n_layer=model.depth, n_head=model.num_heads,
+        n_inner=model.mlp_dim, n_positions=model.max_position,
+        layer_norm_epsilon=model.ln_eps,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    hf = transformers.GPT2LMHeadModel(cfg)
+    hidden = model.hidden_size
+    sd = {}
+    sd["transformer.wte.weight"] = _t(params["wte"]["embedding"])
+    sd["transformer.wpe.weight"] = _t(params["wpe"]["embedding"])
+    dec = params["decoder"]
+    sd["transformer.ln_f.weight"] = _t(dec["ln_final"]["scale"])
+    sd["transformer.ln_f.bias"] = _t(dec["ln_final"]["bias"])
+    for i in range(model.depth):
+        blk = dec[f"block_{i}"]
+        h = f"transformer.h.{i}."
+        sd[h + "ln_1.weight"] = _t(blk["ln_attn"]["scale"])
+        sd[h + "ln_1.bias"] = _t(blk["ln_attn"]["bias"])
+        sd[h + "ln_2.weight"] = _t(blk["ln_mlp"]["scale"])
+        sd[h + "ln_2.bias"] = _t(blk["ln_mlp"]["bias"])
+        a = blk["attn"]
+        # Conv1D layout is [in, out]: stack q/k/v back into [H, 3H]
+        c_attn_w = np.concatenate(
+            [np.asarray(a[n]["kernel"]).reshape(hidden, hidden)
+             for n in ("query", "key", "value")], axis=1,
+        )
+        c_attn_b = np.concatenate(
+            [np.asarray(a[n]["bias"]).reshape(hidden)
+             for n in ("query", "key", "value")]
+        )
+        sd[h + "attn.c_attn.weight"] = _t(c_attn_w)
+        sd[h + "attn.c_attn.bias"] = _t(c_attn_b)
+        sd[h + "attn.c_proj.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(hidden, hidden)
+        )
+        sd[h + "attn.c_proj.bias"] = _t(a["out"]["bias"])
+        sd[h + "mlp.c_fc.weight"] = _t(blk["mlp"]["fc1"]["kernel"])
+        sd[h + "mlp.c_fc.bias"] = _t(blk["mlp"]["fc1"]["bias"])
+        sd[h + "mlp.c_proj.weight"] = _t(blk["mlp"]["fc2"]["kernel"])
+        sd[h + "mlp.c_proj.bias"] = _t(blk["mlp"]["fc2"]["bias"])
+    sd["lm_head.weight"] = sd["transformer.wte.weight"]
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    # attn.bias buffers (causal masks) are regenerated by HF; everything
+    # else must load
+    missing = [k for k in missing if not k.endswith("attn.bias")
+               and not k.endswith("attn.masked_bias")]
+    unexpected = list(unexpected)
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={unexpected}")
+    hf.eval()
+    return hf
+
+
+def llama_to_hf(model, params):
+    """A transformers LlamaForCausalLM (or Qwen2 twin when
+    model.qkv_bias) carrying `params` — the inverse of `llama_from_hf` /
+    `qwen2_from_hf`. Mistral-style `sliding_window` models export as
+    MistralForCausalLM with the window in the config."""
+    import transformers
+
+    if (model.position != "rope" or model.norm != "rms"
+            or model.mlp_act != "swiglu" or model.use_bias
+            or model.embed_scale is not None):
+        raise NotImplementedError(
+            "llama_to_hf requires the LLaMA arrangement (rope, RMSNorm, "
+            "swiglu, bias-free, unscaled embeddings); Gemma-style models "
+            "stay native (the 1+w norm fold has no lossless inverse here)"
+        )
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = model.head_dim or hidden // heads
+    kv = model.num_kv_heads or heads
+    common = dict(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        num_key_value_heads=kv, intermediate_size=model.mlp_dim,
+        max_position_embeddings=model.max_position,
+        rope_theta=model.rope_theta, rms_norm_eps=model.ln_eps,
+        tie_word_embeddings=model.tie_embeddings, attention_dropout=0.0,
+    )
+    if model.qkv_bias:
+        if model.sliding_window is not None:
+            raise NotImplementedError(
+                "qkv_bias + sliding_window has no faithful transformers "
+                "twin here (Qwen2 windows are per-layer) — exporting "
+                "without the window would silently widen attention"
+            )
+        cfg = transformers.Qwen2Config(use_sliding_window=False,
+                                       head_dim=hd, **common)
+        hf = transformers.Qwen2ForCausalLM(cfg)
+    elif model.sliding_window is not None:
+        cfg = transformers.MistralConfig(
+            sliding_window=int(model.sliding_window), head_dim=hd, **common
+        )
+        hf = transformers.MistralForCausalLM(cfg)
+    else:
+        cfg = transformers.LlamaConfig(head_dim=hd, **common)
+        hf = transformers.LlamaForCausalLM(cfg)
+    sd = {}
+    sd["model.embed_tokens.weight"] = _t(params["wte"]["embedding"])
+    dec = params["decoder"]
+    sd["model.norm.weight"] = _t(dec["ln_final"]["scale"])
+    if not model.tie_embeddings:
+        sd["lm_head.weight"] = _t(np.asarray(params["lm_head"]["kernel"]).T)
+    else:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    for i in range(model.depth):
+        blk = dec[f"block_{i}"]
+        h = f"model.layers.{i}."
+        sd[h + "input_layernorm.weight"] = _t(blk["ln_attn"]["scale"])
+        sd[h + "post_attention_layernorm.weight"] = _t(
+            blk["ln_mlp"]["scale"]
+        )
+        a = blk["attn"]
+        sd[h + "self_attn.q_proj.weight"] = _t(
+            np.asarray(a["query"]["kernel"]).reshape(hidden, heads * hd).T
+        )
+        sd[h + "self_attn.k_proj.weight"] = _t(
+            np.asarray(a["key"]["kernel"]).reshape(hidden, kv * hd).T
+        )
+        sd[h + "self_attn.v_proj.weight"] = _t(
+            np.asarray(a["value"]["kernel"]).reshape(hidden, kv * hd).T
+        )
+        sd[h + "self_attn.o_proj.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(heads * hd, hidden).T
+        )
+        if model.qkv_bias:
+            sd[h + "self_attn.q_proj.bias"] = _t(
+                np.asarray(a["query"]["bias"]).reshape(heads * hd)
+            )
+            sd[h + "self_attn.k_proj.bias"] = _t(
+                np.asarray(a["key"]["bias"]).reshape(kv * hd)
+            )
+            sd[h + "self_attn.v_proj.bias"] = _t(
+                np.asarray(a["value"]["bias"]).reshape(kv * hd)
+            )
+        sd[h + "mlp.gate_proj.weight"] = _t(
+            np.asarray(blk["mlp"]["gate"]["kernel"]).T
+        )
+        sd[h + "mlp.up_proj.weight"] = _t(
+            np.asarray(blk["mlp"]["fc1"]["kernel"]).T
+        )
+        sd[h + "mlp.down_proj.weight"] = _t(
+            np.asarray(blk["mlp"]["fc2"]["kernel"]).T
+        )
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "rotary_emb" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
+# --------------------------------------------------------------------------
 # CLI: python -m tfde_tpu.models.convert <family> <hf_path> <out_dir>
 # --------------------------------------------------------------------------
 
@@ -497,12 +685,34 @@ def _cli(argv=None) -> str:
     import json
 
     parser = argparse.ArgumentParser(
-        description="HF checkpoint -> tfde_tpu params",
+        description="HF checkpoint -> tfde_tpu params (or back, --reverse)",
     )
     parser.add_argument("family", choices=sorted(_FAMILIES))
-    parser.add_argument("hf_path", help="local save_pretrained() directory")
+    parser.add_argument("hf_path", help="local save_pretrained() directory "
+                        "(with --reverse: a conversion-artifact dir)")
     parser.add_argument("out_dir")
+    parser.add_argument("--reverse", action="store_true",
+                        help="artifact dir -> HF save_pretrained() "
+                             "checkpoint: deploy a model fine-tuned here "
+                             "(full, LoRA-merged, distilled) anywhere "
+                             "transformers runs")
     args = parser.parse_args(argv)
+
+    if args.reverse:
+        model, params = load_converted(args.hf_path)
+        if args.family == "gpt2":
+            hf = gpt2_to_hf(model, params)
+        elif args.family in ("llama", "mistral", "qwen2"):
+            hf = llama_to_hf(model, params)
+        else:
+            raise SystemExit(
+                f"--reverse supports gpt2/llama/mistral/qwen2, not "
+                f"{args.family!r} (gemma's 1+w norm fold and bert's heads "
+                f"have no registered inverse yet)"
+            )
+        hf.save_pretrained(args.out_dir)
+        print(f"exported {args.family} HF checkpoint -> {args.out_dir}")
+        return args.out_dir
 
     import transformers
 

@@ -506,7 +506,9 @@ class Estimator:
         """Run an exporter against the current (or checkpointed) state
         (chief only). A metric-gated exporter (BestExporter — anything
         with `maybe_export`) receives `metrics` and decides for itself;
-        without metrics it falls back to an unconditional export."""
+        without metrics (empty eval, no eval yet) it SKIPS with a
+        warning — a gated export of a never-evaluated model would violate
+        its contract."""
         if self._state is None:
             shape = [1 if d is None else d for d in exporter.input_shape]
             sample = np.zeros(shape, np.dtype(exporter.input_dtype))
@@ -523,7 +525,15 @@ class Estimator:
         def apply_fn(variables, x):
             return self.model.apply(variables, x, train=False)
 
-        if metrics is not None and hasattr(exporter, "maybe_export"):
+        if hasattr(exporter, "maybe_export"):
+            if not metrics:
+                # a gated exporter without metrics must SKIP — exporting a
+                # never-evaluated model would violate its contract
+                log.warning(
+                    "skipping metric-gated exporter %r: no eval metrics "
+                    "available", exporter.name,
+                )
+                return None
             return exporter.maybe_export(
                 self.config.model_dir, apply_fn, variables, metrics
             )
@@ -729,17 +739,8 @@ def _train_with_continuous_eval(
     for exporter in eval_spec.exporters:
         # from_checkpoint mode: gated exporters see the evaluator's final
         # metrics (per-eval gating would need the exporter inside the
-        # evaluator thread; the final-improvement check keeps semantics).
-        # No metrics (evaluator never completed an eval) -> a gated
-        # exporter must SKIP, not export a never-evaluated model.
-        if hasattr(exporter, "maybe_export"):
-            if metrics:
-                estimator.export_saved_model(exporter, metrics=metrics)
-            else:
-                log.warning(
-                    "skipping metric-gated exporter %r: the continuous "
-                    "evaluator produced no metrics", exporter.name,
-                )
-        else:
-            estimator.export_saved_model(exporter)
+        # evaluator thread; the final-improvement check keeps semantics),
+        # and export_saved_model skips them with a warning when the
+        # evaluator produced none
+        estimator.export_saved_model(exporter, metrics=metrics)
     return state, metrics
