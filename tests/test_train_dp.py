@@ -177,3 +177,24 @@ def test_eval_masked_padding_exact_metrics():
     np.testing.assert_allclose(
         float(m["correct_sum"]), float(m1["correct_sum"]), rtol=1e-6
     )
+
+
+def test_grad_norm_metric_emitted(rng):
+    """Both step builders emit a finite, positive global grad_norm — the
+    divergence/clipping telemetry the lifecycle summarizes."""
+    import optax
+
+    from tfde_tpu.models.cnn import PlainCNN
+    from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
+    from tfde_tpu.training.step import init_state, make_train_step
+
+    strategy = MultiWorkerMirroredStrategy()
+    state, _ = init_state(PlainCNN(), optax.sgd(0.1), strategy,
+                          np.zeros((16, 784), np.float32))
+    step = make_train_step(strategy, state, donate=False)
+    images = rng.standard_normal((16, 784)).astype(np.float32)
+    labels = rng.integers(0, 10, (16,)).astype(np.int32)
+    _, m = step(state, (jnp.asarray(images), jnp.asarray(labels)),
+                jax.random.key(0))
+    gn = float(m["grad_norm"])
+    assert np.isfinite(gn) and gn > 0.0
