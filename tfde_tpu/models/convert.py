@@ -457,7 +457,9 @@ def gpt2_to_hf(model, params):
     if (model.position != "learned" or model.norm != "layer"
             or model.mlp_act != "gelu" or not model.tie_embeddings
             or not model.use_bias or model.sliding_window is not None
-            or model.head_dim is not None):
+            or model.head_dim is not None or model.embed_scale is not None
+            or model.qkv_bias
+            or (model.num_kv_heads not in (None, model.num_heads))):
         raise NotImplementedError(
             "gpt2_to_hf requires the GPT-2 arrangement (learned positions, "
             "LayerNorm, gelu, tied head, biased projections, full causal "
@@ -699,6 +701,17 @@ def _cli(argv=None) -> str:
     args = parser.parse_args(argv)
 
     if args.reverse:
+        from tfde_tpu.utils import fs as _fs
+
+        with _fs.fs_open(_fs.join(args.hf_path, "model_config.json"),
+                         "r") as f:
+            recorded = json.load(f).get("family")
+        if recorded != args.family:
+            raise SystemExit(
+                f"artifact {args.hf_path!r} records family {recorded!r}, "
+                f"not {args.family!r} — pass the family the artifact was "
+                f"converted as"
+            )
         model, params = load_converted(args.hf_path)
         if args.family == "gpt2":
             hf = gpt2_to_hf(model, params)

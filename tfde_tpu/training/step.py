@@ -18,6 +18,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tfde_tpu.ops import losses, metrics as metrics_lib
@@ -81,6 +82,10 @@ def train_step(
     metrics = dict(metrics)
     new_stats = metrics.pop("batch_stats", state.batch_stats)
     new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
+    # global grad norm: the divergence/clipping telemetry every training
+    # dashboard wants — computed from grads already in registers, one
+    # scalar, summarized at the usual cadence by the lifecycle
+    metrics["grad_norm"] = optax.global_norm(grads)
     return new_state, {"loss": loss, **metrics}
 
 
@@ -225,6 +230,9 @@ def make_custom_train_step(
     mean-of-means; return that denominator under the reserved metrics key
     ``"grad_weight"`` and the accumulation weights each microbatch by it
     (gradients, loss, and metrics), restoring the exact full-batch update.
+    The reserved key ``"grad_norm"`` is emitted automatically (global norm
+    of the final averaged gradients); a loss_fn returning its own
+    ``grad_norm`` metric takes precedence.
     The standard route to reference-scale global batches on few chips.
     """
     shardings = _state_shardings(strategy, state)
@@ -251,6 +259,7 @@ def make_custom_train_step(
                 state, batch, step_rng
             )
             new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
+            metrics.setdefault("grad_norm", optax.global_norm(grads))
             return new_state, {"loss": loss, **metrics}
 
         b = axes_lib.batch_axes()
@@ -326,6 +335,9 @@ def make_custom_train_step(
         loss = loss * inv
         metrics = jax.tree_util.tree_map(lambda m: m * inv, metrics)
         new_state = state.apply_gradients(grads, new_batch_stats=stats)
+        metrics["grad_norm"] = metrics.get(
+            "grad_norm", optax.global_norm(grads)
+        )
         return new_state, {"loss": loss, **metrics}
 
     def batch_shardings(batch):
