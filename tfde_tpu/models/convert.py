@@ -462,9 +462,9 @@ def gpt2_to_hf(model, params):
             or (model.num_kv_heads not in (None, model.num_heads))):
         raise NotImplementedError(
             "gpt2_to_hf requires the GPT-2 arrangement (learned positions, "
-            "LayerNorm, gelu, tied head, biased projections, full causal "
-            "attention) — other families export via llama_to_hf or stay "
-            "native"
+            "LayerNorm, gelu, tied head, uniformly biased projections, "
+            "classic MHA, unscaled embeddings, full causal attention) — "
+            "other families export via llama_to_hf or stay native"
         )
     cfg = transformers.GPT2Config(
         vocab_size=model.vocab_size, n_embd=model.hidden_size,
@@ -639,6 +639,48 @@ _FAMILIES = {
 }
 
 
+def _read_config(artifact_dir: str) -> dict:
+    """The artifact's model_config.json as a dict — the one read site."""
+    import json
+
+    from tfde_tpu.utils import fs
+
+    with fs.fs_open(fs.join(artifact_dir, "model_config.json"), "r") as f:
+        return json.load(f)
+
+
+def save_converted(model, params, out_dir: str, family: str) -> str:
+    """Write (model, params) as a conversion artifact (params.npz +
+    model_config.json) — what the forward CLI produces, and what
+    `load_converted` / `--reverse` consume. The save half of the artifact
+    contract: persist a fine-tuned model (e.g. Estimator.merged_params()
+    output on a converted base) so it can be reloaded or exported back to
+    transformers later."""
+    import dataclasses
+    import json
+
+    from tfde_tpu.export.serving import write_params_npz
+    from tfde_tpu.utils import fs
+
+    if family not in _FAMILIES:
+        raise ValueError(f"unknown family {family!r}; one of "
+                         f"{sorted(_FAMILIES)}")
+    fs.makedirs(out_dir, exist_ok=True)
+    write_params_npz(fs.join(out_dir, "params.npz"), params)
+    config = {
+        f.name: getattr(model, f.name)
+        for f in dataclasses.fields(model)
+        if f.name not in ("parent", "name")
+        and isinstance(getattr(model, f.name), (int, float, str, bool,
+                                                type(None)))
+    }
+    config["family"] = family
+    config["dtype"] = str(np.dtype(model.dtype))
+    with fs.fs_open(fs.join(out_dir, "model_config.json"), "w") as f:
+        json.dump(config, f, indent=2)
+    return out_dir
+
+
 def load_converted(artifact_dir: str, dtype=None):
     """(model, params) from a conversion-CLI artifact directory
     (params.npz + model_config.json, written by
@@ -649,15 +691,13 @@ def load_converted(artifact_dir: str, dtype=None):
     dtype overrides the recorded compute dtype (e.g. jnp.float32 on CPU).
     """
     import io
-    import json
 
     import jax.numpy as jnp
 
     from tfde_tpu.export.serving import _unflatten_params
     from tfde_tpu.utils import fs
 
-    with fs.fs_open(fs.join(artifact_dir, "model_config.json"), "r") as f:
-        conf = json.load(f)
+    conf = _read_config(artifact_dir)
     family = conf.pop("family")
     recorded = conf.pop("dtype")
     kwargs = dict(conf)
@@ -683,8 +723,6 @@ def _cli(argv=None) -> str:
     Returns the output dir. Offline by construction — `hf_path` is a local
     directory saved with save_pretrained(); nothing is downloaded."""
     import argparse
-    import dataclasses
-    import json
 
     parser = argparse.ArgumentParser(
         description="HF checkpoint -> tfde_tpu params (or back, --reverse)",
@@ -701,11 +739,7 @@ def _cli(argv=None) -> str:
     args = parser.parse_args(argv)
 
     if args.reverse:
-        from tfde_tpu.utils import fs as _fs
-
-        with _fs.fs_open(_fs.join(args.hf_path, "model_config.json"),
-                         "r") as f:
-            recorded = json.load(f).get("family")
+        recorded = _read_config(args.hf_path).get("family")
         if recorded != args.family:
             raise SystemExit(
                 f"artifact {args.hf_path!r} records family {recorded!r}, "
@@ -727,12 +761,9 @@ def _cli(argv=None) -> str:
         print(f"exported {args.family} HF checkpoint -> {args.out_dir}")
         return args.out_dir
 
-    import transformers
-
-    from tfde_tpu.export.serving import write_params_npz
-    from tfde_tpu.utils import fs
-
     import os
+
+    import transformers
 
     if not os.path.isdir(args.hf_path):
         raise SystemExit(
@@ -745,21 +776,7 @@ def _cli(argv=None) -> str:
     )
     hf.eval()
     model, params = globals()[fn_name](hf)
-
-    fs.makedirs(args.out_dir, exist_ok=True)
-    write_params_npz(fs.join(args.out_dir, "params.npz"), params)
-    # the flax module is a frozen dataclass: its fields ARE the config
-    config = {
-        f.name: getattr(model, f.name)
-        for f in dataclasses.fields(model)
-        if f.name not in ("parent", "name")
-        and isinstance(getattr(model, f.name), (int, float, str, bool,
-                                                type(None)))
-    }
-    config["family"] = args.family
-    config["dtype"] = str(np.dtype(model.dtype))  # derived, never assumed
-    with fs.fs_open(fs.join(args.out_dir, "model_config.json"), "w") as f:
-        json.dump(config, f, indent=2)
+    save_converted(model, params, args.out_dir, args.family)
     print(f"converted {args.family} checkpoint -> {args.out_dir}")
     return args.out_dir
 

@@ -241,6 +241,18 @@ class Estimator:
             params=merge_lora(self._lora_base, state.params, self.lora)
         )
 
+    def merged_params(self):
+        """Base-shaped params ready for serving/export: the LoRA adapters
+        folded into the frozen base (plain params when LoRA is off).
+        Requires a trained or checkpoint-restored state; feeds
+        export_serving / convert --reverse / generate directly."""
+        if self._state is None:
+            raise RuntimeError(
+                "merged_params() before train(): no trained state in this "
+                "process — train() or restore from model_dir first"
+            )
+        return self._merged(self._state).params
+
     def _state_for_inference(self, input_fn, what: str) -> TrainState:
         """State for evaluate/predict/export: live if this process trained,
         else restored from model_dir (the Estimator eval-from-checkpoint
