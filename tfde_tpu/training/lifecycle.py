@@ -241,15 +241,28 @@ class Estimator:
             params=merge_lora(self._lora_base, state.params, self.lora)
         )
 
-    def merged_params(self):
+    def merged_params(self, sample_input=None):
         """Base-shaped params ready for serving/export: the LoRA adapters
-        folded into the frozen base (plain params when LoRA is off).
-        Requires a trained or checkpoint-restored state; feeds
-        export_serving / convert --reverse / generate directly."""
+        folded into the frozen base (plain params when LoRA is off);
+        feeds save_converted / export_serving / generate directly.
+
+        In a fresh process (nothing trained yet), pass `sample_input` — a
+        model-input-shaped array, e.g. np.zeros((1, seq), np.int32) — and
+        the state restores from model_dir's latest checkpoint the same
+        way evaluate()/predict() would."""
+        if self._state is None and sample_input is not None:
+            self._ensure_state((sample_input,))
+            if not self._from_checkpoint:
+                self._state = None  # keep train()'s resume logic intact
+                raise RuntimeError(
+                    f"merged_params(): no checkpoint to restore in "
+                    f"model_dir={self.config.model_dir!r}"
+                )
         if self._state is None:
             raise RuntimeError(
                 "merged_params() before train(): no trained state in this "
-                "process — train() or restore from model_dir first"
+                "process — train() first, or pass sample_input to restore "
+                "from model_dir's latest checkpoint"
             )
         return self._merged(self._state).params
 
