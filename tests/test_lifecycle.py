@@ -375,3 +375,31 @@ def test_best_exporter_gates_on_metric(tmp_path):
             BestExporter("best2", (None, 784), metric="nope"),
             metrics={"loss": 1.0},
         )
+
+
+def test_best_exporter_runs_per_eval_in_continuous_mode(tmp_path):
+    """from_checkpoint mode: BestExporter gates inside the evaluator loop
+    (per evaluated checkpoint), and the final catch-up keeps the bar
+    consistent — artifacts + best_metric.json appear without an inline
+    eval ever running."""
+    import json
+
+    from tfde_tpu.export.serving import BestExporter
+
+    train_fn, eval_fn = _input_fns()
+    cfg = RunConfig(model_dir=str(tmp_path / "run"),
+                    save_checkpoints_steps=5, save_summary_steps=100)
+    est = Estimator(PlainCNN(), optax.sgd(0.1), config=cfg)
+    state, metrics = train_and_evaluate(
+        est,
+        TrainSpec(train_fn, max_steps=15),
+        EvalSpec(eval_fn, exporters=[BestExporter("best", (None, 784))],
+                 start_delay_secs=0, throttle_secs=0.2),
+        eval_mode="from_checkpoint",
+    )
+    est.close()
+    export_dir = tmp_path / "run" / "export" / "best"
+    stamps = [d for d in os.listdir(export_dir) if d.isdigit()]
+    assert stamps
+    bar = json.loads((export_dir / "best_metric.json").read_text())
+    assert np.isfinite(bar["value"])

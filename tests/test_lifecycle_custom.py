@@ -283,3 +283,37 @@ def test_pipelined_1f1b_estimator_lifecycle_and_resume(tmp_path):
     second = est2.evaluate(_token_input_fn(1, repeat=1), name="eval")
     assert second["loss"] < first["loss"] + 0.05  # still improving-ish
     est2.close()
+
+
+def test_merged_params_restores_in_fresh_process(tmp_path):
+    """The deploy step runs in a new process: merged_params(sample_input)
+    restores the latest adapters-only checkpoint and returns base-shaped
+    params; without a checkpoint it refuses loudly."""
+    from tfde_tpu.training.lora import LoraConfig
+
+    model = gpt_tiny_test()
+    base = model.init(jax.random.key(5), jnp.zeros((2, 8), jnp.int32),
+                      train=False)["params"]
+    cfg = RunConfig(model_dir=str(tmp_path), save_checkpoints_steps=3)
+    mk = lambda: Estimator(model, optax.adamw(5e-3), config=cfg,
+                           loss_fn=next_token_loss,
+                           lora=LoraConfig(rank=4), lora_base_params=base)
+    est = mk()
+    est.train(_token_input_fn(0), max_steps=6)
+    est.close()
+
+    est2 = mk()  # fresh-process analog
+    merged = est2.merged_params(sample_input=np.zeros((16, 16), np.int32))
+    assert (jax.tree_util.tree_structure(merged)
+            == jax.tree_util.tree_structure(base))
+    est2.close()
+
+    empty_cfg = RunConfig(model_dir=str(tmp_path / "empty"),
+                          save_checkpoints_steps=3)
+    est3 = Estimator(model, optax.adamw(5e-3), config=empty_cfg,
+                     loss_fn=next_token_loss, lora=LoraConfig(rank=4),
+                     lora_base_params=base)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="no checkpoint|no trained"):
+        est3.merged_params(sample_input=np.zeros((16, 16), np.int32))

@@ -237,9 +237,10 @@ class BestExporter(FinalExporter):
     exports only when the monitored eval metric improves on the best seen
     so far. The bar persists in `<export dir>/best_metric.json`, so a
     resumed run keeps comparing against its own history. Runs after every
-    throttled eval in `train_and_evaluate` (inline mode) and once more at
-    the final eval; the timestamped layout matches FinalExporter, newest
-    == best."""
+    throttled eval in `train_and_evaluate` (inline mode), after every
+    evaluated checkpoint in `continuous_eval` / eval_mode='from_checkpoint',
+    and once more at the final eval; the timestamped layout matches
+    FinalExporter, newest == best."""
 
     def __init__(
         self,

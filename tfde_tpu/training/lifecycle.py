@@ -251,13 +251,9 @@ class Estimator:
         the state restores from model_dir's latest checkpoint the same
         way evaluate()/predict() would."""
         if self._state is None and sample_input is not None:
-            self._ensure_state((sample_input,))
-            if not self._from_checkpoint:
-                self._state = None  # keep train()'s resume logic intact
-                raise RuntimeError(
-                    f"merged_params(): no checkpoint to restore in "
-                    f"model_dir={self.config.model_dir!r}"
-                )
+            # one restore-or-raise path for every inference entry point
+            self._state_for_inference(lambda: [(sample_input,)],
+                                      "merged_params()")
         if self._state is None:
             raise RuntimeError(
                 "merged_params() before train(): no trained state in this "
@@ -591,6 +587,11 @@ def continuous_eval(
     Stops when `stop_after_step` is reached, `idle_timeout_secs` passes with
     no new checkpoint, or `stop_event` is set (after a final catch-up pass).
     Returns (last_evaluated_step, last_metrics).
+
+    Metric-gated exporters in `eval_spec.exporters` (BestExporter) run
+    after EVERY evaluated checkpoint — the per-eval gating the
+    tf.estimator contract describes; plain exporters stay end-of-training
+    (the caller's final-export loop).
     """
     poll = eval_spec.throttle_secs if poll_secs is None else poll_secs
     seen, last = -1, {}
@@ -606,6 +607,9 @@ def continuous_eval(
         seen = step
         idle_since = time.time()
         last = estimator.evaluate(eval_spec.input_fn, eval_spec.steps, eval_spec.name)
+        for exporter in eval_spec.exporters:
+            if hasattr(exporter, "maybe_export"):
+                estimator.export_saved_model(exporter, metrics=last)
         return True
 
     while True:
@@ -762,10 +766,10 @@ def _train_with_continuous_eval(
         ) from box["error"]
     _, metrics = box.get("result", (-1, {}))
     for exporter in eval_spec.exporters:
-        # from_checkpoint mode: gated exporters see the evaluator's final
-        # metrics (per-eval gating would need the exporter inside the
-        # evaluator thread; the final-improvement check keeps semantics),
-        # and export_saved_model skips them with a warning when the
-        # evaluator produced none
+        # gated exporters already ran per evaluated checkpoint inside
+        # continuous_eval; this pass is the safety net (evaluator thread
+        # produced no evals -> skip with a warning) and the plain
+        # FinalExporters' end-of-training run. Re-gating with the final
+        # metrics is a guaranteed no-op (strict-improvement bar).
         estimator.export_saved_model(exporter, metrics=metrics)
     return state, metrics
