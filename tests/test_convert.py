@@ -249,10 +249,11 @@ def test_llama_converted_generates_like_hf(hf_llama, rng):
 
 
 def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma,
-                                  hf_qwen2):
+                                  hf_qwen2, hf_phi):
     """Converted trees must match the models' own init structure exactly —
     a missing/extra leaf means a silently unconverted weight."""
-    from tfde_tpu.models.convert import gemma_from_hf, qwen2_from_hf
+    from tfde_tpu.models.convert import (gemma_from_hf, phi_from_hf,
+                                         qwen2_from_hf)
 
     for hf, conv, sample in (
         (hf_gpt2, gpt2_from_hf, jnp.zeros((1, 8), jnp.int32)),
@@ -260,6 +261,7 @@ def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma,
         (hf_llama, llama_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_gemma, gemma_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_qwen2, qwen2_from_hf, jnp.zeros((1, 8), jnp.int32)),
+        (hf_phi, phi_from_hf, jnp.zeros((1, 8), jnp.int32)),
     ):
         model, params = conv(hf, dtype=jnp.float32)
         ref = model.init(jax.random.key(0), sample)["params"]
@@ -411,3 +413,51 @@ def test_to_hf_refuses_foreign_arrangements():
     gemma_ish = rope.clone(mlp_act="geglu", embed_scale=4.0)
     with pytest.raises(NotImplementedError, match="LLaMA arrangement"):
         llama_to_hf(gemma_ish, {})
+
+
+@pytest.fixture(scope="module")
+def hf_phi():
+    cfg = transformers.PhiConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        attention_dropout=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+    )
+    torch.manual_seed(7)
+    m = transformers.PhiForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_phi_logits_match(hf_phi, rng):
+    """Phi = parallel blocks (one LN, attn + MLP side by side) + partial
+    rotary (rope_dim = 0.5 * head_dim) + biased everything including the
+    untied lm_head — one converted forward checks all of it."""
+    from tfde_tpu.models.convert import phi_from_hf
+
+    model, params = phi_from_hf(hf_phi, dtype=jnp.float32)
+    assert model.norm_style == "parallel" and model.head_bias
+    assert model.rope_dim == 4  # 0.5 * head_dim(8)
+    assert "ln_mlp" not in params["decoder"]["block_0"]  # one LN per block
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_phi(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_phi_converted_generates_like_hf(hf_phi, rng):
+    """Partial rotary through the KV cache: cached decode must equal HF
+    greedy generation (the rotation boundary rides the cache offsets)."""
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import phi_from_hf
+
+    model, params = phi_from_hf(hf_phi, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_phi.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)

@@ -315,3 +315,24 @@ def test_generate_repetition_penalty_breaks_loops(rng):
             emitted.append(t)
     # and the knob actually changed the output vs plain greedy
     assert not np.array_equal(np.asarray(plain), np.asarray(pen))
+
+
+def test_min_p_filters_below_adaptive_floor(rng):
+    """min-p keeps exactly the tokens whose probability reaches
+    min_p * max-probability; composition after top-k/top-p holds."""
+    from tfde_tpu.inference.decode import sample_logits
+
+    # probs ~ [0.643, 0.237, 0.087, 0.032]; floor at 0.5*0.643 = 0.321
+    logits = jnp.log(jnp.asarray([[0.643, 0.237, 0.087, 0.032]], jnp.float32))
+    picks = set()
+    for i in range(200):
+        t = sample_logits(logits, jax.random.key(i), temperature=1.0,
+                          min_p=0.5)
+        picks.add(int(t[0]))
+    assert picks == {0}  # only the top token clears the 0.32 floor
+    picks = set()
+    for i in range(400):
+        t = sample_logits(logits, jax.random.key(i), temperature=1.0,
+                          min_p=0.3)
+        picks.add(int(t[0]))
+    assert picks == {0, 1}  # 0.237 clears 0.193; 0.087 does not

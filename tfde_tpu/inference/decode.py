@@ -122,11 +122,16 @@ def sample_logits(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
     repetition_penalty: float = 1.0,
     seen: Optional[jax.Array] = None,
 ) -> jax.Array:
     """[B, V] logits -> [B] sampled token ids. temperature=0 is greedy
-    (argmax); top_k and top_p filters compose (k first, then nucleus).
+    (argmax); the top_k, top_p and min_p filters compose (k, then
+    nucleus, then min-p: drop tokens whose probability is below
+    min_p * max-probability — a shape-adaptive floor that cuts the long
+    tail when the model is confident and keeps diversity when it is
+    not).
 
     repetition_penalty > 1 with `seen` (a [B, V] bool presence mask of
     already-emitted ids) applies the CTRL/HF rule before any other
@@ -165,13 +170,18 @@ def sample_logits(
             axis=-1, keepdims=True,
         )
         logits = jnp.where(logits < threshold, neg, logits)
+    if min_p is not None and 0.0 < min_p < 1.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        floor = min_p * jnp.max(probs, axis=-1, keepdims=True)
+        logits = jnp.where(probs < floor, neg, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "top_p", "eos_id", "pad_id", "repetition_penalty"),
+                     "top_p", "min_p", "eos_id", "pad_id",
+                     "repetition_penalty"),
 )
 def generate(
     model,
@@ -182,6 +192,7 @@ def generate(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
     repetition_penalty: float = 1.0,
@@ -208,7 +219,7 @@ def generate(
     prompt = prompt.astype(jnp.int32)
     model_step = _make_model_step(decode_model, params)
     sample = functools.partial(sample_logits, temperature=temperature,
-                               top_k=top_k, top_p=top_p,
+                               top_k=top_k, top_p=top_p, min_p=min_p,
                                repetition_penalty=repetition_penalty)
     penalize = repetition_penalty != 1.0
     # presence mask of everything emitted so far (prompt included, the HF

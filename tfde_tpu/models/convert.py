@@ -292,6 +292,104 @@ def qwen2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def phi_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers PhiForCausalLM.
+
+    The Phi arrangement: PARALLEL blocks (one LayerNorm feeds attention
+    and MLP side by side — `GPT(norm_style='parallel')`), partial rotary
+    (`rope_dim = partial_rotary_factor * head_dim`), tanh-gelu MLP,
+    biases everywhere including the untied lm_head (`head_bias=True`)."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    if getattr(cfg, "rope_scaling", None):
+        raise NotImplementedError(
+            f"rope_scaling={cfg.rope_scaling!r} is not supported; "
+            f"converting would produce silently wrong logits — only plain "
+            f"rope_theta Phi checkpoints convert today"
+        )
+    if bool(getattr(cfg, "qk_layernorm", False)):
+        raise NotImplementedError(
+            "qk_layernorm=True Phi checkpoints are not supported (the "
+            "per-head q/k norms would be silently dropped)"
+        )
+    if getattr(cfg, "hidden_act", None) not in ("gelu_new", None):
+        raise NotImplementedError(
+            f"hidden_act {cfg.hidden_act!r} is not supported (expected "
+            f"Phi's gelu_new, which our tanh-gelu Mlp matches exactly)"
+        )
+    heads = cfg.num_attention_heads
+    hidden = cfg.hidden_size
+    hd = hidden // heads
+    kv = cfg.num_key_value_heads
+    rope_dim = int(getattr(cfg, "partial_rotary_factor", 1.0) * hd)
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.num_hidden_layers,
+        num_heads=heads,
+        mlp_dim=cfg.intermediate_size,
+        max_position=cfg.max_position_embeddings,
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        position="rope",
+        rope_theta=float(cfg.rope_theta),
+        rope_dim=None if rope_dim == hd else rope_dim,
+        num_kv_heads=kv,
+        norm="layer",
+        norm_style="parallel",
+        mlp_act="gelu",
+        use_bias=True,
+        tie_embeddings=False,
+        head_bias=True,
+        ln_eps=cfg.layer_norm_eps,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    params = {
+        "wte": {"embedding": sd[f"{pre}embed_tokens.weight"]},
+        "decoder": {
+            "ln_final": {"scale": sd[f"{pre}final_layernorm.weight"],
+                         "bias": sd[f"{pre}final_layernorm.bias"]},
+        },
+        "lm_head": {"kernel": sd["lm_head.weight"].T,
+                    "bias": sd["lm_head.bias"]},
+    }
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}layers.{i}."
+        params["decoder"][f"block_{i}"] = {
+            # parallel blocks have ONE norm: input_layernorm -> ln_attn
+            "ln_attn": {"scale": sd[h + "input_layernorm.weight"],
+                        "bias": sd[h + "input_layernorm.bias"]},
+            "attn": {
+                "query": {"kernel": sd[h + "self_attn.q_proj.weight"].T
+                          .reshape(hidden, heads, hd),
+                          "bias": sd[h + "self_attn.q_proj.bias"]
+                          .reshape(heads, hd)},
+                "key": {"kernel": sd[h + "self_attn.k_proj.weight"].T
+                        .reshape(hidden, kv, hd),
+                        "bias": sd[h + "self_attn.k_proj.bias"]
+                        .reshape(kv, hd)},
+                "value": {"kernel": sd[h + "self_attn.v_proj.weight"].T
+                          .reshape(hidden, kv, hd),
+                          "bias": sd[h + "self_attn.v_proj.bias"]
+                          .reshape(kv, hd)},
+                "out": {"kernel": sd[h + "self_attn.dense.weight"].T
+                        .reshape(heads, hd, hidden),
+                        "bias": sd[h + "self_attn.dense.bias"]},
+            },
+            "mlp": {
+                "fc1": {"kernel": sd[h + "mlp.fc1.weight"].T,
+                        "bias": sd[h + "mlp.fc1.bias"]},
+                "fc2": {"kernel": sd[h + "mlp.fc2.weight"].T,
+                        "bias": sd[h + "mlp.fc2.bias"]},
+            },
+        }
+    return model, params
+
+
 def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(Bert, params) from a transformers BertForMaskedLM (or BertModel —
     then the MLM head params initialize to the identity transform)."""
@@ -636,6 +734,7 @@ _FAMILIES = {
     "qwen2": ("Qwen2ForCausalLM", "qwen2_from_hf"),
     "bert-classifier": ("BertForSequenceClassification",
                         "bert_classifier_from_hf"),
+    "phi": ("PhiForCausalLM", "phi_from_hf"),
 }
 
 
@@ -707,7 +806,7 @@ def load_converted(artifact_dir: str, dtype=None):
     from tfde_tpu.models.gpt import GPT
 
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
-           "qwen2": GPT, "bert": Bert,
+           "qwen2": GPT, "phi": GPT, "bert": Bert,
            "bert-classifier": BertClassifier}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:

@@ -52,6 +52,9 @@ class GPT(nn.Module):
     # better length extrapolation
     position: str = "learned"
     rope_theta: float = 10_000.0
+    # partial rotary (the Phi family): only the first rope_dim features of
+    # each head rotate; None = full head_dim
+    rope_dim: Optional[int] = None
     # grouped-query attention: KV heads per layer (None = num_heads); the
     # KV cache shrinks by num_heads/num_kv_heads — the serving memory knob
     num_kv_heads: Optional[int] = None
@@ -60,6 +63,11 @@ class GPT(nn.Module):
     use_bias: bool = True    # False: LLaMA bias-free projections
     # Qwen2: biased q/k/v projections beside bias-free out/MLP
     qkv_bias: bool = False
+    # 'pre' (GPT-2/LLaMA) | 'parallel' (Phi: one LN per block, attention
+    # and MLP side by side on it)
+    norm_style: str = "pre"
+    # Phi: the untied lm_head carries a bias
+    head_bias: bool = False
     # token embeddings are multiplied by this after lookup (Gemma:
     # sqrt(hidden_size)); None = no scaling (every other family)
     embed_scale: Optional[float] = None
@@ -146,11 +154,13 @@ class GPT(nn.Module):
             decode=self.decode,
             rope=self.position == "rope",
             rope_theta=self.rope_theta,
+            rope_dim=self.rope_dim,
             num_kv_heads=self.num_kv_heads,
             fused_qkv=self.fused_qkv,
             quant=self.quant,
             window=self.sliding_window,
             norm=self.norm,
+            norm_style=self.norm_style,
             mlp_act=self.mlp_act,
             use_bias=self.use_bias,
             qkv_bias=self.qkv_bias,
@@ -162,17 +172,24 @@ class GPT(nn.Module):
             name="decoder",
         )(x, train=train)
         if self.tie_embeddings:
+            if self.head_bias:
+                raise ValueError(
+                    "head_bias=True requires tie_embeddings=False (the "
+                    "tied head is wte^T via Embed.attend, which carries "
+                    "no bias) — a silently dropped bias would change the "
+                    "architecture"
+                )
             logits = wte.attend(x.astype(self.dtype)).astype(jnp.float32)
         elif self.quant is not None:
             from tfde_tpu.ops.quant import QuantDenseGeneral
 
             logits = QuantDenseGeneral(
-                self.vocab_size, use_bias=False, dtype=self.dtype,
+                self.vocab_size, use_bias=self.head_bias, dtype=self.dtype,
                 name="lm_head",
             )(x.astype(self.dtype)).astype(jnp.float32)
         else:
             logits = nn.Dense(
-                self.vocab_size, use_bias=False, dtype=self.dtype,
+                self.vocab_size, use_bias=self.head_bias, dtype=self.dtype,
                 param_dtype=jnp.float32, name="lm_head",
             )(x.astype(self.dtype)).astype(jnp.float32)
         return constrain(logits, b, "seq", "tensor")

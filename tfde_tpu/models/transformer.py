@@ -69,6 +69,9 @@ class MultiHeadAttention(nn.Module):
     decode: bool = False
     rope: bool = False  # rotary q/k rotation (ops/rotary.py) inside the layer
     rope_theta: float = 10_000.0
+    # partial rotary (Phi convention): only the first rope_dim features of
+    # each head rotate; None = full head_dim
+    rope_dim: Optional[int] = None
     # grouped-query attention: K/V carry this many heads (must divide
     # num_heads); each KV head serves num_heads/num_kv_heads query heads.
     # None = classic MHA. The KV cache and its decode bandwidth shrink by
@@ -192,8 +195,10 @@ class MultiHeadAttention(nn.Module):
         pos = jnp.asarray(start, jnp.int32)[..., None] + jnp.arange(
             q.shape[1], dtype=jnp.int32
         )  # scalar -> [S] (shape-(1,) start broadcasts away), [B] -> [B, S]
-        return (apply_rotary(q, pos, self.rope_theta),
-                apply_rotary(k, pos, self.rope_theta))
+        return (apply_rotary(q, pos, self.rope_theta,
+                             rotary_dim=self.rope_dim),
+                apply_rotary(k, pos, self.rope_theta,
+                             rotary_dim=self.rope_dim))
 
     def _decode_attention(self, q, k, v, batch) -> jax.Array:
         """Write this call's K/V into the cache, attend q over the filled
@@ -351,11 +356,13 @@ class TransformerBlock(nn.Module):
     decode: bool = False
     rope: bool = False
     rope_theta: float = 10_000.0
+    rope_dim: Optional[int] = None  # partial rotary (MultiHeadAttention)
     num_kv_heads: Optional[int] = None  # GQA (MultiHeadAttention)
     fused_qkv: bool = False  # one-GEMM qkv projection (MultiHeadAttention)
     quant: Optional[str] = None  # int8 serving twins (MultiHeadAttention)
     window: Optional[int] = None  # sliding window (MultiHeadAttention)
-    norm_style: str = "pre"  # 'pre' | 'post'
+    norm_style: str = "pre"  # 'pre' | 'post' | 'parallel' (Phi: one LN,
+    #                          x + attn(ln(x)) + mlp(ln(x)))
     norm: str = "layer"  # 'layer' | 'rms' (LLaMA: scale-only, no bias)
     mlp_act: str = "gelu"  # Mlp.act
     use_bias: bool = True
@@ -388,6 +395,7 @@ class TransformerBlock(nn.Module):
             decode=self.decode,
             rope=self.rope,
             rope_theta=self.rope_theta,
+            rope_dim=self.rope_dim,
             num_kv_heads=self.num_kv_heads,
             fused_qkv=self.fused_qkv,
             quant=self.quant,
@@ -439,7 +447,16 @@ class TransformerBlock(nn.Module):
             x = x.astype(self.dtype)
             x = ln(name="ln_mlp")(x + mlp(x, train=train))
             return x.astype(self.dtype)
-        raise ValueError(f"norm_style must be 'pre' or 'post', got {self.norm_style!r}")
+        if self.norm_style == "parallel":
+            # the Phi arrangement: ONE LayerNorm feeds attention and MLP
+            # side by side, residual added once — attn and MLP GEMMs have
+            # no serial dependency, so XLA overlaps them freely
+            y = ln(name="ln_attn")(x).astype(self.dtype)
+            return x + attn(y, mask=mask, train=train) + mlp(y, train=train)
+        raise ValueError(
+            f"norm_style must be 'pre', 'post' or 'parallel', got "
+            f"{self.norm_style!r}"
+        )
 
 
 def remat_policy(remat):
@@ -475,6 +492,7 @@ class Encoder(nn.Module):
     decode: bool = False
     rope: bool = False
     rope_theta: float = 10_000.0
+    rope_dim: Optional[int] = None
     num_kv_heads: Optional[int] = None
     fused_qkv: bool = False
     quant: Optional[str] = None
@@ -527,6 +545,7 @@ class Encoder(nn.Module):
                 decode=self.decode,
                 rope=self.rope,
                 rope_theta=self.rope_theta,
+                rope_dim=self.rope_dim,
                 num_kv_heads=self.num_kv_heads,
                 fused_qkv=self.fused_qkv,
                 quant=self.quant,

@@ -35,10 +35,24 @@ def rotary_angles(positions: jax.Array, dim: int,
 
 
 def apply_rotary(x: jax.Array, positions: jax.Array,
-                 theta: float = 10_000.0) -> jax.Array:
+                 theta: float = 10_000.0,
+                 rotary_dim=None) -> jax.Array:
     """Rotate [B, S, H, D] by per-token angles; `positions` is [S] or
-    [B, S] absolute token positions. fp32 trig, result in x.dtype."""
+    [B, S] absolute token positions. fp32 trig, result in x.dtype.
+
+    rotary_dim: PARTIAL rotary (the Phi/GPT-NeoX partial_rotary_factor
+    convention) — only the first `rotary_dim` features rotate, the rest
+    pass through untouched. None/D = full rotation."""
     d = x.shape[-1]
+    if rotary_dim is not None and rotary_dim != d:
+        if not 0 < rotary_dim < d:
+            raise ValueError(
+                f"rotary_dim {rotary_dim} must be in (0, head_dim={d}]"
+            )
+        rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+        return jnp.concatenate(
+            [apply_rotary(rot, positions, theta), rest], axis=-1
+        )
     cos, sin = rotary_angles(positions, d, theta)  # [..., S, d/2]
     # broadcast to [B, S, 1, d/2] over heads
     if cos.ndim == 2:  # [S, d/2] -> [1, S, 1, d/2]
