@@ -14,7 +14,7 @@ Artifact layout mirrors the classifier export:
       params.npz        final params, host-gathered
       model.stablehlo   jax.export serialization of generate(), cpu+tpu
 
-The sampling configuration (temperature/top_k/top_p/eos) is part of the
+The sampling configuration (temperature/top_k/top_p/min_p/eos) is part of the
 compiled program — a deployment picks it at export time, the way it picks
 the signature shape. The `seed` argument stays runtime: one artifact serves
 any number of sampled continuations.
@@ -46,6 +46,7 @@ def export_generate(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
     platforms: Tuple[str, ...] = ("cpu", "tpu"),
@@ -64,7 +65,7 @@ def export_generate(
         return generate(
             model, host_params, prompt, max_new_tokens,
             rng=jax.random.key(seed), temperature=temperature, top_k=top_k,
-            top_p=top_p, eos_id=eos_id, pad_id=pad_id,
+            top_p=top_p, min_p=min_p, eos_id=eos_id, pad_id=pad_id,
         )
 
     prompt_arg = jax.ShapeDtypeStruct((batch_size, prompt_len), jnp.int32)
@@ -94,6 +95,7 @@ def export_generate(
                 "temperature": temperature,
                 "top_k": top_k,
                 "top_p": top_p,
+                "min_p": min_p,
                 "eos_id": eos_id,
                 "pad_id": pad_id,
             },

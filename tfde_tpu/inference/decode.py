@@ -170,7 +170,9 @@ def sample_logits(
             axis=-1, keepdims=True,
         )
         logits = jnp.where(logits < threshold, neg, logits)
-    if min_p is not None and 0.0 < min_p < 1.0:
+    if min_p is not None and 0.0 < min_p <= 1.0:
+        # min_p=1.0 is MEANINGFUL (keep only tokens tied with the max) —
+        # unlike top_p, 1.0 is not a no-op here
         probs = jax.nn.softmax(logits, axis=-1)
         floor = min_p * jnp.max(probs, axis=-1, keepdims=True)
         logits = jnp.where(probs < floor, neg, logits)
@@ -285,6 +287,7 @@ def generate_ragged(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
 ):
@@ -334,24 +337,24 @@ def generate_ragged(
     return _generate_ragged(
         model, params, prompt.astype(jnp.int32), jnp.asarray(lengths_np),
         max_new_tokens, rng, prefill_len, temperature, top_k, top_p,
-        eos_id, pad_id,
+        min_p, eos_id, pad_id,
     )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "prefill_len", "temperature",
-                     "top_k", "top_p", "eos_id", "pad_id"),
+                     "top_k", "top_p", "min_p", "eos_id", "pad_id"),
 )
 def _generate_ragged(model, params, prompt, prompt_lengths, max_new_tokens,
-                     rng, prefill_len, temperature, top_k, top_p, eos_id,
-                     pad_id):
+                     rng, prefill_len, temperature, top_k, top_p, min_p,
+                     eos_id, pad_id):
     b, p_max = prompt.shape
     total = validate_budget(model, p_max, max_new_tokens)
     decode_model = _decode_clone(model)
     cache = init_cache(model, b, total)
     sample = functools.partial(sample_logits, temperature=temperature,
-                               top_k=top_k, top_p=top_p)
+                               top_k=top_k, top_p=top_p, min_p=min_p)
     model_step = _make_model_step(decode_model, params)
 
     # seq holds the final assembly; prompt slots are already right, the

@@ -162,6 +162,7 @@ class ContinuousBatcher:
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        min_p: Optional[float] = None,
         eos_id: Optional[int] = None,
         pad_id: int = 0,
         rng: Optional[jax.Array] = None,
@@ -176,7 +177,8 @@ class ContinuousBatcher:
         self._b = batch_size
         self._max_len = int(max_len)
         self._sample = functools.partial(
-            sample_logits, temperature=temperature, top_k=top_k, top_p=top_p
+            sample_logits, temperature=temperature, top_k=top_k,
+            top_p=top_p, min_p=min_p,
         )
         self._eos = eos_id
         self._pad = pad_id

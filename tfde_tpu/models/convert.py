@@ -556,7 +556,8 @@ def gpt2_to_hf(model, params):
             or model.mlp_act != "gelu" or not model.tie_embeddings
             or not model.use_bias or model.sliding_window is not None
             or model.head_dim is not None or model.embed_scale is not None
-            or model.qkv_bias
+            or model.qkv_bias or model.head_bias
+            or model.norm_style != "pre" or model.rope_dim is not None
             or (model.num_kv_heads not in (None, model.num_heads))):
         raise NotImplementedError(
             "gpt2_to_hf requires the GPT-2 arrangement (learned positions, "
@@ -629,11 +630,13 @@ def llama_to_hf(model, params):
 
     if (model.position != "rope" or model.norm != "rms"
             or model.mlp_act != "swiglu" or model.use_bias
-            or model.embed_scale is not None):
+            or model.embed_scale is not None or model.head_bias
+            or model.norm_style != "pre" or model.rope_dim is not None):
         raise NotImplementedError(
-            "llama_to_hf requires the LLaMA arrangement (rope, RMSNorm, "
-            "swiglu, bias-free, unscaled embeddings); Gemma-style models "
-            "stay native (the 1+w norm fold has no lossless inverse here)"
+            "llama_to_hf requires the LLaMA arrangement (rope — full, not "
+            "partial — RMSNorm, swiglu, bias-free pre-norm blocks, "
+            "unscaled embeddings, bias-free head); Gemma/Phi-style models "
+            "stay native"
         )
     heads = model.num_heads
     hidden = model.hidden_size
