@@ -46,6 +46,9 @@ def main(argv=None):
     parser.add_argument("--requests", type=int, default=12,
                         help="synthetic requests to serve")
     parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--top-k", type=int, default=None)
+    parser.add_argument("--top-p", type=float, default=None)
+    parser.add_argument("--min-p", type=float, default=None)
     parser.add_argument("--eos-id", type=int, default=None)
     parser.add_argument("--num-draft", type=int, default=0, metavar="K",
                         help="serve through SpeculativeContinuousBatcher "
@@ -90,11 +93,21 @@ def main(argv=None):
         )["params"]
         log.warning("serving RANDOM weights; pass --hf-dir for a real model")
 
+    sampling_flags = (args.temperature != 0.0 or args.top_k is not None
+                      or args.top_p is not None or args.min_p is not None)
+    if args.temperature == 0.0 and (args.top_k is not None
+                                    or args.top_p is not None
+                                    or args.min_p is not None):
+        raise SystemExit(
+            "--top-k/--top-p/--min-p only act when sampling — set "
+            "--temperature > 0 (at 0.0 decoding is greedy argmax and the "
+            "filters would be silent no-ops)"
+        )
     if args.num_draft > 0:
-        if args.temperature != 0.0:
+        if sampling_flags:
             raise ValueError(
-                "--num-draft serves the greedy verifier; drop "
-                "--temperature (speculative SAMPLING lives in "
+                "--num-draft serves the greedy verifier; drop the "
+                "sampling flags (speculative SAMPLING lives in "
                 "generate_speculative, not the batcher yet)"
             )
         from tfde_tpu.inference.server import SpeculativeContinuousBatcher
@@ -120,7 +133,8 @@ def main(argv=None):
     else:
         srv = ContinuousBatcher(
             model, params, batch_size=args.batch_size, max_len=args.max_len,
-            temperature=args.temperature, eos_id=args.eos_id,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, min_p=args.min_p, eos_id=args.eos_id,
         )
     tok = None
     if args.tokenizer:
