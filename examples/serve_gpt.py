@@ -49,6 +49,9 @@ def main(argv=None):
     parser.add_argument("--top-k", type=int, default=None)
     parser.add_argument("--top-p", type=float, default=None)
     parser.add_argument("--min-p", type=float, default=None)
+    parser.add_argument("--repetition-penalty", type=float, default=1.0,
+                        help="CTRL rule over each row's prompt+output "
+                             "(1.0 = off); acts under greedy decoding too")
     parser.add_argument("--eos-id", type=int, default=None)
     parser.add_argument("--num-draft", type=int, default=0, metavar="K",
                         help="serve through SpeculativeContinuousBatcher "
@@ -104,7 +107,7 @@ def main(argv=None):
             "filters would be silent no-ops)"
         )
     if args.num_draft > 0:
-        if sampling_flags:
+        if sampling_flags or args.repetition_penalty != 1.0:
             raise ValueError(
                 "--num-draft serves the greedy verifier; drop the "
                 "sampling flags (speculative SAMPLING lives in "
@@ -134,7 +137,9 @@ def main(argv=None):
         srv = ContinuousBatcher(
             model, params, batch_size=args.batch_size, max_len=args.max_len,
             temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, min_p=args.min_p, eos_id=args.eos_id,
+            top_p=args.top_p, min_p=args.min_p,
+            repetition_penalty=args.repetition_penalty,
+            eos_id=args.eos_id,
         )
     tok = None
     if args.tokenizer:

@@ -254,3 +254,25 @@ def test_prompt_buckets():
     assert ids[0, 3:].tolist() == [0] * 5
     ids, last = _bucketed(np.arange(9), (8, 16), pad_id=0)
     assert ids.shape == (1, 16) and last == 8
+
+
+def test_batcher_repetition_penalty_no_repeats(rng):
+    """repetition_penalty at extreme strength: every token a request emits
+    is distinct from its prompt and its own prior output, across
+    admission recycling — the presence mask resets per row."""
+    model = gpt_tiny_test()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48,
+                            repetition_penalty=1e9)
+    prompts = {}
+    for i in range(5):
+        p = rng.integers(0, model.vocab_size, int(rng.integers(2, 6)))
+        rid = srv.submit(p, 8)
+        prompts[rid] = list(p)
+    done = srv.run()
+    assert len(done) == 5
+    for rid, toks in done:
+        emitted = list(prompts[rid])
+        for t in toks:
+            assert t not in emitted, (rid, t, emitted)
+            emitted.append(int(t))

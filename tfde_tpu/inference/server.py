@@ -163,6 +163,7 @@ class ContinuousBatcher:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         min_p: Optional[float] = None,
+        repetition_penalty: float = 1.0,
         eos_id: Optional[int] = None,
         pad_id: int = 0,
         rng: Optional[jax.Array] = None,
@@ -170,6 +171,11 @@ class ContinuousBatcher:
     ):
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        if repetition_penalty <= 0.0:
+            raise ValueError(
+                f"repetition_penalty must be > 0 (1.0 = off), got "
+                f"{repetition_penalty}"
+            )
         self._buckets = _normalize_buckets(prompt_buckets, max_len)
         self._decode_model = _decode_clone(model)
         self._model = model
@@ -179,7 +185,17 @@ class ContinuousBatcher:
         self._sample = functools.partial(
             sample_logits, temperature=temperature, top_k=top_k,
             top_p=top_p, min_p=min_p,
+            repetition_penalty=repetition_penalty,
         )
+        # presence mask for the repetition penalty (per row, prompt ids
+        # included — the generate() convention); lives ON DEVICE and is
+        # updated with .at scatters, so steady-state ticks ship no
+        # [B, vocab] host copies
+        self._seen = (
+            jnp.zeros((batch_size, model.vocab_size), bool)
+            if repetition_penalty != 1.0 else None
+        )
+        self._vocab = model.vocab_size
         self._eos = eos_id
         self._pad = pad_id
         self._rng = rng if rng is not None else jax.random.key(0)
@@ -213,6 +229,16 @@ class ContinuousBatcher:
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
+        if self._seen is not None and (
+                prompt.min() < 0 or prompt.max() >= self._vocab):
+            # queue-time, not admission-time (the _normalize_buckets rule):
+            # the presence-mask scatter would IndexError (or a negative id
+            # silently wrap to the wrong vocab entry) once admitted
+            raise ValueError(
+                f"prompt ids must lie in [0, {self._vocab}) when "
+                f"repetition_penalty is on; got "
+                f"[{int(prompt.min())}, {int(prompt.max())}]"
+            )
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -251,7 +277,10 @@ class ContinuousBatcher:
             jnp.asarray(self._tok, jnp.int32),
         )
         self._rng, sub = jax.random.split(self._rng)
-        toks = np.asarray(self._sample(logits, sub))
+        toks = np.asarray(self._sample(logits, sub, seen=self._seen))
+        if self._seen is not None:
+            act = np.asarray(active)
+            self._seen = self._seen.at[act, toks[act]].set(True)
         for r in active:
             # feeding tok[r] committed it; the new sample is now pending
             self._committed[r] += 1
@@ -277,6 +306,8 @@ class ContinuousBatcher:
             self._out[r] = []
             self._committed[r] = 0
             self._tok[r] = self._pad
+            if self._seen is not None:
+                self._seen = self._seen.at[r].set(False)
             self._indices_dirty = True
             return [done]
         return []
@@ -304,8 +335,19 @@ class ContinuousBatcher:
                     self._cache, row_cache, jnp.int32(r)
                 )
                 self._indices_dirty = True
+                if self._seen is not None:
+                    self._seen = (
+                        self._seen.at[r].set(False)
+                        .at[r, jnp.asarray(prompt)].set(True)
+                    )
                 self._rng, sub = jax.random.split(self._rng)
-                t = int(np.asarray(self._sample(logits, sub))[0])
+                t = int(np.asarray(self._sample(
+                    logits, sub,
+                    seen=(None if self._seen is None
+                          else self._seen[r:r + 1]),
+                ))[0])
+                if self._seen is not None:
+                    self._seen = self._seen.at[r, t].set(True)
                 self._req[r] = rid
                 self._out[r] = []
                 self._budget[r] = budget
