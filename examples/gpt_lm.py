@@ -105,6 +105,10 @@ def main(argv=None):
     parser.add_argument("--rope", action="store_true",
                         help="rotary position embeddings instead of the "
                              "learned GPT-2 table (ops/rotary.py)")
+    parser.add_argument("--sliding-window", type=int, default=0,
+                        help="Mistral-style sliding-window attention: each "
+                             "position attends the last N only (composes "
+                             "with --kv-heads, --rope, --seq-parallel)")
     parser.add_argument("--tiny", action="store_true")
     parser.add_argument("--remat", nargs="?", const="full", default=False,
                         choices=["full", "dots"])
@@ -122,6 +126,11 @@ def main(argv=None):
         raise ValueError(
             "--rope applies to the GPT decoder; PipelinedLM keeps its "
             "learned positions (drop --pipeline to use rotary)"
+        )
+    if args.sliding_window > 0 and args.pipeline > 1:
+        raise ValueError(
+            "--sliding-window applies to the GPT decoder; the banded ring "
+            "does not ride the pipeline yet (drop --pipeline)"
         )
     if args.kv_heads > 0 and args.pipeline > 1:
         raise ValueError(
@@ -198,6 +207,8 @@ def main(argv=None):
             model_kw["position"] = "rope"
         if args.kv_heads > 0:
             model_kw["num_kv_heads"] = args.kv_heads
+        if args.sliding_window > 0:
+            model_kw["sliding_window"] = args.sliding_window
         model = (
             gpt_tiny_test(remat=args.remat, **model_kw) if args.tiny
             else GPT2Small(remat=args.remat, **model_kw)

@@ -109,8 +109,9 @@ def main(argv=None):
     if args.num_draft > 0:
         if sampling_flags or args.repetition_penalty != 1.0:
             raise ValueError(
-                "--num-draft serves the greedy verifier; drop the "
-                "sampling flags (speculative SAMPLING lives in "
+                "--num-draft serves the plain greedy verifier; drop "
+                "--temperature/--top-k/--top-p/--min-p/"
+                "--repetition-penalty (speculative SAMPLING lives in "
                 "generate_speculative, not the batcher yet)"
             )
         from tfde_tpu.inference.server import SpeculativeContinuousBatcher

@@ -232,8 +232,10 @@ class ContinuousBatcher:
         if self._seen is not None and (
                 prompt.min() < 0 or prompt.max() >= self._vocab):
             # queue-time, not admission-time (the _normalize_buckets rule):
-            # the presence-mask scatter would IndexError (or a negative id
-            # silently wrap to the wrong vocab entry) once admitted
+            # jnp .at scatters DROP out-of-bounds updates silently, so an
+            # over-vocab id would simply go un-penalized and a negative id
+            # would mark the wrong entry via wraparound — no crash, just
+            # quietly wrong sampling; refuse here instead
             raise ValueError(
                 f"prompt ids must lie in [0, {self._vocab}) when "
                 f"repetition_penalty is on; got "
@@ -336,10 +338,12 @@ class ContinuousBatcher:
                 )
                 self._indices_dirty = True
                 if self._seen is not None:
-                    self._seen = (
-                        self._seen.at[r].set(False)
-                        .at[r, jnp.asarray(prompt)].set(True)
-                    )
+                    # row r is all-False by invariant (_take_token clears
+                    # on completion; init starts zeroed) — only the prompt
+                    # scatter is needed
+                    self._seen = self._seen.at[
+                        r, jnp.asarray(prompt)
+                    ].set(True)
                 self._rng, sub = jax.random.split(self._rng)
                 t = int(np.asarray(self._sample(
                     logits, sub,
