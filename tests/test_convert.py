@@ -249,11 +249,11 @@ def test_llama_converted_generates_like_hf(hf_llama, rng):
 
 
 def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma,
-                                  hf_qwen2, hf_phi):
+                                  hf_qwen2, hf_phi, hf_neox):
     """Converted trees must match the models' own init structure exactly —
     a missing/extra leaf means a silently unconverted weight."""
-    from tfde_tpu.models.convert import (gemma_from_hf, phi_from_hf,
-                                         qwen2_from_hf)
+    from tfde_tpu.models.convert import (gemma_from_hf, neox_from_hf,
+                                         phi_from_hf, qwen2_from_hf)
 
     for hf, conv, sample in (
         (hf_gpt2, gpt2_from_hf, jnp.zeros((1, 8), jnp.int32)),
@@ -262,6 +262,7 @@ def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma,
         (hf_gemma, gemma_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_qwen2, qwen2_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_phi, phi_from_hf, jnp.zeros((1, 8), jnp.int32)),
+        (hf_neox, neox_from_hf, jnp.zeros((1, 8), jnp.int32)),
     ):
         model, params = conv(hf, dtype=jnp.float32)
         ref = model.init(jax.random.key(0), sample)["params"]
@@ -456,6 +457,77 @@ def test_phi_converted_generates_like_hf(hf_phi, rng):
     prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
     with torch.no_grad():
         ref = hf_phi.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+@pytest.fixture(scope="module")
+def hf_neox():
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.5,
+        use_parallel_residual=True, attention_dropout=0.0,
+        hidden_dropout=0.0,
+    )
+    torch.manual_seed(8)
+    m = transformers.GPTNeoXForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_neox_logits_match(hf_neox, rng):
+    """NeoX/Pythia = parallel residual with separate attn/MLP LayerNorms
+    (norm_style='parallel2') + 50%-partial rotary + per-head-interleaved
+    fused qkv, de-interleaved at conversion + untied bias-free head."""
+    from tfde_tpu.models.convert import neox_from_hf
+
+    model, params = neox_from_hf(hf_neox, dtype=jnp.float32)
+    assert model.norm_style == "parallel2" and not model.tie_embeddings
+    assert model.rope_dim == 4  # 0.5 * head_dim(8)
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_neox(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    # exact-gelu (HF neox) vs tanh-gelu (ours): ~1e-3 delta, BERT precedent
+    np.testing.assert_allclose(ours, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_neox_sequential_residual_maps_to_pre(rng):
+    """use_parallel_residual=False NeoX checkpoints are plain pre-LN —
+    the converter maps them to norm_style='pre' and still logit-matches."""
+    from tfde_tpu.models.convert import neox_from_hf
+
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=53, hidden_size=16, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=32, rotary_pct=0.25,
+        use_parallel_residual=False, attention_dropout=0.0,
+        hidden_dropout=0.0,
+    )
+    torch.manual_seed(9)
+    hf = transformers.GPTNeoXForCausalLM(cfg)
+    hf.eval()
+    model, params = neox_from_hf(hf, dtype=jnp.float32)
+    assert model.norm_style == "pre"
+    ids = rng.integers(0, 53, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_neox_converted_generates_like_hf(hf_neox, rng):
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import neox_from_hf
+
+    model, params = neox_from_hf(hf_neox, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_neox.generate(
             torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
             do_sample=False, pad_token_id=0,
         ).numpy()

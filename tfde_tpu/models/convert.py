@@ -390,6 +390,126 @@ def phi_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def neox_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers GPTNeoXForCausalLM (the Pythia
+    family).
+
+    The NeoX arrangement: parallel residual with SEPARATE attention/MLP
+    LayerNorms (`norm_style='parallel2'`; use_parallel_residual=False
+    checkpoints map to plain 'pre'), 25%-partial rotary
+    (`rope_dim = rotary_pct * head_dim`), biased projections, untied
+    bias-free embed_out head. The fused query_key_value weight is
+    PER-HEAD interleaved ([heads, 3, head_dim, hidden]) — de-interleaved
+    here into the three projection kernels.
+
+    Known approximation: NeoX runs exact erf-gelu; our Mlp uses the
+    tanh approximation (~1e-3 activation delta, same as the BERT
+    converter — the logit-match test bounds it)."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    if getattr(cfg, "rope_scaling", None):
+        raise NotImplementedError(
+            f"rope_scaling={cfg.rope_scaling!r} is not supported; only "
+            f"plain rotary_emb_base checkpoints convert today"
+        )
+    if getattr(cfg, "hidden_act", "gelu") not in ("gelu", "gelu_new",
+                                                  "gelu_pytorch_tanh"):
+        raise NotImplementedError(
+            f"hidden_act {cfg.hidden_act!r} is not supported (expected a "
+            f"gelu variant)"
+        )
+    if not bool(getattr(cfg, "attention_bias", True)):
+        raise NotImplementedError(
+            "attention_bias=False NeoX checkpoints are not supported (the "
+            "converter maps the biased arrangement every Pythia release "
+            "ships)"
+        )
+    heads = cfg.num_attention_heads
+    hidden = cfg.hidden_size
+    hd = hidden // heads
+    rope_dim = int(hd * cfg.rotary_pct)
+    tied = bool(getattr(cfg, "tie_word_embeddings", False))
+    if tied:
+        raise NotImplementedError(
+            "tied-embedding NeoX checkpoints are not supported (every "
+            "Pythia release unties embed_out); the tied head would drop "
+            "embed_out.weight silently"
+        )
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.num_hidden_layers,
+        num_heads=heads,
+        mlp_dim=cfg.intermediate_size,
+        max_position=cfg.max_position_embeddings,
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        position="rope",
+        rope_theta=float(getattr(cfg, "rotary_emb_base", 10_000.0)),
+        rope_dim=None if rope_dim == hd else rope_dim,
+        norm="layer",
+        norm_style=("parallel2" if cfg.use_parallel_residual else "pre"),
+        mlp_act="gelu",
+        use_bias=True,
+        tie_embeddings=False,
+        ln_eps=cfg.layer_norm_eps,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    pre = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+    if "embed_out.weight" not in sd:
+        raise NotImplementedError(
+            "pass a GPTNeoXForCausalLM (with its embed_out head); a bare "
+            "GPTNeoXModel has no LM head to map"
+        )
+    params = {
+        "wte": {"embedding": sd[f"{pre}embed_in.weight"]},
+        "decoder": {
+            "ln_final": {"scale": sd[f"{pre}final_layer_norm.weight"],
+                         "bias": sd[f"{pre}final_layer_norm.bias"]},
+        },
+        "lm_head": {"kernel": sd["embed_out.weight"].T},
+    }
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}layers.{i}."
+        # [3H, H] rows are per-head interleaved: head h's q, then k, then v
+        qkv_w = sd[h + "attention.query_key_value.weight"].reshape(
+            heads, 3, hd, hidden
+        )
+        qkv_b = sd[h + "attention.query_key_value.bias"].reshape(
+            heads, 3, hd
+        )
+
+        def proj(j):
+            # [heads, hd, hidden] -> in-major [hidden, heads, hd]
+            return {"kernel": qkv_w[:, j].transpose(2, 0, 1),
+                    "bias": qkv_b[:, j]}
+
+        params["decoder"][f"block_{i}"] = {
+            "ln_attn": {"scale": sd[h + "input_layernorm.weight"],
+                        "bias": sd[h + "input_layernorm.bias"]},
+            "ln_mlp": {"scale": sd[h + "post_attention_layernorm.weight"],
+                       "bias": sd[h + "post_attention_layernorm.bias"]},
+            "attn": {
+                "query": proj(0),
+                "key": proj(1),
+                "value": proj(2),
+                "out": {"kernel": sd[h + "attention.dense.weight"].T
+                        .reshape(heads, hd, hidden),
+                        "bias": sd[h + "attention.dense.bias"]},
+            },
+            "mlp": {
+                "fc1": {"kernel": sd[h + "mlp.dense_h_to_4h.weight"].T,
+                        "bias": sd[h + "mlp.dense_h_to_4h.bias"]},
+                "fc2": {"kernel": sd[h + "mlp.dense_4h_to_h.weight"].T,
+                        "bias": sd[h + "mlp.dense_4h_to_h.bias"]},
+            },
+        }
+    return model, params
+
+
 def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(Bert, params) from a transformers BertForMaskedLM (or BertModel —
     then the MLM head params initialize to the identity transform)."""
@@ -738,6 +858,7 @@ _FAMILIES = {
     "bert-classifier": ("BertForSequenceClassification",
                         "bert_classifier_from_hf"),
     "phi": ("PhiForCausalLM", "phi_from_hf"),
+    "neox": ("GPTNeoXForCausalLM", "neox_from_hf"),
 }
 
 
@@ -809,7 +930,7 @@ def load_converted(artifact_dir: str, dtype=None):
     from tfde_tpu.models.gpt import GPT
 
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
-           "qwen2": GPT, "phi": GPT, "bert": Bert,
+           "qwen2": GPT, "phi": GPT, "neox": GPT, "bert": Bert,
            "bert-classifier": BertClassifier}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:

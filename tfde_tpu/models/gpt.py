@@ -64,7 +64,8 @@ class GPT(nn.Module):
     # Qwen2: biased q/k/v projections beside bias-free out/MLP
     qkv_bias: bool = False
     # 'pre' (GPT-2/LLaMA) | 'parallel' (Phi: one LN per block, attention
-    # and MLP side by side on it)
+    # and MLP side by side on it) | 'parallel2' (GPT-NeoX/Pythia: parallel
+    # residual with separate attention/MLP LayerNorms)
     norm_style: str = "pre"
     # Phi: the untied lm_head carries a bias
     head_bias: bool = False

@@ -361,8 +361,9 @@ class TransformerBlock(nn.Module):
     fused_qkv: bool = False  # one-GEMM qkv projection (MultiHeadAttention)
     quant: Optional[str] = None  # int8 serving twins (MultiHeadAttention)
     window: Optional[int] = None  # sliding window (MultiHeadAttention)
-    norm_style: str = "pre"  # 'pre' | 'post' | 'parallel' (Phi: one LN,
-    #                          x + attn(ln(x)) + mlp(ln(x)))
+    norm_style: str = "pre"
+    # 'pre' | 'post' | 'parallel' (Phi: one LN, x + attn(ln(x)) + mlp(ln(x)))
+    # | 'parallel2' (NeoX/Pythia: parallel residual, separate attn/MLP LNs)
     norm: str = "layer"  # 'layer' | 'rms' (LLaMA: scale-only, no bias)
     mlp_act: str = "gelu"  # Mlp.act
     use_bias: bool = True
@@ -453,9 +454,16 @@ class TransformerBlock(nn.Module):
             # no serial dependency, so XLA overlaps them freely
             y = ln(name="ln_attn")(x).astype(self.dtype)
             return x + attn(y, mask=mask, train=train) + mlp(y, train=train)
+        if self.norm_style == "parallel2":
+            # the GPT-NeoX/Pythia arrangement: parallel residual like Phi,
+            # but attention and MLP each get their OWN LayerNorm
+            ya = ln(name="ln_attn")(x).astype(self.dtype)
+            ym = ln(name="ln_mlp")(x).astype(self.dtype)
+            return (x + attn(ya, mask=mask, train=train)
+                    + mlp(ym, train=train))
         raise ValueError(
-            f"norm_style must be 'pre', 'post' or 'parallel', got "
-            f"{self.norm_style!r}"
+            f"norm_style must be 'pre', 'post', 'parallel' or 'parallel2', "
+            f"got {self.norm_style!r}"
         )
 
 
