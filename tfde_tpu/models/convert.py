@@ -844,6 +844,169 @@ def llama_to_hf(model, params):
     return hf
 
 
+def phi_to_hf(model, params):
+    """A transformers PhiForCausalLM carrying `params` — the inverse of
+    `phi_from_hf` (parallel blocks, partial rotary, biased everything)."""
+    import transformers
+
+    if (model.position != "rope" or model.norm != "layer"
+            or model.mlp_act != "gelu" or model.tie_embeddings
+            or not model.use_bias or not model.head_bias
+            or model.norm_style != "parallel"
+            or model.sliding_window is not None
+            or model.embed_scale is not None):
+        raise NotImplementedError(
+            "phi_to_hf requires the Phi arrangement (parallel blocks, "
+            "LayerNorm, gelu, biased projections and head, untied) — "
+            "other families export via gpt2_to_hf/llama_to_hf or stay "
+            "native"
+        )
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = model.head_dim or hidden // heads
+    kv = model.num_kv_heads or heads
+    cfg = transformers.PhiConfig(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        num_key_value_heads=kv, intermediate_size=model.mlp_dim,
+        max_position_embeddings=model.max_position,
+        rope_theta=model.rope_theta,
+        partial_rotary_factor=(model.rope_dim or hd) / hd,
+        layer_norm_eps=model.ln_eps, tie_word_embeddings=False,
+        attention_dropout=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+    )
+    hf = transformers.PhiForCausalLM(cfg)
+    sd = {}
+    sd["model.embed_tokens.weight"] = _t(params["wte"]["embedding"])
+    dec = params["decoder"]
+    sd["model.final_layernorm.weight"] = _t(dec["ln_final"]["scale"])
+    sd["model.final_layernorm.bias"] = _t(dec["ln_final"]["bias"])
+    sd["lm_head.weight"] = _t(np.asarray(params["lm_head"]["kernel"]).T)
+    sd["lm_head.bias"] = _t(params["lm_head"]["bias"])
+    for i in range(model.depth):
+        blk = dec[f"block_{i}"]
+        h = f"model.layers.{i}."
+        sd[h + "input_layernorm.weight"] = _t(blk["ln_attn"]["scale"])
+        sd[h + "input_layernorm.bias"] = _t(blk["ln_attn"]["bias"])
+        a = blk["attn"]
+        for ours, theirs, n in (("query", "q_proj", heads),
+                                ("key", "k_proj", kv),
+                                ("value", "v_proj", kv)):
+            sd[h + f"self_attn.{theirs}.weight"] = _t(
+                np.asarray(a[ours]["kernel"]).reshape(hidden, n * hd).T
+            )
+            sd[h + f"self_attn.{theirs}.bias"] = _t(
+                np.asarray(a[ours]["bias"]).reshape(n * hd)
+            )
+        sd[h + "self_attn.dense.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(heads * hd, hidden).T
+        )
+        sd[h + "self_attn.dense.bias"] = _t(a["out"]["bias"])
+        sd[h + "mlp.fc1.weight"] = _t(np.asarray(blk["mlp"]["fc1"]["kernel"]).T)
+        sd[h + "mlp.fc1.bias"] = _t(blk["mlp"]["fc1"]["bias"])
+        sd[h + "mlp.fc2.weight"] = _t(np.asarray(blk["mlp"]["fc2"]["kernel"]).T)
+        sd[h + "mlp.fc2.bias"] = _t(blk["mlp"]["fc2"]["bias"])
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "rotary_emb" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
+def neox_to_hf(model, params):
+    """A transformers GPTNeoXForCausalLM carrying `params` — the inverse
+    of `neox_from_hf`: the three projection kernels re-interleave into
+    the per-head fused query_key_value weight."""
+    import transformers
+
+    if (model.position != "rope" or model.norm != "layer"
+            or model.mlp_act != "gelu" or model.tie_embeddings
+            or not model.use_bias or model.head_bias
+            or model.norm_style not in ("parallel2", "pre")
+            or model.sliding_window is not None
+            or model.embed_scale is not None
+            or (model.num_kv_heads not in (None, model.num_heads))):
+        raise NotImplementedError(
+            "neox_to_hf requires the NeoX arrangement (parallel2/pre "
+            "blocks, LayerNorm, gelu, biased projections, untied "
+            "bias-free head, MHA) — other families export via their own "
+            "inverses or stay native"
+        )
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = model.head_dim or hidden // heads
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        intermediate_size=model.mlp_dim,
+        max_position_embeddings=model.max_position,
+        rotary_emb_base=model.rope_theta,
+        rotary_pct=(model.rope_dim or hd) / hd,
+        use_parallel_residual=model.norm_style == "parallel2",
+        layer_norm_eps=model.ln_eps, tie_word_embeddings=False,
+        attention_dropout=0.0, hidden_dropout=0.0,
+        # our Mlp 'gelu' IS the tanh approximation — export the matching
+        # activation so round-trip logits stay exact (plain 'gelu' in HF
+        # is the erf form, a silent ~1e-3 drift)
+        hidden_act="gelu_pytorch_tanh",
+    )
+    hf = transformers.GPTNeoXForCausalLM(cfg)
+    sd = {}
+    sd["gpt_neox.embed_in.weight"] = _t(params["wte"]["embedding"])
+    dec = params["decoder"]
+    sd["gpt_neox.final_layer_norm.weight"] = _t(dec["ln_final"]["scale"])
+    sd["gpt_neox.final_layer_norm.bias"] = _t(dec["ln_final"]["bias"])
+    sd["embed_out.weight"] = _t(np.asarray(params["lm_head"]["kernel"]).T)
+    for i in range(model.depth):
+        blk = dec[f"block_{i}"]
+        h = f"gpt_neox.layers.{i}."
+        sd[h + "input_layernorm.weight"] = _t(blk["ln_attn"]["scale"])
+        sd[h + "input_layernorm.bias"] = _t(blk["ln_attn"]["bias"])
+        sd[h + "post_attention_layernorm.weight"] = _t(
+            blk["ln_mlp"]["scale"]
+        )
+        sd[h + "post_attention_layernorm.bias"] = _t(blk["ln_mlp"]["bias"])
+        a = blk["attn"]
+        # [hidden, heads, hd] kernels -> per-head interleaved [3H, hidden]
+        qkv_w = np.stack(
+            [np.asarray(a[n]["kernel"]).transpose(1, 2, 0)
+             for n in ("query", "key", "value")], axis=1,
+        )  # [heads, 3, hd, hidden]
+        qkv_b = np.stack(
+            [np.asarray(a[n]["bias"]) for n in ("query", "key", "value")],
+            axis=1,
+        )  # [heads, 3, hd]
+        sd[h + "attention.query_key_value.weight"] = _t(
+            qkv_w.reshape(3 * hidden, hidden)
+        )
+        sd[h + "attention.query_key_value.bias"] = _t(
+            qkv_b.reshape(3 * hidden)
+        )
+        sd[h + "attention.dense.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(heads * hd, hidden).T
+        )
+        sd[h + "attention.dense.bias"] = _t(a["out"]["bias"])
+        sd[h + "mlp.dense_h_to_4h.weight"] = _t(
+            np.asarray(blk["mlp"]["fc1"]["kernel"]).T
+        )
+        sd[h + "mlp.dense_h_to_4h.bias"] = _t(blk["mlp"]["fc1"]["bias"])
+        sd[h + "mlp.dense_4h_to_h.weight"] = _t(
+            np.asarray(blk["mlp"]["fc2"]["kernel"]).T
+        )
+        sd[h + "mlp.dense_4h_to_h.bias"] = _t(blk["mlp"]["fc2"]["bias"])
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "rotary_emb" not in k
+               and "attention.bias" not in k
+               and "masked_bias" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
 # --------------------------------------------------------------------------
 # CLI: python -m tfde_tpu.models.convert <family> <hf_path> <out_dir>
 # --------------------------------------------------------------------------
@@ -974,11 +1137,15 @@ def _cli(argv=None) -> str:
             hf = gpt2_to_hf(model, params)
         elif args.family in ("llama", "mistral", "qwen2"):
             hf = llama_to_hf(model, params)
+        elif args.family == "phi":
+            hf = phi_to_hf(model, params)
+        elif args.family == "neox":
+            hf = neox_to_hf(model, params)
         else:
             raise SystemExit(
-                f"--reverse supports gpt2/llama/mistral/qwen2, not "
-                f"{args.family!r} (gemma's 1+w norm fold and bert's heads "
-                f"have no registered inverse yet)"
+                f"--reverse supports gpt2/llama/mistral/qwen2/phi/neox, "
+                f"not {args.family!r} (gemma's 1+w norm fold and bert's "
+                f"heads have no registered inverse yet)"
             )
         hf.save_pretrained(args.out_dir)
         print(f"exported {args.family} HF checkpoint -> {args.out_dir}")
