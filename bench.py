@@ -632,6 +632,34 @@ def _bench_flash(clock: _Clock, smoke: bool) -> dict:
     speedups = [v for k_, v in out.items() if k_.startswith("flash_speedup_s")]
     if speedups:
         out["flash_speedup"] = max(speedups)
+
+    # non-causal A/B at the auto tile size: at 128 tiles this measured
+    # 0.87-0.97x (dispatch threshold stayed memory-motivated at S>=4096);
+    # the 512-tile default may flip it — this measurement decides whether
+    # the non-causal threshold drops (round-5 queue, BASELINE.md)
+    def nc_ref_loss(q, k, v):
+        return reference_attention(q, k, v).astype(jnp.float32).sum()
+
+    def nc_flash_loss(q, k, v):
+        return flash_attention(q, k, v, interpret=interpret).astype(
+            jnp.float32).sum()
+
+    nc_ref_g = jax.jit(jax.grad(nc_ref_loss, argnums=(0, 1, 2)))
+    nc_fl_g = jax.jit(jax.grad(nc_flash_loss, argnums=(0, 1, 2)))
+    for b, s in ((2, 4096),):
+        try:
+            q, k, v = make_qkv(b, s, 12, 64)
+            clock.fetch_scalar(
+                nc_ref_g(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32)
+            )
+            clock.fetch_scalar(
+                nc_fl_g(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32)
+            )
+            t_ref = time_impl(nc_ref_g, q, k, v)
+            t_fl = time_impl(nc_fl_g, q, k, v)
+            out[f"flash_nc_speedup_s{s}"] = round(t_ref / t_fl, 3)
+        except Exception as e:
+            out[f"flash_nc_error_s{s}"] = f"{type(e).__name__}: {e}"[:200]
     return out
 
 
@@ -685,7 +713,11 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
                     max_position=seq, dropout_rate=0.0, attn_impl="flash")
         warmup = 2
     else:
-        seq, per_chip_batch = 4096, 1
+        # gpt_long2 (b=2): the round-5 batch lever question — b=1 measured
+        # ~20% MFU after the 512-tile flip; doubling tokens/step may lift
+        # the h=768 GEMM efficiency term
+        seq = 4096
+        per_chip_batch = 2 if prefix == "gpt_long2" else 1
         model = GPT(max_position=seq, dropout_rate=0.0)  # GPT-2 small dims
         warmup = 2
     global_batch = per_chip_batch * n_chips
@@ -983,6 +1015,9 @@ def run_mode() -> None:
         ("gpt_medium", lambda: _bench_gpt_long(clock, strategy, n_chips,
                                                peak, smoke,
                                                prefix="gpt_medium")),
+        ("gpt_long2", lambda: _bench_gpt_long(clock, strategy, n_chips,
+                                              peak, smoke,
+                                              prefix="gpt_long2")),
         ("decode", lambda: _bench_decode(clock, smoke)),
         ("serve", lambda: _bench_serve(clock, smoke)),
     ]

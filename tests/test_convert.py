@@ -552,7 +552,19 @@ def test_roundtrip_phi_neox_to_hf(family, hf_phi, hf_neox, rng):
         model, params = phi_from_hf(hf, dtype=jnp.float32)
         hf2 = phi_to_hf(model, params)
     else:
-        hf = hf_neox
+        # a tanh-gelu source, so the round trip tests the invariant to_hf
+        # provides (exact equality to OUR math; an erf-gelu original
+        # differs by the documented ~1e-3 import approximation)
+        cfg = transformers.GPTNeoXConfig(
+            vocab_size=101, hidden_size=32, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, rotary_pct=0.5,
+            use_parallel_residual=True, attention_dropout=0.0,
+            hidden_dropout=0.0, hidden_act="gelu_pytorch_tanh",
+        )
+        torch.manual_seed(8)
+        hf = transformers.GPTNeoXForCausalLM(cfg)
+        hf.eval()
         model, params = neox_from_hf(hf, dtype=jnp.float32)
         hf2 = neox_to_hf(model, params)
     vocab = hf.config.vocab_size

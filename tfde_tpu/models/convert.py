@@ -854,7 +854,8 @@ def phi_to_hf(model, params):
             or not model.use_bias or not model.head_bias
             or model.norm_style != "parallel"
             or model.sliding_window is not None
-            or model.embed_scale is not None):
+            or model.embed_scale is not None
+            or model.head_dim is not None):
         raise NotImplementedError(
             "phi_to_hf requires the Phi arrangement (parallel blocks, "
             "LayerNorm, gelu, biased projections and head, untied) — "
@@ -927,6 +928,7 @@ def neox_to_hf(model, params):
             or model.norm_style not in ("parallel2", "pre")
             or model.sliding_window is not None
             or model.embed_scale is not None
+            or model.head_dim is not None
             or (model.num_kv_heads not in (None, model.num_heads))):
         raise NotImplementedError(
             "neox_to_hf requires the NeoX arrangement (parallel2/pre "
