@@ -616,14 +616,16 @@ def _bench_flash(clock: _Clock, smoke: bool) -> dict:
         )
         return window / reps
 
+    def ab_pair(g_ref, g_fl, q, k, v):
+        """Warm both compiled grads, then time each — the ONE A/B
+        protocol for causal and non-causal sweeps."""
+        clock.fetch_scalar(g_ref(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32))
+        clock.fetch_scalar(g_fl(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32))
+        return time_impl(g_ref, q, k, v), time_impl(g_fl, q, k, v)
+
     for b, s in ((4, 2048), (2, 4096), (1, 8192)):
         try:
-            q, k, v = make_qkv(b, s, 12, 64)
-            # compile + warm both before timing either
-            clock.fetch_scalar(ref_g(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32))
-            clock.fetch_scalar(fl_g(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32))
-            t_ref = time_impl(ref_g, q, k, v)
-            t_fl = time_impl(fl_g, q, k, v)
+            t_ref, t_fl = ab_pair(ref_g, fl_g, *make_qkv(b, s, 12, 64))
             out[f"flash_speedup_s{s}"] = round(t_ref / t_fl, 3)
             out[f"flash_ref_ms_s{s}"] = round(t_ref * 1e3, 3)
             out[f"flash_ms_s{s}"] = round(t_fl * 1e3, 3)
@@ -636,7 +638,9 @@ def _bench_flash(clock: _Clock, smoke: bool) -> dict:
     # non-causal A/B at the auto tile size: at 128 tiles this measured
     # 0.87-0.97x (dispatch threshold stayed memory-motivated at S>=4096);
     # the 512-tile default may flip it — this measurement decides whether
-    # the non-causal threshold drops (round-5 queue, BASELINE.md)
+    # the non-causal threshold drops (round-5 queue, BASELINE.md). ONE
+    # warm+time protocol (ab_pair) serves the causal sweep above and this,
+    # so the two stay comparable.
     def nc_ref_loss(q, k, v):
         return reference_attention(q, k, v).astype(jnp.float32).sum()
 
@@ -644,22 +648,16 @@ def _bench_flash(clock: _Clock, smoke: bool) -> dict:
         return flash_attention(q, k, v, interpret=interpret).astype(
             jnp.float32).sum()
 
-    nc_ref_g = jax.jit(jax.grad(nc_ref_loss, argnums=(0, 1, 2)))
-    nc_fl_g = jax.jit(jax.grad(nc_flash_loss, argnums=(0, 1, 2)))
-    for b, s in ((2, 4096),):
-        try:
-            q, k, v = make_qkv(b, s, 12, 64)
-            clock.fetch_scalar(
-                nc_ref_g(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32)
-            )
-            clock.fetch_scalar(
-                nc_fl_g(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32)
-            )
-            t_ref = time_impl(nc_ref_g, q, k, v)
-            t_fl = time_impl(nc_fl_g, q, k, v)
-            out[f"flash_nc_speedup_s{s}"] = round(t_ref / t_fl, 3)
-        except Exception as e:
-            out[f"flash_nc_error_s{s}"] = f"{type(e).__name__}: {e}"[:200]
+    b, s = 2, 4096
+    try:
+        t_ref, t_fl = ab_pair(
+            jax.jit(jax.grad(nc_ref_loss, argnums=(0, 1, 2))),
+            jax.jit(jax.grad(nc_flash_loss, argnums=(0, 1, 2))),
+            *make_qkv(b, s, 12, 64),
+        )
+        out[f"flash_nc_speedup_s{s}"] = round(t_ref / t_fl, 3)
+    except Exception as e:
+        out[f"flash_nc_error_s{s}"] = f"{type(e).__name__}: {e}"[:200]
     return out
 
 

@@ -536,7 +536,7 @@ def test_neox_converted_generates_like_hf(hf_neox, rng):
 
 
 @pytest.mark.parametrize("family", ["phi", "neox"])
-def test_roundtrip_phi_neox_to_hf(family, hf_phi, hf_neox, rng):
+def test_roundtrip_phi_neox_to_hf(family, hf_phi, rng):
     """from_hf -> to_hf for the parallel-block families reconstructs a
     transformers model with identical logits (re-interleaving the NeoX
     fused qkv on the way back)."""

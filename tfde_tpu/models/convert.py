@@ -864,7 +864,7 @@ def phi_to_hf(model, params):
         )
     heads = model.num_heads
     hidden = model.hidden_size
-    hd = model.head_dim or hidden // heads
+    hd = hidden // heads  # head_dim is None past the guard
     kv = model.num_kv_heads or heads
     cfg = transformers.PhiConfig(
         vocab_size=model.vocab_size, hidden_size=hidden,
@@ -938,7 +938,7 @@ def neox_to_hf(model, params):
         )
     heads = model.num_heads
     hidden = model.hidden_size
-    hd = model.head_dim or hidden // heads
+    hd = hidden // heads  # head_dim is None past the guard
     cfg = transformers.GPTNeoXConfig(
         vocab_size=model.vocab_size, hidden_size=hidden,
         num_hidden_layers=model.depth, num_attention_heads=heads,
