@@ -249,11 +249,13 @@ def test_llama_converted_generates_like_hf(hf_llama, rng):
 
 
 def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma,
-                                  hf_qwen2, hf_phi, hf_neox):
+                                  hf_qwen2, hf_phi, hf_neox,
+                                  hf_bigcode):
     """Converted trees must match the models' own init structure exactly —
     a missing/extra leaf means a silently unconverted weight."""
-    from tfde_tpu.models.convert import (gemma_from_hf, neox_from_hf,
-                                         phi_from_hf, qwen2_from_hf)
+    from tfde_tpu.models.convert import (bigcode_from_hf, gemma_from_hf,
+                                         neox_from_hf, phi_from_hf,
+                                         qwen2_from_hf)
 
     for hf, conv, sample in (
         (hf_gpt2, gpt2_from_hf, jnp.zeros((1, 8), jnp.int32)),
@@ -263,6 +265,7 @@ def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma,
         (hf_qwen2, qwen2_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_phi, phi_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_neox, neox_from_hf, jnp.zeros((1, 8), jnp.int32)),
+        (hf_bigcode, bigcode_from_hf, jnp.zeros((1, 8), jnp.int32)),
     ):
         model, params = conv(hf, dtype=jnp.float32)
         ref = model.init(jax.random.key(0), sample)["params"]
@@ -600,3 +603,69 @@ def test_save_converted_roundtrip(tmp_path, rng):
     )
     with pytest.raises(ValueError, match="unknown family"):
         save_converted(model, params, str(tmp_path / "bad"), "nope")
+
+
+@pytest.fixture(scope="module")
+def hf_bigcode():
+    cfg = transformers.GPTBigCodeConfig(
+        vocab_size=101, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+        multi_query=True, attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+    )
+    torch.manual_seed(10)
+    m = transformers.GPTBigCodeForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_bigcode_logits_match(hf_bigcode, rng):
+    """StarCoder = GPT-2 arrangement + multi-query attention; the fused
+    c_attn [q | k | v] rows split into the kv=1 projection kernels.
+    gelu_pytorch_tanh is our exact gelu — tight tolerance."""
+    from tfde_tpu.models.convert import bigcode_from_hf
+
+    model, params = bigcode_from_hf(hf_bigcode, dtype=jnp.float32)
+    assert model.num_kv_heads == 1 and model.position == "learned"
+    assert params["decoder"]["block_0"]["attn"]["key"]["kernel"].shape == (
+        32, 1, 8)
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_bigcode(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bigcode_converted_generates_like_hf(hf_bigcode, rng):
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import bigcode_from_hf
+
+    model, params = bigcode_from_hf(hf_bigcode, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_bigcode.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_bigcode_mha_interleave(rng):
+    """multi_query=False GPTBigCode stores the fused qkv PER-HEAD
+    interleaved (unlike the flat MQA blocks) — converted logits must
+    still match transformers."""
+    from tfde_tpu.models.convert import bigcode_from_hf
+
+    cfg = transformers.GPTBigCodeConfig(
+        vocab_size=53, n_embd=16, n_layer=1, n_head=2, n_positions=32,
+        multi_query=False, attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+    )
+    torch.manual_seed(11)
+    hf = transformers.GPTBigCodeForCausalLM(cfg)
+    hf.eval()
+    model, params = bigcode_from_hf(hf, dtype=jnp.float32)
+    assert model.num_kv_heads == 2  # == heads: classic MHA
+    ids = rng.integers(0, 53, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)

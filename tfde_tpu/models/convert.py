@@ -510,6 +510,102 @@ def neox_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def bigcode_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers GPTBigCodeForCausalLM (the
+    StarCoder family): the GPT-2 arrangement (learned positions,
+    LayerNorm, tanh-gelu — exact for gelu_pytorch_tanh — tied head,
+    biased projections) with MULTI-QUERY attention; the fused c_attn
+    packs [q (H) | k (kv*hd) | v (kv*hd)] rows, split here into the
+    three projection kernels."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    if not bool(getattr(cfg, "scale_attn_weights", True)):
+        raise NotImplementedError(
+            "scale_attn_weights=False checkpoints are not supported (our "
+            "attention always scales by 1/sqrt(head_dim))"
+        )
+    if getattr(cfg, "activation_function",
+               "gelu_pytorch_tanh") not in ("gelu_pytorch_tanh",
+                                            "gelu_new"):
+        # exact-erf 'gelu' would convert with a silent ~1e-3 drift; the
+        # tanh variants match our Mlp exactly
+        raise NotImplementedError(
+            f"activation_function {cfg.activation_function!r} is not "
+            f"supported (expected the tanh-gelu variants "
+            f"gelu_pytorch_tanh/gelu_new, which our Mlp matches exactly)"
+        )
+    heads = cfg.n_head
+    hidden = cfg.n_embd
+    hd = hidden // heads
+    kv = 1 if cfg.multi_query else heads
+    mlp_dim = cfg.n_inner if cfg.n_inner is not None else 4 * hidden
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.n_layer,
+        num_heads=heads,
+        mlp_dim=mlp_dim,
+        max_position=cfg.n_positions,
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        num_kv_heads=kv,
+        ln_eps=cfg.layer_norm_epsilon,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    params = {
+        "wte": {"embedding": sd[f"{pre}wte.weight"]},
+        "wpe": {"embedding": sd[f"{pre}wpe.weight"]},
+        "decoder": {
+            "ln_final": {"scale": sd[f"{pre}ln_f.weight"],
+                         "bias": sd[f"{pre}ln_f.bias"]},
+        },
+    }
+    for i in range(cfg.n_layer):
+        h = f"{pre}h.{i}."
+        # torch Linear [out, in] -> in-major, then split. The two fused
+        # layouts differ: multi_query packs flat [Q (H) | K (hd) | V (hd)]
+        # blocks; classic MHA interleaves PER HEAD ([q_h | k_h | v_h] for
+        # each head — the .view(heads, 3*hd) split in the HF forward)
+        w = sd[h + "attn.c_attn.weight"].T
+        b = sd[h + "attn.c_attn.bias"]
+        if cfg.multi_query:
+            qw, kw, vw = np.split(w, [hidden, hidden + kv * hd], axis=1)
+            qb, kb, vb = np.split(b, [hidden, hidden + kv * hd])
+        else:
+            w4 = w.reshape(hidden, heads, 3, hd)
+            b3 = b.reshape(heads, 3, hd)
+            qw, kw, vw = w4[:, :, 0], w4[:, :, 1], w4[:, :, 2]
+            qb, kb, vb = b3[:, 0], b3[:, 1], b3[:, 2]
+        params["decoder"][f"block_{i}"] = {
+            "ln_attn": {"scale": sd[h + "ln_1.weight"],
+                        "bias": sd[h + "ln_1.bias"]},
+            "ln_mlp": {"scale": sd[h + "ln_2.weight"],
+                       "bias": sd[h + "ln_2.bias"]},
+            "attn": {
+                "query": {"kernel": qw.reshape(hidden, heads, hd),
+                          "bias": qb.reshape(heads, hd)},
+                "key": {"kernel": kw.reshape(hidden, kv, hd),
+                        "bias": kb.reshape(kv, hd)},
+                "value": {"kernel": vw.reshape(hidden, kv, hd),
+                          "bias": vb.reshape(kv, hd)},
+                "out": {"kernel": sd[h + "attn.c_proj.weight"].T
+                        .reshape(heads, hd, hidden),
+                        "bias": sd[h + "attn.c_proj.bias"]},
+            },
+            "mlp": {
+                "fc1": {"kernel": sd[h + "mlp.c_fc.weight"].T,
+                        "bias": sd[h + "mlp.c_fc.bias"]},
+                "fc2": {"kernel": sd[h + "mlp.c_proj.weight"].T,
+                        "bias": sd[h + "mlp.c_proj.bias"]},
+            },
+        }
+    return model, params
+
+
 def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(Bert, params) from a transformers BertForMaskedLM (or BertModel —
     then the MLM head params initialize to the identity transform)."""
@@ -1024,6 +1120,7 @@ _FAMILIES = {
                         "bert_classifier_from_hf"),
     "phi": ("PhiForCausalLM", "phi_from_hf"),
     "neox": ("GPTNeoXForCausalLM", "neox_from_hf"),
+    "bigcode": ("GPTBigCodeForCausalLM", "bigcode_from_hf"),
 }
 
 
@@ -1095,8 +1192,8 @@ def load_converted(artifact_dir: str, dtype=None):
     from tfde_tpu.models.gpt import GPT
 
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
-           "qwen2": GPT, "phi": GPT, "neox": GPT, "bert": Bert,
-           "bert-classifier": BertClassifier}[family]
+           "qwen2": GPT, "phi": GPT, "neox": GPT, "bigcode": GPT,
+           "bert": Bert, "bert-classifier": BertClassifier}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
         z = np.load(io.BytesIO(f.read()))
