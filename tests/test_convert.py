@@ -250,12 +250,12 @@ def test_llama_converted_generates_like_hf(hf_llama, rng):
 
 def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma,
                                   hf_qwen2, hf_phi, hf_neox,
-                                  hf_bigcode):
+                                  hf_bigcode, hf_opt):
     """Converted trees must match the models' own init structure exactly —
     a missing/extra leaf means a silently unconverted weight."""
     from tfde_tpu.models.convert import (bigcode_from_hf, gemma_from_hf,
-                                         neox_from_hf, phi_from_hf,
-                                         qwen2_from_hf)
+                                         neox_from_hf, opt_from_hf,
+                                         phi_from_hf, qwen2_from_hf)
 
     for hf, conv, sample in (
         (hf_gpt2, gpt2_from_hf, jnp.zeros((1, 8), jnp.int32)),
@@ -266,6 +266,7 @@ def test_param_trees_are_complete(hf_gpt2, hf_bert, hf_llama, hf_gemma,
         (hf_phi, phi_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_neox, neox_from_hf, jnp.zeros((1, 8), jnp.int32)),
         (hf_bigcode, bigcode_from_hf, jnp.zeros((1, 8), jnp.int32)),
+        (hf_opt, opt_from_hf, jnp.zeros((1, 8), jnp.int32)),
     ):
         model, params = conv(hf, dtype=jnp.float32)
         ref = model.init(jax.random.key(0), sample)["params"]
@@ -669,3 +670,61 @@ def test_bigcode_mha_interleave(rng):
         ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
     ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.fixture(scope="module")
+def hf_opt():
+    cfg = transformers.OPTConfig(
+        vocab_size=101, hidden_size=32, ffn_dim=96, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        word_embed_proj_dim=32, do_layer_norm_before=True,
+        attention_dropout=0.0, dropout=0.0,
+    )
+    torch.manual_seed(12)
+    m = transformers.OPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_opt_logits_match(hf_opt, rng):
+    """OPT = pre-LN + relu MLP + offset-2 learned positions (the table
+    slice at conversion makes our 0-based lookup identical) + tied head."""
+    from tfde_tpu.models.convert import opt_from_hf
+
+    model, params = opt_from_hf(hf_opt, dtype=jnp.float32)
+    assert model.mlp_act == "relu" and model.tie_embeddings
+    assert params["wpe"]["embedding"].shape == (64, 32)  # offset sliced
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_opt(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_opt_converted_generates_like_hf(hf_opt, rng):
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import opt_from_hf
+
+    model, params = opt_from_hf(hf_opt, dtype=jnp.float32)
+    prompt = rng.integers(1, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_opt.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=1,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_opt_projected_embeddings_refused():
+    from tfde_tpu.models.convert import opt_from_hf
+
+    cfg = transformers.OPTConfig(
+        vocab_size=53, hidden_size=16, ffn_dim=32, num_hidden_layers=1,
+        num_attention_heads=2, max_position_embeddings=32,
+        word_embed_proj_dim=8,
+    )
+    torch.manual_seed(0)
+    m = transformers.OPTForCausalLM(cfg)
+    with pytest.raises(NotImplementedError, match="word_embed_proj_dim"):
+        opt_from_hf(m, dtype=jnp.float32)

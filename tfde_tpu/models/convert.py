@@ -606,6 +606,116 @@ def bigcode_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def opt_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers OPTForCausalLM.
+
+    The OPT arrangement: pre-LN blocks, relu MLP, learned positions with
+    the legacy offset-2 table — handled at conversion by SLICING the
+    first two embedding rows off (position i uses HF row i+2; our
+    0-based lookup then hits the identical vector, no model knob) —
+    biased projections, tied head, final LayerNorm. Projected-embedding
+    checkpoints (word_embed_proj_dim != hidden, e.g. opt-350m, which is
+    also the only post-LN release) are refused."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    if cfg.word_embed_proj_dim != cfg.hidden_size:
+        raise NotImplementedError(
+            f"word_embed_proj_dim {cfg.word_embed_proj_dim} != hidden "
+            f"{cfg.hidden_size}: projected-embedding OPT checkpoints "
+            f"(opt-350m) are not supported"
+        )
+    if not bool(getattr(cfg, "do_layer_norm_before", True)):
+        raise NotImplementedError(
+            "do_layer_norm_before=False (post-LN OPT) is not supported"
+        )
+    if bool(getattr(cfg, "_remove_final_layer_norm", False)):
+        raise NotImplementedError(
+            "_remove_final_layer_norm=True (pre-release metaseq "
+            "conversions) is not supported — the checkpoint has no "
+            "final LayerNorm to map"
+        )
+    if not bool(getattr(cfg, "enable_bias", True)) or not bool(
+            getattr(cfg, "layer_norm_elementwise_affine", True)):
+        raise NotImplementedError(
+            "bias-free / non-affine-LN OPT variants are not supported"
+        )
+    if getattr(cfg, "activation_function", "relu") != "relu":
+        raise NotImplementedError(
+            f"activation_function {cfg.activation_function!r} is not "
+            f"supported (OPT releases use relu)"
+        )
+    if not bool(getattr(cfg, "tie_word_embeddings", True)):
+        raise NotImplementedError(
+            "untied OPT checkpoints are not supported (lm_head.weight "
+            "would be silently dropped)"
+        )
+    heads = cfg.num_attention_heads
+    hidden = cfg.hidden_size
+    hd = hidden // heads
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.num_hidden_layers,
+        num_heads=heads,
+        mlp_dim=cfg.ffn_dim,
+        max_position=cfg.max_position_embeddings,
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        mlp_act="relu",
+        tie_embeddings=True,
+        ln_eps=1e-5,  # torch nn.LayerNorm default, what OPT runs
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    pre = ("model.decoder."
+           if any(k.startswith("model.decoder.") for k in sd)
+           else "decoder." if any(k.startswith("decoder.") for k in sd)
+           else "")
+    params = {
+        "wte": {"embedding": sd[f"{pre}embed_tokens.weight"]},
+        # drop the legacy offset rows: HF looks up row i+2 for position i
+        "wpe": {"embedding": sd[f"{pre}embed_positions.weight"][2:]},
+        "decoder": {
+            "ln_final": {"scale": sd[f"{pre}final_layer_norm.weight"],
+                         "bias": sd[f"{pre}final_layer_norm.bias"]},
+        },
+    }
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}layers.{i}."
+        params["decoder"][f"block_{i}"] = {
+            "ln_attn": {"scale": sd[h + "self_attn_layer_norm.weight"],
+                        "bias": sd[h + "self_attn_layer_norm.bias"]},
+            "ln_mlp": {"scale": sd[h + "final_layer_norm.weight"],
+                       "bias": sd[h + "final_layer_norm.bias"]},
+            "attn": {
+                "query": {"kernel": sd[h + "self_attn.q_proj.weight"].T
+                          .reshape(hidden, heads, hd),
+                          "bias": sd[h + "self_attn.q_proj.bias"]
+                          .reshape(heads, hd)},
+                "key": {"kernel": sd[h + "self_attn.k_proj.weight"].T
+                        .reshape(hidden, heads, hd),
+                        "bias": sd[h + "self_attn.k_proj.bias"]
+                        .reshape(heads, hd)},
+                "value": {"kernel": sd[h + "self_attn.v_proj.weight"].T
+                          .reshape(hidden, heads, hd),
+                          "bias": sd[h + "self_attn.v_proj.bias"]
+                          .reshape(heads, hd)},
+                "out": {"kernel": sd[h + "self_attn.out_proj.weight"].T
+                        .reshape(heads, hd, hidden),
+                        "bias": sd[h + "self_attn.out_proj.bias"]},
+            },
+            "mlp": {
+                "fc1": {"kernel": sd[h + "fc1.weight"].T,
+                        "bias": sd[h + "fc1.bias"]},
+                "fc2": {"kernel": sd[h + "fc2.weight"].T,
+                        "bias": sd[h + "fc2.bias"]},
+            },
+        }
+    return model, params
+
+
 def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(Bert, params) from a transformers BertForMaskedLM (or BertModel —
     then the MLM head params initialize to the identity transform)."""
@@ -1121,6 +1231,7 @@ _FAMILIES = {
     "phi": ("PhiForCausalLM", "phi_from_hf"),
     "neox": ("GPTNeoXForCausalLM", "neox_from_hf"),
     "bigcode": ("GPTBigCodeForCausalLM", "bigcode_from_hf"),
+    "opt": ("OPTForCausalLM", "opt_from_hf"),
 }
 
 
@@ -1193,7 +1304,8 @@ def load_converted(artifact_dir: str, dtype=None):
 
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
            "qwen2": GPT, "phi": GPT, "neox": GPT, "bigcode": GPT,
-           "bert": Bert, "bert-classifier": BertClassifier}[family]
+           "opt": GPT, "bert": Bert,
+           "bert-classifier": BertClassifier}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
         z = np.load(io.BytesIO(f.read()))

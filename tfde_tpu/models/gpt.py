@@ -59,7 +59,8 @@ class GPT(nn.Module):
     # KV cache shrinks by num_heads/num_kv_heads — the serving memory knob
     num_kv_heads: Optional[int] = None
     norm: str = "layer"      # 'layer' | 'rms' (LLaMA)
-    mlp_act: str = "gelu"    # 'gelu' | 'swiglu' (LLaMA) | 'geglu' (Gemma)
+    mlp_act: str = "gelu"    # 'gelu' | 'relu' (OPT) | 'swiglu' (LLaMA) |
+    #                          'geglu' (Gemma)
     use_bias: bool = True    # False: LLaMA bias-free projections
     # Qwen2: biased q/k/v projections beside bias-free out/MLP
     qkv_bias: bool = False

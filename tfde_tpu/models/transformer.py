@@ -320,6 +320,8 @@ class Mlp(nn.Module):
         h = dense(self.mlp_dim, name="fc1")(x)
         if self.act == "gelu":
             h = nn.gelu(h)
+        elif self.act == "relu":
+            h = nn.relu(h)
         elif self.act == "swiglu":
             gate = dense(self.mlp_dim, name="gate")(x)
             h = nn.silu(gate) * h
@@ -330,7 +332,8 @@ class Mlp(nn.Module):
             h = nn.gelu(gate, approximate=True) * h
         else:
             raise ValueError(
-                f"act must be 'gelu', 'swiglu' or 'geglu', got {self.act!r}"
+                f"act must be 'gelu', 'relu', 'swiglu' or 'geglu', got "
+                f"{self.act!r}"
             )
         h = constrain(h, b, "seq", "tensor")
         h = dense(x.shape[-1], name="fc2")(h)
