@@ -215,14 +215,30 @@ def gpt_tiny_test(**kw) -> GPT:
 
 def next_token_loss(state, params, batch, rng):
     """(loss, metrics) for make_custom_train_step: shifted CE over all
-    positions (predict token t+1 from prefix <= t)."""
+    positions (predict token t+1 from prefix <= t).
+
+    Applies with mutable=["losses"] so values the model sows there — the
+    MoE load-balance aux and router z-loss (models/moe.py) — join the
+    objective, matching the default classification path (training/step.py
+    `_forward`). Without this an MoE GPT would train with unbalanced
+    routing: sow() into an immutable collection is a silent no-op. Each
+    sown loss is also surfaced as a metric (summed over layers) so
+    telemetry and the bench can watch router balance.
+    """
     from tfde_tpu.ops.losses import masked_lm_loss
 
     (tokens,) = batch if isinstance(batch, tuple) else (batch,)
-    logits = state.apply_fn(
-        {"params": params}, tokens, train=True, rngs={"dropout": rng}
+    logits, mutated = state.apply_fn(
+        {"params": params}, tokens, train=True, rngs={"dropout": rng},
+        mutable=["losses"],
     )
     # align: logits[:, :-1] predict tokens[:, 1:]
     labels = tokens[:, 1:].astype(jnp.int32)
     loss, acc = masked_lm_loss(logits[:, :-1], labels)
-    return loss, {"next_token_accuracy": acc}
+    metrics = {"next_token_accuracy": acc}
+    from tfde_tpu.training.step import sown_losses_by_name
+
+    for name, total in sown_losses_by_name(mutated.get("losses", {})).items():
+        loss = loss + total
+        metrics[name] = total
+    return loss, metrics

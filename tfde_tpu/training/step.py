@@ -27,6 +27,22 @@ from tfde_tpu.parallel.strategies import Strategy
 from tfde_tpu.training.train_state import TrainState
 
 
+def sown_losses_by_name(mutated_losses) -> dict:
+    """Group everything sown into the 'losses' collection by its final sown
+    name (e.g. 'moe_aux', 'moe_z'), summed across layers. The ONE
+    definition of "every sown loss joins the objective" — used by the
+    default classification path (`_forward`) and the custom-LM path
+    (models/gpt.py `next_token_loss`); sow() into an immutable collection
+    is a silent no-op, so any apply that skips this drops the MoE
+    load-balance term."""
+    by_name: dict = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(mutated_losses):
+        keys = [getattr(p, "key", None) for p in path]
+        name = next((k for k in reversed(keys) if isinstance(k, str)), "aux")
+        by_name[name] = by_name.get(name, 0.0) + jnp.sum(leaf)
+    return by_name
+
+
 def _forward(state: TrainState, params, images, train: bool, dropout_rng=None):
     """Returns (logits, new_batch_stats, aux_loss). aux_loss collects every
     value the model sows into the 'losses' collection (e.g. the MoE
@@ -44,8 +60,7 @@ def _forward(state: TrainState, params, images, train: bool, dropout_rng=None):
             mutable=["batch_stats", "losses"], **kwargs
         )
         aux = sum(
-            jnp.sum(v)
-            for v in jax.tree_util.tree_leaves(mutated.get("losses", {}))
+            sown_losses_by_name(mutated.get("losses", {})).values()
         )
         return logits, mutated.get("batch_stats", state.batch_stats), aux
     logits = state.apply_fn(variables, images, train=train, **kwargs)
