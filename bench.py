@@ -882,8 +882,10 @@ def _bench_moe(clock: _Clock, strategy, n_chips: int, peak: float,
     # layers, so perfectly balanced routing reads n_moe * aux_loss_weight
     # (= 6 * 0.01 here), larger = more collapsed; z-loss shrinking means
     # logit magnitudes are controlled
+    from tfde_tpu.models.moe import MoEMlp
+
     out["moe_aux_balanced_value"] = round(
-        (depth // every) * 0.01, 6  # MoEMlp.aux_loss_weight default
+        (depth // every) * MoEMlp.aux_loss_weight, 6
     )
     for kk in ("moe_aux", "moe_z"):
         if kk in first:
@@ -1449,13 +1451,17 @@ def driver_mode() -> None:
 
     reason = (f"TPU backend unavailable after {attempt} attempts "
               f"within {budget:.0f}s budget")
-    try:
-        fell_back = _emit_fallback(reason, last_rc, last_tail, attempt,
-                                   budget)
-    except Exception as e:  # the always-emit invariant beats any fallback
-        print(f"[bench driver] fallback reporting failed: {e}",
-              file=sys.stderr)
-        fell_back = False
+    # cpu_only is a PERMANENT condition (no TPU plugin on this host), not
+    # a tunnel outage — replaying a committed TPU capture there would
+    # claim "same chip" on a machine that never had one
+    fell_back = False
+    if last_rc != "cpu_only":
+        try:
+            fell_back = _emit_fallback(reason, last_rc, last_tail, attempt,
+                                       budget)
+        except Exception as e:  # the always-emit invariant beats fallback
+            print(f"[bench driver] fallback reporting failed: {e}",
+                  file=sys.stderr)
     if fell_back:
         sys.exit(0)
     print(json.dumps({

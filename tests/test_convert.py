@@ -728,3 +728,148 @@ def test_opt_projected_embeddings_refused():
     m = transformers.OPTForCausalLM(cfg)
     with pytest.raises(NotImplementedError, match="word_embed_proj_dim"):
         opt_from_hf(m, dtype=jnp.float32)
+
+
+@pytest.mark.parametrize("family", ["gemma", "bigcode", "bigcode_mha", "opt"])
+def test_roundtrip_new_families_to_hf(family, hf_gemma, hf_bigcode, hf_opt,
+                                      rng):
+    """from_hf -> to_hf for the families VERDICT r4 flagged as one-way
+    (Gemma's 1+w norm un-fold, StarCoder's two c_attn refusions, OPT's
+    offset-2 table rebuild): the reconstructed transformers model must
+    produce IDENTICAL logits on unpadded input."""
+    from tfde_tpu.models.convert import (
+        bigcode_from_hf,
+        bigcode_to_hf,
+        gemma_from_hf,
+        gemma_to_hf,
+        opt_from_hf,
+        opt_to_hf,
+    )
+
+    if family == "gemma":
+        hf = hf_gemma
+        model, params = gemma_from_hf(hf, dtype=jnp.float32)
+        hf2 = gemma_to_hf(model, params)
+        assert hf2.config.head_dim == 16
+    elif family == "bigcode":
+        hf = hf_bigcode
+        model, params = bigcode_from_hf(hf, dtype=jnp.float32)
+        hf2 = bigcode_to_hf(model, params)
+        assert hf2.config.multi_query
+    elif family == "bigcode_mha":
+        cfg = transformers.GPTBigCodeConfig(
+            vocab_size=53, n_embd=16, n_layer=1, n_head=2, n_positions=32,
+            multi_query=False, attn_pdrop=0.0, embd_pdrop=0.0,
+            resid_pdrop=0.0,
+        )
+        torch.manual_seed(11)
+        hf = transformers.GPTBigCodeForCausalLM(cfg)
+        hf.eval()
+        model, params = bigcode_from_hf(hf, dtype=jnp.float32)
+        hf2 = bigcode_to_hf(model, params)
+        assert not hf2.config.multi_query
+    else:  # opt
+        hf = hf_opt
+        model, params = opt_from_hf(hf, dtype=jnp.float32)
+        hf2 = opt_to_hf(model, params)
+        # offset rows rebuilt: HF table is max_position + 2
+        assert hf2.model.decoder.embed_positions.weight.shape[0] == 66
+
+    vocab = hf.config.vocab_size
+    ids = torch.tensor(rng.integers(0, vocab, (2, 12)).astype(np.int64))
+    with torch.no_grad():
+        a = hf(ids).logits
+        b = hf2(ids).logits
+    assert float((a - b).abs().max()) < 1e-4
+
+
+def test_roundtrip_bert_to_hf(hf_bert, rng):
+    """bert_from_hf -> bert_to_hf: the exported BertForMaskedLM must match
+    OUR forward exactly (both run tanh-gelu); vs the erf-gelu source
+    checkpoint the usual ~1e-3 activation delta applies."""
+    from tfde_tpu.models.convert import bert_to_hf
+
+    model, params = bert_from_hf(hf_bert, dtype=jnp.float32)
+    hf2 = bert_to_hf(model, params)
+    assert hf2.config.hidden_act == "gelu_pytorch_tanh"
+    ids = rng.integers(0, 97, (2, 12)).astype(np.int32)
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hf2(torch.tensor(ids.astype(np.int64))).logits.numpy()
+        src = hf_bert(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(theirs, src, rtol=5e-3, atol=5e-3)
+
+
+def test_roundtrip_bert_classifier_to_hf(rng):
+    from tfde_tpu.models.convert import (
+        bert_classifier_from_hf,
+        bert_classifier_to_hf,
+    )
+
+    cfg = transformers.BertConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, num_labels=3,
+    )
+    torch.manual_seed(6)
+    hf = transformers.BertForSequenceClassification(cfg)
+    hf.eval()
+    model, params = bert_classifier_from_hf(hf, dtype=jnp.float32)
+    hf2 = bert_classifier_to_hf(model, params)
+    assert hf2.config.num_labels == 3
+    ids = rng.integers(0, 97, (2, 12)).astype(np.int32)
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hf2(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+
+def test_new_to_hf_refuse_foreign_arrangements():
+    from tfde_tpu.models.bert import Bert
+    from tfde_tpu.models.convert import (
+        bert_to_hf,
+        bigcode_to_hf,
+        gemma_to_hf,
+        opt_to_hf,
+    )
+    from tfde_tpu.models.gpt import GPT
+
+    llama_ish = GPT(vocab_size=51, hidden_size=16, depth=1, num_heads=2,
+                    mlp_dim=32, max_position=32, position="rope",
+                    norm="rms", mlp_act="swiglu", use_bias=False)
+    with pytest.raises(NotImplementedError, match="Gemma arrangement"):
+        gemma_to_hf(llama_ish, {})
+    with pytest.raises(NotImplementedError, match="StarCoder arrangement"):
+        bigcode_to_hf(llama_ish, {})
+    with pytest.raises(NotImplementedError, match="OPT arrangement"):
+        opt_to_hf(llama_ish, {})
+    padded = Bert(vocab_size=97, hidden_size=32, depth=1, num_heads=2,
+                  mlp_dim=64, max_position=32, pad_vocab=True)
+    with pytest.raises(NotImplementedError, match="pad_vocab"):
+        bert_to_hf(padded, {})
+
+
+def test_convert_cli_reverse_new_family(tmp_path, hf_gemma, rng):
+    """The full deploy-anywhere loop through the CLI for a family VERDICT
+    r4 flagged as one-way: HF dir -> artifact -> --reverse -> a
+    save_pretrained checkpoint transformers reloads with identical
+    logits."""
+    from tfde_tpu.models.convert import _cli
+
+    src = str(tmp_path / "hf")
+    art = str(tmp_path / "artifact")
+    back = str(tmp_path / "exported")
+    hf_gemma.save_pretrained(src)
+    _cli(["gemma", src, art])
+    _cli(["gemma", art, back, "--reverse"])
+    hf2 = transformers.GemmaForCausalLM.from_pretrained(
+        back, local_files_only=True
+    )
+    hf2.eval()
+    ids = torch.tensor(rng.integers(0, 101, (2, 12)).astype(np.int64))
+    with torch.no_grad():
+        a = hf_gemma(ids).logits
+        b = hf2(ids).logits
+    assert float((a - b).abs().max()) < 1e-4

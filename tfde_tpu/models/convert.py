@@ -734,6 +734,7 @@ def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
         num_heads=heads,
         mlp_dim=cfg.intermediate_size,
         max_position=cfg.max_position_embeddings,
+        type_vocab_size=cfg.type_vocab_size,
         dropout_rate=0.0,
         pad_vocab=False,
         dtype=dtype if dtype is not None else jnp.bfloat16,
@@ -947,53 +948,14 @@ def gpt2_to_hf(model, params):
     return hf
 
 
-def llama_to_hf(model, params):
-    """A transformers LlamaForCausalLM (or Qwen2 twin when
-    model.qkv_bias) carrying `params` — the inverse of `llama_from_hf` /
-    `qwen2_from_hf`. Mistral-style `sliding_window` models export as
-    MistralForCausalLM with the window in the config."""
-    import transformers
-
-    if (model.position != "rope" or model.norm != "rms"
-            or model.mlp_act != "swiglu" or model.use_bias
-            or model.embed_scale is not None or model.head_bias
-            or model.norm_style != "pre" or model.rope_dim is not None):
-        raise NotImplementedError(
-            "llama_to_hf requires the LLaMA arrangement (rope — full, not "
-            "partial — RMSNorm, swiglu, bias-free pre-norm blocks, "
-            "unscaled embeddings, bias-free head); Gemma/Phi-style models "
-            "stay native"
-        )
+def _llama_style_sd(model, params) -> dict:
+    """The transformers state dict for a LLaMA-arranged gated-MLP decoder
+    (model.layers.* keys) — shared by `llama_to_hf` (LLaMA/Mistral/Qwen2)
+    and `gemma_to_hf` (which un-folds the zero-centered norms on top)."""
     heads = model.num_heads
     hidden = model.hidden_size
     hd = model.head_dim or hidden // heads
     kv = model.num_kv_heads or heads
-    common = dict(
-        vocab_size=model.vocab_size, hidden_size=hidden,
-        num_hidden_layers=model.depth, num_attention_heads=heads,
-        num_key_value_heads=kv, intermediate_size=model.mlp_dim,
-        max_position_embeddings=model.max_position,
-        rope_theta=model.rope_theta, rms_norm_eps=model.ln_eps,
-        tie_word_embeddings=model.tie_embeddings, attention_dropout=0.0,
-    )
-    if model.qkv_bias:
-        if model.sliding_window is not None:
-            raise NotImplementedError(
-                "qkv_bias + sliding_window has no faithful transformers "
-                "twin here (Qwen2 windows are per-layer) — exporting "
-                "without the window would silently widen attention"
-            )
-        cfg = transformers.Qwen2Config(use_sliding_window=False,
-                                       head_dim=hd, **common)
-        hf = transformers.Qwen2ForCausalLM(cfg)
-    elif model.sliding_window is not None:
-        cfg = transformers.MistralConfig(
-            sliding_window=int(model.sliding_window), head_dim=hd, **common
-        )
-        hf = transformers.MistralForCausalLM(cfg)
-    else:
-        cfg = transformers.LlamaConfig(head_dim=hd, **common)
-        hf = transformers.LlamaForCausalLM(cfg)
     sd = {}
     sd["model.embed_tokens.weight"] = _t(params["wte"]["embedding"])
     dec = params["decoder"]
@@ -1041,6 +1003,108 @@ def llama_to_hf(model, params):
         sd[h + "mlp.down_proj.weight"] = _t(
             np.asarray(blk["mlp"]["fc2"]["kernel"]).T
         )
+    return sd
+
+
+def llama_to_hf(model, params):
+    """A transformers LlamaForCausalLM (or Qwen2 twin when
+    model.qkv_bias) carrying `params` — the inverse of `llama_from_hf` /
+    `qwen2_from_hf`. Mistral-style `sliding_window` models export as
+    MistralForCausalLM with the window in the config."""
+    import transformers
+
+    if (model.position != "rope" or model.norm != "rms"
+            or model.mlp_act != "swiglu" or model.use_bias
+            or model.embed_scale is not None or model.head_bias
+            or model.norm_style != "pre" or model.rope_dim is not None):
+        raise NotImplementedError(
+            "llama_to_hf requires the LLaMA arrangement (rope — full, not "
+            "partial — RMSNorm, swiglu, bias-free pre-norm blocks, "
+            "unscaled embeddings, bias-free head); Gemma/Phi-style models "
+            "stay native"
+        )
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = model.head_dim or hidden // heads
+    kv = model.num_kv_heads or heads
+    common = dict(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        num_key_value_heads=kv, intermediate_size=model.mlp_dim,
+        max_position_embeddings=model.max_position,
+        rope_theta=model.rope_theta, rms_norm_eps=model.ln_eps,
+        tie_word_embeddings=model.tie_embeddings, attention_dropout=0.0,
+    )
+    if model.qkv_bias:
+        if model.sliding_window is not None:
+            raise NotImplementedError(
+                "qkv_bias + sliding_window has no faithful transformers "
+                "twin here (Qwen2 windows are per-layer) — exporting "
+                "without the window would silently widen attention"
+            )
+        cfg = transformers.Qwen2Config(use_sliding_window=False,
+                                       head_dim=hd, **common)
+        hf = transformers.Qwen2ForCausalLM(cfg)
+    elif model.sliding_window is not None:
+        cfg = transformers.MistralConfig(
+            sliding_window=int(model.sliding_window), head_dim=hd, **common
+        )
+        hf = transformers.MistralForCausalLM(cfg)
+    else:
+        cfg = transformers.LlamaConfig(head_dim=hd, **common)
+        hf = transformers.LlamaForCausalLM(cfg)
+    sd = _llama_style_sd(model, params)
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "rotary_emb" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
+def gemma_to_hf(model, params):
+    """A transformers GemmaForCausalLM carrying `params` — the inverse of
+    `gemma_from_hf`: the LLaMA-style state dict with the two Gemma folds
+    undone — the stored RMSNorm scales carry the zero-centered `1 + w`
+    fold, so the exported weights are `scale - 1` (the HF module computes
+    `x * (1 + w)`); the sqrt(hidden) embedding scale and tanh-gelu gate
+    are config-level and checked, not transformed."""
+    import transformers
+
+    if (model.position != "rope" or model.norm != "rms"
+            or model.mlp_act != "geglu" or model.use_bias
+            or not model.tie_embeddings or model.qkv_bias
+            or model.head_bias or model.sliding_window is not None
+            or model.norm_style != "pre" or model.rope_dim is not None
+            or model.embed_scale is None
+            or abs(model.embed_scale - model.hidden_size ** 0.5) > 1e-6):
+        raise NotImplementedError(
+            "gemma_to_hf requires the Gemma arrangement (full rope, "
+            "RMSNorm, geglu, bias-free pre-norm blocks, tied head, "
+            "sqrt(hidden)-scaled embeddings) — LLaMA-style models export "
+            "via llama_to_hf"
+        )
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = model.head_dim or hidden // heads
+    cfg = transformers.GemmaConfig(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        num_key_value_heads=model.num_kv_heads or heads,
+        intermediate_size=model.mlp_dim, head_dim=hd,
+        max_position_embeddings=model.max_position,
+        rope_theta=model.rope_theta, rms_norm_eps=model.ln_eps,
+        tie_word_embeddings=True, attention_dropout=0.0,
+        # our geglu gate IS the tanh approximation — the exact match
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    hf = transformers.GemmaForCausalLM(cfg)
+    sd = _llama_style_sd(model, params)
+    for k in list(sd):
+        # un-fold 1+w on every RMSNorm scale (2 per layer + final)
+        if k.endswith("layernorm.weight") or k == "model.norm.weight":
+            sd[k] = sd[k] - 1.0
     missing, unexpected = hf.load_state_dict(sd, strict=False)
     missing = [k for k in missing if "rotary_emb" not in k]
     if missing or unexpected:
@@ -1215,6 +1279,330 @@ def neox_to_hf(model, params):
     return hf
 
 
+def bigcode_to_hf(model, params):
+    """A transformers GPTBigCodeForCausalLM carrying `params` — the
+    inverse of `bigcode_from_hf`: q/k/v kernels re-fuse into c_attn with
+    the layout the HF forward expects (flat [Q|K|V] blocks under
+    multi-query; per-head interleave under classic MHA)."""
+    import transformers
+
+    heads = model.num_heads
+    kv = model.num_kv_heads or heads
+    if (model.position != "learned" or model.norm != "layer"
+            or model.mlp_act != "gelu" or not model.tie_embeddings
+            or not model.use_bias or model.sliding_window is not None
+            or model.head_dim is not None or model.embed_scale is not None
+            or model.qkv_bias or model.head_bias
+            or model.norm_style != "pre" or model.rope_dim is not None
+            or kv not in (1, heads)):
+        raise NotImplementedError(
+            "bigcode_to_hf requires the StarCoder arrangement (learned "
+            "positions, LayerNorm, gelu, tied head, biased projections, "
+            "multi-query or classic MHA) — other families export via "
+            "their own inverses or stay native"
+        )
+    hidden = model.hidden_size
+    hd = hidden // heads
+    multi_query = kv == 1 and heads > 1
+    cfg = transformers.GPTBigCodeConfig(
+        vocab_size=model.vocab_size, n_embd=hidden, n_layer=model.depth,
+        n_head=heads, n_inner=model.mlp_dim,
+        n_positions=model.max_position, multi_query=multi_query,
+        layer_norm_epsilon=model.ln_eps, scale_attn_weights=True,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        # our Mlp gelu IS the tanh approximation — exact for this export
+        activation_function="gelu_pytorch_tanh",
+    )
+    hf = transformers.GPTBigCodeForCausalLM(cfg)
+    sd = {}
+    sd["transformer.wte.weight"] = _t(params["wte"]["embedding"])
+    sd["transformer.wpe.weight"] = _t(params["wpe"]["embedding"])
+    dec = params["decoder"]
+    sd["transformer.ln_f.weight"] = _t(dec["ln_final"]["scale"])
+    sd["transformer.ln_f.bias"] = _t(dec["ln_final"]["bias"])
+    for i in range(model.depth):
+        blk = dec[f"block_{i}"]
+        h = f"transformer.h.{i}."
+        sd[h + "ln_1.weight"] = _t(blk["ln_attn"]["scale"])
+        sd[h + "ln_1.bias"] = _t(blk["ln_attn"]["bias"])
+        sd[h + "ln_2.weight"] = _t(blk["ln_mlp"]["scale"])
+        sd[h + "ln_2.bias"] = _t(blk["ln_mlp"]["bias"])
+        a = blk["attn"]
+        qw = np.asarray(a["query"]["kernel"])   # [hidden, heads, hd]
+        kw = np.asarray(a["key"]["kernel"])     # [hidden, kv, hd]
+        vw = np.asarray(a["value"]["kernel"])
+        qb = np.asarray(a["query"]["bias"])     # [heads, hd]
+        kb = np.asarray(a["key"]["bias"])       # [kv, hd]
+        vb = np.asarray(a["value"]["bias"])
+        if multi_query:
+            # flat [Q (H) | K (hd) | V (hd)] rows, exactly the split
+            # bigcode_from_hf undoes
+            w = np.concatenate(
+                [qw.reshape(hidden, hidden), kw.reshape(hidden, kv * hd),
+                 vw.reshape(hidden, kv * hd)], axis=1,
+            )
+            b = np.concatenate(
+                [qb.reshape(hidden), kb.reshape(kv * hd),
+                 vb.reshape(kv * hd)]
+            )
+        else:
+            # classic MHA interleaves per head: [q_h | k_h | v_h] each head
+            w = np.stack([qw, kw, vw], axis=2).reshape(hidden, 3 * hidden)
+            b = np.stack([qb, kb, vb], axis=1).reshape(3 * hidden)
+        sd[h + "attn.c_attn.weight"] = _t(w.T)
+        sd[h + "attn.c_attn.bias"] = _t(b)
+        sd[h + "attn.c_proj.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(heads * hd, hidden).T
+        )
+        sd[h + "attn.c_proj.bias"] = _t(a["out"]["bias"])
+        sd[h + "mlp.c_fc.weight"] = _t(
+            np.asarray(blk["mlp"]["fc1"]["kernel"]).T
+        )
+        sd[h + "mlp.c_fc.bias"] = _t(blk["mlp"]["fc1"]["bias"])
+        sd[h + "mlp.c_proj.weight"] = _t(
+            np.asarray(blk["mlp"]["fc2"]["kernel"]).T
+        )
+        sd[h + "mlp.c_proj.bias"] = _t(blk["mlp"]["fc2"]["bias"])
+    sd["lm_head.weight"] = sd["transformer.wte.weight"]
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    # '.attn.bias' (with the dot) is the causal-mask buffer ONLY — a bare
+    # 'attn.bias' suffix would also swallow the real c_attn.bias weight
+    missing = [k for k in missing if not k.endswith(".attn.bias")
+               and not k.endswith("masked_bias")]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
+def opt_to_hf(model, params):
+    """A transformers OPTForCausalLM carrying `params` — the inverse of
+    `opt_from_hf`. The legacy offset-2 position table is rebuilt by
+    PREPENDING two zero rows (opt_from_hf sliced the originals off; HF
+    only reaches rows 0-1 for left-padded positions, which attention
+    masks exclude — unpadded logits are exact)."""
+    import transformers
+
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = hidden // heads
+    if (model.position != "learned" or model.norm != "layer"
+            or model.mlp_act != "relu" or not model.tie_embeddings
+            or not model.use_bias or model.sliding_window is not None
+            or model.head_dim is not None or model.embed_scale is not None
+            or model.qkv_bias or model.head_bias
+            or model.norm_style != "pre" or model.rope_dim is not None
+            or (model.num_kv_heads not in (None, heads))
+            or abs(model.ln_eps - 1e-5) > 1e-12):
+        raise NotImplementedError(
+            "opt_to_hf requires the OPT arrangement (learned positions, "
+            "pre-LN with eps 1e-5, relu MLP, tied head, biased "
+            "projections, classic MHA) — other families export via their "
+            "own inverses or stay native"
+        )
+    cfg = transformers.OPTConfig(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        ffn_dim=model.mlp_dim, max_position_embeddings=model.max_position,
+        word_embed_proj_dim=hidden, do_layer_norm_before=True,
+        activation_function="relu", tie_word_embeddings=True,
+        dropout=0.0, attention_dropout=0.0, enable_bias=True,
+        layer_norm_elementwise_affine=True,
+    )
+    hf = transformers.OPTForCausalLM(cfg)
+    sd = {}
+    pre = "model.decoder."
+    sd[pre + "embed_tokens.weight"] = _t(params["wte"]["embedding"])
+    wpe = np.asarray(params["wpe"]["embedding"], np.float32)
+    sd[pre + "embed_positions.weight"] = _t(
+        np.concatenate([np.zeros((2, hidden), np.float32), wpe], axis=0)
+    )
+    dec = params["decoder"]
+    sd[pre + "final_layer_norm.weight"] = _t(dec["ln_final"]["scale"])
+    sd[pre + "final_layer_norm.bias"] = _t(dec["ln_final"]["bias"])
+    for i in range(model.depth):
+        blk = dec[f"block_{i}"]
+        h = f"{pre}layers.{i}."
+        sd[h + "self_attn_layer_norm.weight"] = _t(blk["ln_attn"]["scale"])
+        sd[h + "self_attn_layer_norm.bias"] = _t(blk["ln_attn"]["bias"])
+        sd[h + "final_layer_norm.weight"] = _t(blk["ln_mlp"]["scale"])
+        sd[h + "final_layer_norm.bias"] = _t(blk["ln_mlp"]["bias"])
+        a = blk["attn"]
+        for ours, theirs in (("query", "q_proj"), ("key", "k_proj"),
+                             ("value", "v_proj")):
+            sd[h + f"self_attn.{theirs}.weight"] = _t(
+                np.asarray(a[ours]["kernel"]).reshape(hidden, hidden).T
+            )
+            sd[h + f"self_attn.{theirs}.bias"] = _t(
+                np.asarray(a[ours]["bias"]).reshape(hidden)
+            )
+        sd[h + "self_attn.out_proj.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(heads * hd, hidden).T
+        )
+        sd[h + "self_attn.out_proj.bias"] = _t(a["out"]["bias"])
+        sd[h + "fc1.weight"] = _t(np.asarray(blk["mlp"]["fc1"]["kernel"]).T)
+        sd[h + "fc1.bias"] = _t(blk["mlp"]["fc1"]["bias"])
+        sd[h + "fc2.weight"] = _t(np.asarray(blk["mlp"]["fc2"]["kernel"]).T)
+        sd[h + "fc2.bias"] = _t(blk["mlp"]["fc2"]["bias"])
+    sd["lm_head.weight"] = sd[pre + "embed_tokens.weight"]
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
+def _bert_encoder_sd(model, params, pre: str) -> dict:
+    """The transformers embeddings+encoder state dict (under prefix `pre`)
+    for a converted Bert/BertClassifier — the shared inverse of the
+    encoder mapping in `bert_from_hf`."""
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = hidden // heads
+    emb = params["embeddings"]
+    sd = {
+        pre + "embeddings.word_embeddings.weight":
+            _t(emb["word"]["embedding"]),
+        pre + "embeddings.position_embeddings.weight":
+            _t(emb["position"]["embedding"]),
+        pre + "embeddings.token_type_embeddings.weight":
+            _t(emb["token_type"]["embedding"]),
+        pre + "embeddings.LayerNorm.weight": _t(emb["ln"]["scale"]),
+        pre + "embeddings.LayerNorm.bias": _t(emb["ln"]["bias"]),
+    }
+    for i in range(model.depth):
+        blk = params["encoder"][f"block_{i}"]
+        h = f"{pre}encoder.layer.{i}."
+        a = blk["attn"]
+        for ours, theirs in (("query", "attention.self.query"),
+                             ("key", "attention.self.key"),
+                             ("value", "attention.self.value")):
+            sd[h + theirs + ".weight"] = _t(
+                np.asarray(a[ours]["kernel"]).reshape(hidden, hidden).T
+            )
+            sd[h + theirs + ".bias"] = _t(
+                np.asarray(a[ours]["bias"]).reshape(hidden)
+            )
+        sd[h + "attention.output.dense.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(heads * hd, hidden).T
+        )
+        sd[h + "attention.output.dense.bias"] = _t(a["out"]["bias"])
+        sd[h + "attention.output.LayerNorm.weight"] = _t(
+            blk["ln_attn"]["scale"]
+        )
+        sd[h + "attention.output.LayerNorm.bias"] = _t(
+            blk["ln_attn"]["bias"]
+        )
+        sd[h + "intermediate.dense.weight"] = _t(
+            np.asarray(blk["mlp"]["fc1"]["kernel"]).T
+        )
+        sd[h + "intermediate.dense.bias"] = _t(blk["mlp"]["fc1"]["bias"])
+        sd[h + "output.dense.weight"] = _t(
+            np.asarray(blk["mlp"]["fc2"]["kernel"]).T
+        )
+        sd[h + "output.dense.bias"] = _t(blk["mlp"]["fc2"]["bias"])
+        sd[h + "output.LayerNorm.weight"] = _t(blk["ln_mlp"]["scale"])
+        sd[h + "output.LayerNorm.bias"] = _t(blk["ln_mlp"]["bias"])
+    return sd
+
+
+def _bert_config(model, **extra):
+    import transformers
+
+    return transformers.BertConfig(
+        vocab_size=model.vocab_size, hidden_size=model.hidden_size,
+        num_hidden_layers=model.depth,
+        num_attention_heads=model.num_heads,
+        intermediate_size=model.mlp_dim,
+        max_position_embeddings=model.max_position,
+        type_vocab_size=model.type_vocab_size,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=model.ln_eps,
+        # our encoder's gelu is the tanh approximation; exporting the
+        # matching activation keeps native-vs-exported logits exact (a
+        # checkpoint imported from erf-gelu BERT re-exports with ~1e-3
+        # drift vs its origin — the same delta bert_from_hf documents)
+        hidden_act="gelu_pytorch_tanh",
+        **extra,
+    )
+
+
+def _check_bert_exportable(model, fn: str) -> None:
+    if getattr(model, "pad_vocab", False) or getattr(model, "fused_qkv",
+                                                     False):
+        raise NotImplementedError(
+            f"{fn} requires the transformers-compatible arrangement "
+            f"(pad_vocab=False — a padded vocab widens the logit table — "
+            f"and unfused per-projection qkv kernels)"
+        )
+
+
+def bert_to_hf(model, params):
+    """A transformers BertForMaskedLM carrying `params` — the inverse of
+    `bert_from_hf` (encoder + MLM transform head, tied decoder)."""
+    import transformers
+
+    _check_bert_exportable(model, "bert_to_hf")
+    sd = _bert_encoder_sd(model, params, "bert.")
+    sd["cls.predictions.transform.dense.weight"] = _t(
+        np.asarray(params["mlm_dense"]["kernel"]).T
+    )
+    sd["cls.predictions.transform.dense.bias"] = _t(
+        params["mlm_dense"]["bias"]
+    )
+    sd["cls.predictions.transform.LayerNorm.weight"] = _t(
+        params["mlm_ln"]["scale"]
+    )
+    sd["cls.predictions.transform.LayerNorm.bias"] = _t(
+        params["mlm_ln"]["bias"]
+    )
+    sd["cls.predictions.bias"] = _t(params["mlm_bias"])
+    sd["cls.predictions.decoder.weight"] = sd[
+        "bert.embeddings.word_embeddings.weight"
+    ]
+    sd["cls.predictions.decoder.bias"] = sd["cls.predictions.bias"]
+    hf = transformers.BertForMaskedLM(_bert_config(model))
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "position_ids" not in k
+               and "token_type_ids" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
+def bert_classifier_to_hf(model, params):
+    """A transformers BertForSequenceClassification carrying `params` —
+    the inverse of `bert_classifier_from_hf` (encoder + pooler +
+    classification head)."""
+    import transformers
+
+    _check_bert_exportable(model, "bert_classifier_to_hf")
+    sd = _bert_encoder_sd(model, params, "bert.")
+    sd["bert.pooler.dense.weight"] = _t(
+        np.asarray(params["pooler"]["kernel"]).T
+    )
+    sd["bert.pooler.dense.bias"] = _t(params["pooler"]["bias"])
+    sd["classifier.weight"] = _t(
+        np.asarray(params["classifier"]["kernel"]).T
+    )
+    sd["classifier.bias"] = _t(params["classifier"]["bias"])
+    hf = transformers.BertForSequenceClassification(
+        _bert_config(model, num_labels=model.num_labels)
+    )
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "position_ids" not in k
+               and "token_type_ids" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
 # --------------------------------------------------------------------------
 # CLI: python -m tfde_tpu.models.convert <family> <hf_path> <out_dir>
 # --------------------------------------------------------------------------
@@ -1344,20 +1732,14 @@ def _cli(argv=None) -> str:
                 f"converted as"
             )
         model, params = load_converted(args.hf_path)
-        if args.family == "gpt2":
-            hf = gpt2_to_hf(model, params)
-        elif args.family in ("llama", "mistral", "qwen2"):
-            hf = llama_to_hf(model, params)
-        elif args.family == "phi":
-            hf = phi_to_hf(model, params)
-        elif args.family == "neox":
-            hf = neox_to_hf(model, params)
-        else:
-            raise SystemExit(
-                f"--reverse supports gpt2/llama/mistral/qwen2/phi/neox, "
-                f"not {args.family!r} (gemma's 1+w norm fold and bert's "
-                f"heads have no registered inverse yet)"
-            )
+        to_hf = {
+            "gpt2": gpt2_to_hf, "llama": llama_to_hf,
+            "mistral": llama_to_hf, "qwen2": llama_to_hf,
+            "gemma": gemma_to_hf, "phi": phi_to_hf, "neox": neox_to_hf,
+            "bigcode": bigcode_to_hf, "opt": opt_to_hf,
+            "bert": bert_to_hf, "bert-classifier": bert_classifier_to_hf,
+        }[args.family]
+        hf = to_hf(model, params)
         hf.save_pretrained(args.out_dir)
         print(f"exported {args.family} HF checkpoint -> {args.out_dir}")
         return args.out_dir
