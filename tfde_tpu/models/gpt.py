@@ -228,10 +228,23 @@ def next_token_loss(state, params, batch, rng):
     from tfde_tpu.ops.losses import masked_lm_loss
 
     (tokens,) = batch if isinstance(batch, tuple) else (batch,)
-    logits, mutated = state.apply_fn(
-        {"params": params}, tokens, train=True, rngs={"dropout": rng},
-        mutable=["losses"],
-    )
+    try:
+        logits, mutated = state.apply_fn(
+            {"params": params}, tokens, train=True, rngs={"dropout": rng},
+            mutable=["losses"],
+        )
+    except TypeError as e:
+        # custom apply_fns without flax's kwarg (PipelinedLM.apply) — no
+        # sown-loss collections to collect there. Match the exact
+        # unsupported-kwarg signature error: a looser match would silently
+        # rerun (and drop sown losses for) models whose own TypeError
+        # merely mentions mutable
+        if "unexpected keyword argument 'mutable'" not in str(e):
+            raise
+        logits = state.apply_fn(
+            {"params": params}, tokens, train=True, rngs={"dropout": rng}
+        )
+        mutated = {}
     # align: logits[:, :-1] predict tokens[:, 1:]
     labels = tokens[:, 1:].astype(jnp.int32)
     loss, acc = masked_lm_loss(logits[:, :-1], labels)
