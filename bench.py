@@ -623,7 +623,10 @@ def _bench_flash(clock: _Clock, smoke: bool) -> dict:
         clock.fetch_scalar(g_fl(q, k, v)[0][0, 0, 0, 0].astype(jnp.float32))
         return time_impl(g_ref, q, k, v), time_impl(g_fl, q, k, v)
 
-    for b, s in ((4, 2048), (2, 4096), (1, 8192)):
+    # S=1024 joins the sweep for the causal dispatch threshold decision
+    # (ops/attention.py dispatches causal at S>=2048 from the 128-tile
+    # A/Bs; the 512-tile auto default needs the 1024 point re-measured)
+    for b, s in ((8, 1024), (4, 2048), (2, 4096), (1, 8192)):
         try:
             t_ref, t_fl = ab_pair(ref_g, fl_g, *make_qkv(b, s, 12, 64))
             out[f"flash_speedup_s{s}"] = round(t_ref / t_fl, 3)
@@ -703,7 +706,9 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
         seq, per_chip_batch = 128, 1
         model = GPT(vocab_size=512, hidden_size=64, depth=2, num_heads=2,
                     mlp_dim=128, max_position=seq, dtype=jnp.float32,
-                    attn_impl="flash" if medium else "auto")
+                    attn_impl="flash" if medium else "auto",
+                    # smoke must cover the remat path gpt_long4 ships with
+                    remat="dots" if prefix == "gpt_long4" else False)
         warmup = 1
     elif medium:
         seq, per_chip_batch = 1024, 8
@@ -711,12 +716,15 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
                     max_position=seq, dropout_rate=0.0, attn_impl="flash")
         warmup = 2
     else:
-        # gpt_long2 (b=2): the round-5 batch lever question — b=1 measured
-        # ~20% MFU after the 512-tile flip; doubling tokens/step may lift
-        # the h=768 GEMM efficiency term
+        # gpt_long2 (b=2) / gpt_long4 (b=4 + remat='dots'): the round-5
+        # batch-lever ladder — b=1 measured ~20% MFU after the 512-tile
+        # flip; more tokens/step lifts the h=768 GEMM efficiency term, and
+        # at b=4 the dots-only remat trades recompute FLOPs for the
+        # activation memory that would otherwise bound the batch
         seq = 4096
-        per_chip_batch = 2 if prefix == "gpt_long2" else 1
-        model = GPT(max_position=seq, dropout_rate=0.0)  # GPT-2 small dims
+        per_chip_batch = {"gpt_long2": 2, "gpt_long4": 4}.get(prefix, 1)
+        model = GPT(max_position=seq, dropout_rate=0.0,  # GPT-2 small dims
+                    remat="dots" if prefix == "gpt_long4" else False)
         warmup = 2
     global_batch = per_chip_batch * n_chips
 
@@ -1171,6 +1179,9 @@ def run_mode() -> None:
         ("gpt_long2", lambda: _bench_gpt_long(clock, strategy, n_chips,
                                               peak, smoke,
                                               prefix="gpt_long2")),
+        ("gpt_long4", lambda: _bench_gpt_long(clock, strategy, n_chips,
+                                              peak, smoke,
+                                              prefix="gpt_long4")),
         ("moe", lambda: _bench_moe(clock, strategy, n_chips, peak, smoke)),
         ("decode", lambda: _bench_decode(clock, smoke)),
         ("serve", lambda: _bench_serve(clock, smoke)),
