@@ -825,6 +825,117 @@ def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def t5_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(T5, params) from a transformers T5ForConditionalGeneration.
+
+    The T5 arrangement (models/t5.py): shared embedding, relative-position
+    -bias attention (UNSCALED scores), T5-RMSNorm (plain w, no 1+ fold),
+    bias-free projections with an inner attention dim decoupled from
+    d_model, relu (v1.0) or gated tanh-gelu (v1.1) MLPs, tied head with
+    the d_model^-0.5 logit rescale (v1.0) or an untied lm_head (v1.1).
+    The per-stack shared bias table (HF stores it in block 0's attention;
+    this model stores it at the stack level — the same single table) maps
+    across directly."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.t5 import T5
+
+    cfg = hf_model.config
+    gated = bool(getattr(cfg, "is_gated_act", False))
+    act = getattr(cfg, "dense_act_fn", "relu")
+    if gated:
+        if act not in ("gelu_new", "gelu_pytorch_tanh"):
+            raise NotImplementedError(
+                f"gated dense_act_fn {act!r} is not supported (expected "
+                f"the v1.1 tanh-gelu, which models/t5.py 'geglu' matches "
+                f"exactly)"
+            )
+        mlp_act = "geglu"
+    else:
+        if act != "relu":
+            raise NotImplementedError(
+                f"dense_act_fn {act!r} is not supported (T5 v1.0 uses "
+                f"relu)"
+            )
+        mlp_act = "relu"
+    heads = cfg.num_heads
+    model = T5(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.d_model,
+        depth=cfg.num_layers,
+        decoder_depth=cfg.num_decoder_layers,
+        num_heads=heads,
+        head_dim=cfg.d_kv,
+        mlp_dim=cfg.d_ff,
+        mlp_act=mlp_act,
+        num_buckets=cfg.relative_attention_num_buckets,
+        max_distance=getattr(cfg, "relative_attention_max_distance", 128),
+        tie_embeddings=bool(cfg.tie_word_embeddings),
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        ln_eps=cfg.layer_norm_epsilon,
+        pad_id=cfg.pad_token_id,
+    )
+    hidden, hd = cfg.d_model, cfg.d_kv
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    params: dict = {"shared": {"embedding": sd["shared.weight"]}}
+    if not model.tie_embeddings:
+        params["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+
+    def attn_tree(pre: str) -> dict:
+        return {
+            "query": {"kernel": sd[pre + "q.weight"].T
+                      .reshape(hidden, heads, hd)},
+            "key": {"kernel": sd[pre + "k.weight"].T
+                    .reshape(hidden, heads, hd)},
+            "value": {"kernel": sd[pre + "v.weight"].T
+                      .reshape(hidden, heads, hd)},
+            "out": {"kernel": sd[pre + "o.weight"].T
+                    .reshape(heads, hd, hidden)},
+        }
+
+    def mlp_tree(pre: str) -> dict:
+        if gated:
+            t = {"gate": {"kernel": sd[pre + "wi_0.weight"].T},
+                 "fc1": {"kernel": sd[pre + "wi_1.weight"].T}}
+        else:
+            t = {"fc1": {"kernel": sd[pre + "wi.weight"].T}}
+        t["fc2"] = {"kernel": sd[pre + "wo.weight"].T}
+        return t
+
+    for stack, n_layers, cross in (("encoder", cfg.num_layers, False),
+                                   ("decoder", cfg.num_decoder_layers,
+                                    True)):
+        tree: dict = {
+            "rel_bias": sd[
+                f"{stack}.block.0.layer.0.SelfAttention"
+                f".relative_attention_bias.weight"
+            ],
+            "ln_final": {
+                "scale": sd[f"{stack}.final_layer_norm.weight"]
+            },
+        }
+        mlp_layer = 2 if cross else 1
+        for i in range(n_layers):
+            h = f"{stack}.block.{i}."
+            blk = {
+                "ln_attn": {"scale": sd[h + "layer.0.layer_norm.weight"]},
+                "attn": attn_tree(h + "layer.0.SelfAttention."),
+                f"ln_mlp": {
+                    "scale": sd[h + f"layer.{mlp_layer}.layer_norm.weight"]
+                },
+                "mlp": mlp_tree(h + f"layer.{mlp_layer}.DenseReluDense."),
+            }
+            if cross:
+                blk["ln_cross"] = {
+                    "scale": sd[h + "layer.1.layer_norm.weight"]
+                }
+                blk["cross_attn"] = attn_tree(h + "layer.1.EncDecAttention.")
+            tree[f"block_{i}"] = blk
+        params[stack] = tree
+    return model, params
+
+
 def bert_classifier_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(BertClassifier, params) from a transformers
     BertForSequenceClassification — the fine-tuned-classifier import path.
@@ -1603,6 +1714,100 @@ def bert_classifier_to_hf(model, params):
     return hf
 
 
+def t5_to_hf(model, params):
+    """A transformers T5ForConditionalGeneration carrying `params` — the
+    inverse of `t5_from_hf` (per-stack bias table back into block 0's
+    attention, kernels back to [out, in])."""
+    import transformers
+
+    if model.mlp_act not in ("relu", "geglu"):
+        raise NotImplementedError(
+            "t5_to_hf requires the T5 arrangement (relu v1.0 or gated "
+            "tanh-gelu v1.1 MLPs) — other activations stay native"
+        )
+    gated = model.mlp_act == "geglu"
+    cfg = transformers.T5Config(
+        vocab_size=model.vocab_size, d_model=model.hidden_size,
+        d_kv=model.head_dim, d_ff=model.mlp_dim,
+        num_layers=model.depth,
+        num_decoder_layers=model.decoder_depth or model.depth,
+        num_heads=model.num_heads,
+        relative_attention_num_buckets=model.num_buckets,
+        relative_attention_max_distance=model.max_distance,
+        dropout_rate=0.0, layer_norm_epsilon=model.ln_eps,
+        feed_forward_proj="gated-gelu" if gated else "relu",
+        tie_word_embeddings=model.tie_embeddings,
+        pad_token_id=model.pad_id, decoder_start_token_id=model.pad_id,
+    )
+    hf = transformers.T5ForConditionalGeneration(cfg)
+    heads, hd = model.num_heads, model.head_dim
+    hidden = model.hidden_size
+    sd = {}
+    sd["shared.weight"] = _t(params["shared"]["embedding"])
+    sd["encoder.embed_tokens.weight"] = sd["shared.weight"]
+    sd["decoder.embed_tokens.weight"] = sd["shared.weight"]
+    sd["lm_head.weight"] = (
+        _t(np.asarray(params["lm_head"]["kernel"]).T)
+        if not model.tie_embeddings else sd["shared.weight"]
+    )
+
+    def put_attn(pre: str, a: dict) -> None:
+        sd[pre + "q.weight"] = _t(
+            np.asarray(a["query"]["kernel"]).reshape(hidden, heads * hd).T
+        )
+        sd[pre + "k.weight"] = _t(
+            np.asarray(a["key"]["kernel"]).reshape(hidden, heads * hd).T
+        )
+        sd[pre + "v.weight"] = _t(
+            np.asarray(a["value"]["kernel"]).reshape(hidden, heads * hd).T
+        )
+        sd[pre + "o.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(heads * hd, hidden).T
+        )
+
+    def put_mlp(pre: str, m: dict) -> None:
+        if gated:
+            sd[pre + "wi_0.weight"] = _t(np.asarray(m["gate"]["kernel"]).T)
+            sd[pre + "wi_1.weight"] = _t(np.asarray(m["fc1"]["kernel"]).T)
+        else:
+            sd[pre + "wi.weight"] = _t(np.asarray(m["fc1"]["kernel"]).T)
+        sd[pre + "wo.weight"] = _t(np.asarray(m["fc2"]["kernel"]).T)
+
+    for stack, n_layers, cross in (
+        ("encoder", model.depth, False),
+        ("decoder", model.decoder_depth or model.depth, True),
+    ):
+        tree = params[stack]
+        sd[f"{stack}.final_layer_norm.weight"] = _t(
+            tree["ln_final"]["scale"]
+        )
+        sd[f"{stack}.block.0.layer.0.SelfAttention"
+           f".relative_attention_bias.weight"] = _t(tree["rel_bias"])
+        mlp_layer = 2 if cross else 1
+        for i in range(n_layers):
+            blk = tree[f"block_{i}"]
+            h = f"{stack}.block.{i}."
+            sd[h + "layer.0.layer_norm.weight"] = _t(
+                blk["ln_attn"]["scale"]
+            )
+            put_attn(h + "layer.0.SelfAttention.", blk["attn"])
+            if cross:
+                sd[h + "layer.1.layer_norm.weight"] = _t(
+                    blk["ln_cross"]["scale"]
+                )
+                put_attn(h + "layer.1.EncDecAttention.", blk["cross_attn"])
+            sd[h + f"layer.{mlp_layer}.layer_norm.weight"] = _t(
+                blk["ln_mlp"]["scale"]
+            )
+            put_mlp(h + f"layer.{mlp_layer}.DenseReluDense.", blk["mlp"])
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
 # --------------------------------------------------------------------------
 # CLI: python -m tfde_tpu.models.convert <family> <hf_path> <out_dir>
 # --------------------------------------------------------------------------
@@ -1620,6 +1825,7 @@ _FAMILIES = {
     "neox": ("GPTNeoXForCausalLM", "neox_from_hf"),
     "bigcode": ("GPTBigCodeForCausalLM", "bigcode_from_hf"),
     "opt": ("OPTForCausalLM", "opt_from_hf"),
+    "t5": ("T5ForConditionalGeneration", "t5_from_hf"),
 }
 
 
@@ -1689,11 +1895,12 @@ def load_converted(artifact_dir: str, dtype=None):
 
     from tfde_tpu.models.bert import Bert, BertClassifier
     from tfde_tpu.models.gpt import GPT
+    from tfde_tpu.models.t5 import T5
 
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
            "qwen2": GPT, "phi": GPT, "neox": GPT, "bigcode": GPT,
            "opt": GPT, "bert": Bert,
-           "bert-classifier": BertClassifier}[family]
+           "bert-classifier": BertClassifier, "t5": T5}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
         z = np.load(io.BytesIO(f.read()))
@@ -1738,6 +1945,7 @@ def _cli(argv=None) -> str:
             "gemma": gemma_to_hf, "phi": phi_to_hf, "neox": neox_to_hf,
             "bigcode": bigcode_to_hf, "opt": opt_to_hf,
             "bert": bert_to_hf, "bert-classifier": bert_classifier_to_hf,
+            "t5": t5_to_hf,
         }[args.family]
         hf = to_hf(model, params)
         hf.save_pretrained(args.out_dir)

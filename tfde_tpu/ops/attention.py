@@ -45,13 +45,15 @@ def reference_attention(
     mask: Optional[jax.Array] = None,
     causal: bool = False,
     window: Optional[int] = None,
+    bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Plain softmax(QK^T/sqrt(d))V with fp32 accumulation.
 
     mask: broadcastable to [B, H, Sq, Sk]; True/1 = attend. Additive -inf
     masking in fp32 keeps bf16 inputs numerically safe. window: sliding-
     window (Mistral-style) band — position i attends [i-window+1, i];
-    requires causal=True.
+    requires causal=True. bias: additive pre-softmax score bias (see
+    grouped_attention).
 
     The numerics oracle every other kernel is tested against. Internally
     the degenerate (groups == 1) case of `grouped_attention` — ONE
@@ -59,7 +61,7 @@ def reference_attention(
     decode path cannot drift.
     """
     return grouped_attention(q, k, v, mask=mask, causal=causal,
-                             window=window)
+                             window=window, bias=bias)
 
 
 def grouped_attention(
@@ -69,6 +71,8 @@ def grouped_attention(
     mask: Optional[jax.Array] = None,
     causal: bool = False,
     window: Optional[int] = None,
+    bias: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
 ) -> jax.Array:
     """Grouped-query attention: q [B,Sq,H,D] against k/v [B,Sk,Kv,D] with
     H = Kv * groups — each KV head serves a contiguous group of query heads.
@@ -80,6 +84,14 @@ def grouped_attention(
 
     mask: broadcastable to [B, H, Sq, Sk] (or with a size-1 head dim);
     True = attend, matching reference_attention.
+
+    bias: additive pre-softmax score bias broadcastable to [B, H, Sq, Sk]
+    (T5's relative position bias, models/t5.py) — added in fp32 AFTER the
+    score scaling and BEFORE masking, matching the transformers ordering.
+
+    scale: score multiplier; None = the standard 1/sqrt(d). T5 runs
+    UNSCALED attention (the scale is folded into its init) — its module
+    passes scale=1.0, keeping one einsum path for both conventions.
     """
     b, sq, h, d = q.shape
     kv = k.shape[2]
@@ -92,11 +104,25 @@ def grouped_attention(
         )
     g = h // kv
     sk = k.shape[1]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     qg = q.reshape(b, sq, kv, g, d)
     logits = jnp.einsum(
         "bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32
     ) * scale
+    if bias is not None:
+        if bias.ndim == 3:  # [H, Sq, Sk]
+            bias = bias[None]
+        if bias.ndim != 4:
+            raise ValueError(
+                f"bias must be broadcastable to [B,H,Sq,Sk] (ndim 3/4), "
+                f"got ndim={bias.ndim}"
+            )
+        if bias.shape[1] == h:
+            bias = bias.reshape(bias.shape[0], kv, g, *bias.shape[2:])
+        else:  # size-1 head dim broadcasts over [kv, g]
+            bias = bias[:, :, None]
+        logits = logits + bias.astype(jnp.float32)
     if causal:
         cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
         if window is not None:

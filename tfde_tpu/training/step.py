@@ -163,7 +163,12 @@ def init_state(
     mesh = strategy.mesh
 
     def init_fn(rng):
-        variables = model.init(rng, jnp.zeros_like(sample_input), train=False)
+        # a tuple sample feeds multi-input models positionally (the T5
+        # encoder-decoder takes (input_ids, decoder_input_ids)); a bare
+        # array keeps the single-input contract every other family uses
+        sample = jax.tree_util.tree_map(jnp.zeros_like, sample_input)
+        args = sample if isinstance(sample, tuple) else (sample,)
+        variables = model.init(rng, *args, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         return TrainState(
