@@ -1,0 +1,134 @@
+"""T5 sequence-to-sequence fine-tuning + generation, data-parallel.
+
+The encoder-decoder recipe beside the causal-LM one (examples/gpt_lm.py):
+a custom seq2seq objective (models/t5.t5_seq2seq_loss — teacher-forced CE
+over shifted labels) through `make_custom_train_step`, the TPU-native
+analog of the reference's hand-written `model_fn` path
+(tf2_mnist_distributed.py:65-91), then KV-cache generation (`t5_generate`:
+encoder once, cross-attention K/V cached, one compiled decode program).
+
+Data: a hermetic synthetic task — REVERSE the input token sequence — that
+a tiny T5 learns in a few hundred steps and that makes generation quality
+visible by eye in the logs. `--hf-dir` swaps in a converted
+T5ForConditionalGeneration artifact (models/convert.py CLI) instead.
+
+Run single-host: python examples/t5_seq2seq.py --max-steps 300 --generate 4
+CPU smoke:       python examples/t5_seq2seq.py --fake-devices 8 --tiny \
+                     --seq-len 8 --max-steps 5 --batch-size 16
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from tfde_tpu import bootstrap
+from tfde_tpu.models.t5 import (
+    T5Small,
+    t5_generate,
+    t5_seq2seq_loss,
+    t5_tiny_test,
+)
+from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
+from tfde_tpu.training.step import init_state, make_custom_train_step
+
+log = logging.getLogger(__name__)
+
+
+def reverse_batches(vocab: int, batch: int, seq: int, seed: int = 0):
+    """(input_ids, labels) streams for the reverse-copy task; ids in
+    [2, vocab) keep 0 (pad/start) and 1 (</s>) out of the payload."""
+    rng = np.random.default_rng(seed)
+    while True:
+        x = rng.integers(2, vocab, (batch, seq)).astype(np.int32)
+        yield x, x[:, ::-1].copy()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=64, help="per worker")
+    parser.add_argument("--seq-len", type=int, default=16)
+    parser.add_argument("--max-steps", type=int, default=300)
+    parser.add_argument("--learning-rate", type=float, default=3e-3)
+    parser.add_argument("--generate", type=int, default=0,
+                        help="after training, greedy-decode this many "
+                             "held-out inputs and log input vs output")
+    parser.add_argument("--hf-dir", type=str, default=None,
+                        help="conversion artifact dir (models/convert.py) "
+                             "to fine-tune instead of the fresh tiny model")
+    parser.add_argument("--tiny", action="store_true", help="CI-sized model")
+    parser.add_argument("--fake-devices", type=int, default=None)
+    args, _ = parser.parse_known_args(argv)
+
+    if args.fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+
+    info = bootstrap()
+    global_batch = args.batch_size * max(info.num_processes, 1)
+
+    params0 = None
+    if args.hf_dir:
+        from tfde_tpu.models.convert import load_converted
+
+        model, params0 = load_converted(args.hf_dir)
+    elif args.tiny:
+        model = t5_tiny_test()
+    else:
+        model = T5Small(
+            vocab_size=128, dropout_rate=0.0, dtype=jnp.float32,
+        )
+
+    strategy = MultiWorkerMirroredStrategy()
+    sample = (np.zeros((global_batch, args.seq_len), np.int32),
+              np.zeros((global_batch, args.seq_len), np.int32))
+    tx = optax.adamw(args.learning_rate)
+    state, _ = init_state(model, tx, strategy, sample, seed=0)
+    if params0 is not None:
+        # place the converted params per the strategy (the
+        # examples/lora_finetune.py pattern)
+        state = state.replace(params=jax.device_put(
+            params0, strategy.params_sharding(params0)
+        ))
+
+    step_fn = make_custom_train_step(strategy, state, t5_seq2seq_loss)
+    rng = jax.random.key(1)
+    stream = reverse_batches(model.vocab_size, global_batch, args.seq_len)
+    t0 = time.time()
+    metrics = {}
+    for step in range(args.max_steps):
+        state, metrics = step_fn(state, next(stream), rng)
+        if (step + 1) % 100 == 0:
+            vals = {k: float(jax.device_get(v)) for k, v in metrics.items()}
+            sps = 100 / (time.time() - t0)
+            t0 = time.time()
+            log.info("step %d: %s (%.2f steps/s)", step + 1, vals, sps)
+
+    if args.generate > 0:
+        params = jax.device_get(state.params)
+        x, want = next(reverse_batches(model.vocab_size, args.generate,
+                                       args.seq_len, seed=99))
+        toks, _ = t5_generate(model, params, jnp.asarray(x),
+                              max_new_tokens=args.seq_len, eos_id=None)
+        out = np.asarray(toks)[:, 1:]  # drop the start token
+        for i in range(args.generate):
+            hit = (out[i] == want[i]).mean()
+            log.info("input %s -> generated %s (target match %.0f%%)",
+                     x[i].tolist(), out[i].tolist(), 100 * hit)
+    return state, metrics
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO, force=True)
+    main()

@@ -154,3 +154,16 @@ def test_serve_gpt_example():
     )
     assert len(done) == 3
     assert all(len(toks) == 5 for _, toks in done)
+
+
+def test_t5_seq2seq_example_smoke():
+    """The encoder-decoder entrypoint: seq2seq training + generation run
+    end-to-end on the fake mesh."""
+    from examples import t5_seq2seq
+
+    state, metrics = t5_seq2seq.main(
+        ["--tiny", "--seq-len", "8", "--max-steps", "2", "--batch-size",
+         "16", "--generate", "2"]
+    )
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    assert int(jax.device_get(state.step)) == 2

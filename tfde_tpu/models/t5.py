@@ -464,7 +464,11 @@ def t5_seq2seq_loss(state, params, batch, rng):
         rngs={"dropout": rng},
     )
     loss, acc = masked_lm_loss(logits, labels.astype(jnp.int32))
-    return loss, {"seq2seq_accuracy": acc}
+    # the CE normalizes by the target-position count — grad_weight lets
+    # grad_accum weight each microbatch by its own count, reproducing the
+    # exact full-batch update on padded batches (training/step.py)
+    n_targets = jnp.sum((labels != -100).astype(jnp.float32))
+    return loss, {"seq2seq_accuracy": acc, "grad_weight": n_targets}
 
 
 def t5_generate(
