@@ -873,3 +873,140 @@ def test_convert_cli_reverse_new_family(tmp_path, hf_gemma, rng):
         a = hf_gemma(ids).logits
         b = hf2(ids).logits
     assert float((a - b).abs().max()) < 1e-4
+
+
+def _tiny_falcon(new_arch: bool, multi_query: bool = True,
+                 parallel_attn: bool = True, seed: int = 30):
+    cfg = transformers.FalconConfig(
+        vocab_size=101, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2 if new_arch else None,
+        new_decoder_architecture=new_arch, multi_query=multi_query,
+        parallel_attn=parallel_attn, alibi=False, bias=False,
+        max_position_embeddings=64, hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(seed)
+    m = transformers.FalconForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.mark.parametrize("arrangement", ["7b", "40b", "sequential"])
+def test_falcon_logits_match(arrangement, rng):
+    """The three Falcon shapes on existing GPT knobs: 7B (multi-query +
+    one parallel LN), 40B (grouped kv + dual-LN parallel residual), and
+    sequential pre-LN. erf-vs-tanh gelu bounds the delta at ~1e-3 (the
+    bert_from_hf precedent)."""
+    from tfde_tpu.models.convert import falcon_from_hf
+
+    if arrangement == "7b":
+        hf = _tiny_falcon(new_arch=False)
+        expect_style, expect_kv = "parallel", 1
+    elif arrangement == "40b":
+        hf = _tiny_falcon(new_arch=True, seed=31)
+        expect_style, expect_kv = "parallel2", 2
+    else:
+        hf = _tiny_falcon(new_arch=False, parallel_attn=False, seed=32)
+        expect_style, expect_kv = "pre", 1
+    model, params = falcon_from_hf(hf, dtype=jnp.float32)
+    assert model.norm_style == expect_style
+    assert (model.num_kv_heads or model.num_heads) == expect_kv
+    assert model.position == "rope" and not model.use_bias
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_falcon_converted_generates_like_hf(rng):
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import falcon_from_hf
+
+    hf = _tiny_falcon(new_arch=True, seed=31)
+    model, params = falcon_from_hf(hf, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+@pytest.mark.parametrize("arrangement", ["7b", "40b"])
+def test_falcon_roundtrip_to_hf(arrangement, rng):
+    from tfde_tpu.models.convert import falcon_from_hf, falcon_to_hf
+
+    hf = (_tiny_falcon(new_arch=False) if arrangement == "7b"
+          else _tiny_falcon(new_arch=True, seed=31))
+    model, params = falcon_from_hf(hf, dtype=jnp.float32)
+    hf2 = falcon_to_hf(model, params)
+    assert hf2.config.new_decoder_architecture == (arrangement == "40b")
+    ids = torch.tensor(rng.integers(0, 101, (2, 10)).astype(np.int64))
+    with torch.no_grad():
+        a = hf(ids).logits
+        b = hf2(ids).logits
+    assert float((a - b).abs().max()) < 1e-4
+
+
+def test_falcon_alibi_refused():
+    from tfde_tpu.models.convert import falcon_from_hf
+
+    cfg = transformers.FalconConfig(
+        vocab_size=53, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, alibi=True, bias=True, multi_query=False,
+        new_decoder_architecture=False,
+    )
+    torch.manual_seed(0)
+    m = transformers.FalconForCausalLM(cfg)
+    with pytest.raises(NotImplementedError, match="alibi"):
+        falcon_from_hf(m, dtype=jnp.float32)
+
+
+def test_falcon2_single_ln_new_arch(rng):
+    """The Falcon2-11B form: new_decoder_architecture (grouped kv) with
+    num_ln_in_parallel_attn=1 — ONE shared LayerNorm, so it maps to
+    norm_style='parallel'; round-trips through falcon_to_hf."""
+    from tfde_tpu.models.convert import falcon_from_hf, falcon_to_hf
+
+    cfg = transformers.FalconConfig(
+        vocab_size=101, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2,
+        new_decoder_architecture=True, num_ln_in_parallel_attn=1,
+        alibi=False, bias=False, max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    torch.manual_seed(33)
+    hf = transformers.FalconForCausalLM(cfg)
+    hf.eval()
+    model, params = falcon_from_hf(hf, dtype=jnp.float32)
+    assert model.norm_style == "parallel" and model.num_kv_heads == 2
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=5e-3, atol=5e-3)
+
+    hf2 = falcon_to_hf(model, params)
+    assert hf2.config.new_decoder_architecture
+    assert hf2.config.num_ln_in_parallel_attn == 1
+    ids_t = torch.tensor(ids.astype(np.int64))
+    with torch.no_grad():
+        d = float((hf(ids_t).logits - hf2(ids_t).logits).abs().max())
+    assert d < 1e-4
+
+
+def test_falcon_rope_scaling_refused():
+    from tfde_tpu.models.convert import falcon_from_hf
+
+    cfg = transformers.FalconConfig(
+        vocab_size=53, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, alibi=False, bias=False,
+        rope_scaling={"rope_type": "linear", "factor": 2.0},
+    )
+    torch.manual_seed(0)
+    m = transformers.FalconForCausalLM(cfg)
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        falcon_from_hf(m, dtype=jnp.float32)

@@ -825,6 +825,149 @@ def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def falcon_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers FalconForCausalLM.
+
+    Three Falcon arrangements, all expressible with existing GPT knobs:
+    the 7B shape (multi_query + parallel_attn: ONE LayerNorm feeds
+    attention and MLP — `norm_style='parallel'`, kv=1), the 40B/180B
+    shape (new_decoder_architecture: separate ln_attn/ln_mlp parallel
+    residual — `norm_style='parallel2'`, grouped kv), and the sequential
+    pre-LN shape (parallel_attn=False). All are rope + bias-free Linears
+    beside biased LayerNorms (GPT(use_bias=False) keeps LN affine+bias —
+    the Phi/NeoX convention this model zoo already relies on).
+
+    The fused query_key_value weight unpacks per arrangement: the 40B
+    form groups [g q-heads | k | v] per KV head; multi-query packs flat
+    [Q (H) | k | v]; classic MHA interleaves per head. alibi checkpoints
+    (falcon-rw) and bias=True Linears are refused — no GPT knob expresses
+    them. Falcon's MLP runs erf-gelu; this framework's gelu is the tanh
+    approximation — a documented ~1e-3 bounded logit delta, the same as
+    bert_from_hf."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    if bool(getattr(cfg, "alibi", False)):
+        raise NotImplementedError(
+            "alibi Falcon checkpoints (falcon-rw) are not supported — "
+            "the position machinery here is rope/learned, not alibi"
+        )
+    if bool(getattr(cfg, "bias", False)):
+        raise NotImplementedError(
+            "bias=True Falcon variants are not supported (the mainline "
+            "7B/40B/180B releases are bias-free)"
+        )
+    if getattr(cfg, "rope_scaling", None):
+        raise NotImplementedError(
+            f"rope_scaling {cfg.rope_scaling!r} is not supported — "
+            f"converting would silently apply unscaled rotary embeddings"
+        )
+    act = getattr(cfg, "activation", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        raise NotImplementedError(
+            f"activation {act!r} is not supported (Falcon releases use "
+            f"gelu; converting would silently change the math)"
+        )
+    heads = cfg.num_attention_heads
+    hidden = cfg.hidden_size
+    hd = hidden // heads
+    new_arch = bool(getattr(cfg, "new_decoder_architecture", False))
+    # LN arrangement: the 40B/180B new-arch form carries TWO parallel LNs
+    # (parallel2) UNLESS num_ln_in_parallel_attn == 1 (the Falcon2-11B
+    # form: grouped kv but ONE shared LN — 'parallel'); pre-new-arch
+    # models have one LN when parallel_attn, two sequential otherwise
+    if new_arch:
+        kv = cfg.num_kv_heads
+        two_ln = getattr(cfg, "num_ln_in_parallel_attn", None) != 1
+        norm_style = "parallel2" if two_ln else "parallel"
+    else:
+        kv = 1 if bool(getattr(cfg, "multi_query", True)) else heads
+        norm_style = ("parallel" if getattr(cfg, "parallel_attn", True)
+                      else "pre")
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.num_hidden_layers,
+        num_heads=heads,
+        mlp_dim=getattr(cfg, "ffn_hidden_size", None) or 4 * hidden,
+        max_position=getattr(cfg, "max_position_embeddings", 2048),
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        position="rope",
+        rope_theta=float(getattr(cfg, "rope_theta", 10_000.0)),
+        num_kv_heads=kv,
+        use_bias=False,
+        norm="layer",
+        norm_style=norm_style,
+        tie_embeddings=bool(getattr(cfg, "tie_word_embeddings", True)),
+        ln_eps=cfg.layer_norm_epsilon,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    pre = ("transformer."
+           if any(k.startswith("transformer.") for k in sd) else "")
+    params = {
+        "wte": {"embedding": sd[f"{pre}word_embeddings.weight"]},
+        "decoder": {
+            "ln_final": {"scale": sd[f"{pre}ln_f.weight"],
+                         "bias": sd[f"{pre}ln_f.bias"]},
+        },
+    }
+    if not model.tie_embeddings:
+        params["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    g = heads // kv
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}h.{i}."
+        w = sd[h + "self_attention.query_key_value.weight"].T  # [in, out]
+        if new_arch:
+            # [hidden, kv, g+2, hd]: per-KV-group [g q | k | v]
+            w4 = w.reshape(hidden, kv, g + 2, hd)
+            qw = w4[:, :, :g].reshape(hidden, heads, hd)
+            kw = w4[:, :, g]
+            vw = w4[:, :, g + 1]
+        elif kv == 1:
+            # flat [Q (H) | k (hd) | v (hd)]
+            qw, kw, vw = np.split(w, [hidden, hidden + hd], axis=1)
+            qw = qw.reshape(hidden, heads, hd)
+            kw = kw.reshape(hidden, 1, hd)
+            vw = vw.reshape(hidden, 1, hd)
+        else:
+            # classic MHA: per-head [q_h | k_h | v_h] interleave
+            w4 = w.reshape(hidden, heads, 3, hd)
+            qw, kw, vw = w4[:, :, 0], w4[:, :, 1], w4[:, :, 2]
+        blk = {
+            "attn": {
+                "query": {"kernel": qw},
+                "key": {"kernel": kw},
+                "value": {"kernel": vw},
+                "out": {"kernel": sd[h + "self_attention.dense.weight"].T
+                        .reshape(heads, hd, hidden)},
+            },
+            "mlp": {
+                "fc1": {"kernel": sd[h + "mlp.dense_h_to_4h.weight"].T},
+                "fc2": {"kernel": sd[h + "mlp.dense_4h_to_h.weight"].T},
+            },
+        }
+        if norm_style == "parallel2":
+            blk["ln_attn"] = {"scale": sd[h + "ln_attn.weight"],
+                              "bias": sd[h + "ln_attn.bias"]}
+            blk["ln_mlp"] = {"scale": sd[h + "ln_mlp.weight"],
+                             "bias": sd[h + "ln_mlp.bias"]}
+        else:
+            # 'parallel' (one LN — 7B and the new-arch Falcon2-11B form
+            # alike) and 'pre' both read input_layernorm
+            blk["ln_attn"] = {"scale": sd[h + "input_layernorm.weight"],
+                              "bias": sd[h + "input_layernorm.bias"]}
+            if norm_style == "pre":
+                blk["ln_mlp"] = {
+                    "scale": sd[h + "post_attention_layernorm.weight"],
+                    "bias": sd[h + "post_attention_layernorm.bias"],
+                }
+        params["decoder"][f"block_{i}"] = blk
+    return model, params
+
+
 def t5_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(T5, params) from a transformers T5ForConditionalGeneration.
 
@@ -1714,6 +1857,125 @@ def bert_classifier_to_hf(model, params):
     return hf
 
 
+def falcon_to_hf(model, params):
+    """A transformers FalconForCausalLM carrying `params` — the inverse of
+    `falcon_from_hf`: q/k/v kernels re-fuse into query_key_value per
+    arrangement (grouped 40B form, flat multi-query, per-head MHA)."""
+    import transformers
+
+    heads = model.num_heads
+    kv = model.num_kv_heads or heads
+    if (model.position != "rope" or model.norm != "layer"
+            or model.mlp_act != "gelu" or model.use_bias
+            or model.qkv_bias or model.head_bias
+            or model.sliding_window is not None
+            or model.head_dim is not None or model.embed_scale is not None
+            or model.rope_dim is not None
+            or model.norm_style not in ("parallel", "parallel2", "pre")):
+        raise NotImplementedError(
+            "falcon_to_hf requires the Falcon arrangement (full rope, "
+            "biased LayerNorms beside bias-free projections, gelu MLP, "
+            "parallel/parallel2/pre blocks) — other families export via "
+            "their own inverses or stay native"
+        )
+    hidden = model.hidden_size
+    hd = hidden // heads
+    # arrangement: parallel2 -> the 40B two-LN new arch; parallel with
+    # grouped kv -> the Falcon2-11B new arch with ONE LN
+    # (num_ln_in_parallel_attn=1); parallel/pre with kv in (1, heads) ->
+    # the pre-new-arch forms
+    new_arch = (model.norm_style == "parallel2"
+                or (model.norm_style == "parallel"
+                    and kv not in (1, heads)))
+    if model.norm_style == "pre" and kv not in (1, heads):
+        raise NotImplementedError(
+            "grouped kv with sequential pre-LN blocks has no Falcon twin"
+        )
+    cfg = transformers.FalconConfig(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        num_kv_heads=kv, new_decoder_architecture=new_arch,
+        multi_query=(not new_arch and kv == 1),
+        parallel_attn=model.norm_style != "pre",
+        num_ln_in_parallel_attn=(
+            1 if new_arch and model.norm_style == "parallel" else None
+        ),
+        alibi=False, bias=False,
+        layer_norm_epsilon=model.ln_eps,
+        rope_theta=model.rope_theta,
+        max_position_embeddings=model.max_position,
+        tie_word_embeddings=model.tie_embeddings,
+        ffn_hidden_size=model.mlp_dim,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    hf = transformers.FalconForCausalLM(cfg)
+    sd = {}
+    pre = "transformer."
+    sd[pre + "word_embeddings.weight"] = _t(params["wte"]["embedding"])
+    dec = params["decoder"]
+    sd[pre + "ln_f.weight"] = _t(dec["ln_final"]["scale"])
+    sd[pre + "ln_f.bias"] = _t(dec["ln_final"]["bias"])
+    sd["lm_head.weight"] = (
+        _t(np.asarray(params["lm_head"]["kernel"]).T)
+        if not model.tie_embeddings
+        else sd[pre + "word_embeddings.weight"]
+    )
+    g = heads // kv
+    for i in range(model.depth):
+        blk = dec[f"block_{i}"]
+        h = f"{pre}h.{i}."
+        a = blk["attn"]
+        qw = np.asarray(a["query"]["kernel"])   # [hidden, heads, hd]
+        kw = np.asarray(a["key"]["kernel"])     # [hidden, kv, hd]
+        vw = np.asarray(a["value"]["kernel"])
+        if new_arch:
+            w4 = np.concatenate(
+                [qw.reshape(hidden, kv, g, hd), kw[:, :, None],
+                 vw[:, :, None]], axis=2,
+            )  # [hidden, kv, g+2, hd]
+            w = w4.reshape(hidden, (kv * (g + 2)) * hd)
+        elif kv == 1:
+            w = np.concatenate(
+                [qw.reshape(hidden, hidden), kw.reshape(hidden, hd),
+                 vw.reshape(hidden, hd)], axis=1,
+            )
+        else:
+            w = np.stack([qw, kw, vw], axis=2).reshape(hidden, 3 * hidden)
+        sd[h + "self_attention.query_key_value.weight"] = _t(w.T)
+        sd[h + "self_attention.dense.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(heads * hd, hidden).T
+        )
+        sd[h + "mlp.dense_h_to_4h.weight"] = _t(
+            np.asarray(blk["mlp"]["fc1"]["kernel"]).T
+        )
+        sd[h + "mlp.dense_4h_to_h.weight"] = _t(
+            np.asarray(blk["mlp"]["fc2"]["kernel"]).T
+        )
+        if model.norm_style == "parallel2":
+            sd[h + "ln_attn.weight"] = _t(blk["ln_attn"]["scale"])
+            sd[h + "ln_attn.bias"] = _t(blk["ln_attn"]["bias"])
+            sd[h + "ln_mlp.weight"] = _t(blk["ln_mlp"]["scale"])
+            sd[h + "ln_mlp.bias"] = _t(blk["ln_mlp"]["bias"])
+        else:
+            # one LN: 'parallel' (incl. the new-arch 11B form) and 'pre'
+            sd[h + "input_layernorm.weight"] = _t(blk["ln_attn"]["scale"])
+            sd[h + "input_layernorm.bias"] = _t(blk["ln_attn"]["bias"])
+            if model.norm_style == "pre":
+                sd[h + "post_attention_layernorm.weight"] = _t(
+                    blk["ln_mlp"]["scale"]
+                )
+                sd[h + "post_attention_layernorm.bias"] = _t(
+                    blk["ln_mlp"]["bias"]
+                )
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "rotary_emb" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
 def t5_to_hf(model, params):
     """A transformers T5ForConditionalGeneration carrying `params` — the
     inverse of `t5_from_hf` (per-stack bias table back into block 0's
@@ -1826,6 +2088,7 @@ _FAMILIES = {
     "bigcode": ("GPTBigCodeForCausalLM", "bigcode_from_hf"),
     "opt": ("OPTForCausalLM", "opt_from_hf"),
     "t5": ("T5ForConditionalGeneration", "t5_from_hf"),
+    "falcon": ("FalconForCausalLM", "falcon_from_hf"),
 }
 
 
@@ -1899,7 +2162,7 @@ def load_converted(artifact_dir: str, dtype=None):
 
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
            "qwen2": GPT, "phi": GPT, "neox": GPT, "bigcode": GPT,
-           "opt": GPT, "bert": Bert,
+           "opt": GPT, "falcon": GPT, "bert": Bert,
            "bert-classifier": BertClassifier, "t5": T5}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
@@ -1945,7 +2208,7 @@ def _cli(argv=None) -> str:
             "gemma": gemma_to_hf, "phi": phi_to_hf, "neox": neox_to_hf,
             "bigcode": bigcode_to_hf, "opt": opt_to_hf,
             "bert": bert_to_hf, "bert-classifier": bert_classifier_to_hf,
-            "t5": t5_to_hf,
+            "t5": t5_to_hf, "falcon": falcon_to_hf,
         }[args.family]
         hf = to_hf(model, params)
         hf.save_pretrained(args.out_dir)
