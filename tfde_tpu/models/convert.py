@@ -825,6 +825,171 @@ def bert_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def mixtral_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers MixtralForCausalLM — the routed
+    sparse-MoE LLaMA: every layer's MLP is a top-k gated expert mixture
+    (w1=gate, w3=up, w2=down per expert, silu-gated), attention/norms are
+    the LLaMA arrangement.
+
+    Maps to GPT(num_experts=E, moe_every=1, mlp_act='swiglu',
+    use_bias=False) over models/moe.MoEMlp with experts_gate beside
+    experts_fc1/fc2. Routing parity: both sides softmax the full router
+    logits, take top-k, and renormalize the kept gates; Mixtral drops NO
+    tokens, so conversion pins `moe_capacity_factor = E / k` — per-group
+    capacity C = m (every token could route to one expert), making the
+    converted forward exact at the cost of an O(m^2 E) dispatch one-hot.
+    Fine-tuning configs can lower the factor; serving parity keeps it."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    heads = cfg.num_attention_heads
+    hidden = cfg.hidden_size
+    hd = getattr(cfg, "head_dim", None) or hidden // heads
+    kv = cfg.num_key_value_heads
+    e = cfg.num_local_experts
+    k = cfg.num_experts_per_tok
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.num_hidden_layers,
+        num_heads=heads,
+        head_dim=None if hd == hidden // heads else hd,
+        mlp_dim=cfg.intermediate_size,
+        max_position=cfg.max_position_embeddings,
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        position="rope",
+        rope_theta=float(cfg.rope_theta),
+        num_kv_heads=kv,
+        use_bias=False,
+        norm="rms",
+        mlp_act="swiglu",
+        num_experts=e,
+        moe_every=1,
+        experts_per_token=k,
+        moe_capacity_factor=float(e) / k,
+        sliding_window=getattr(cfg, "sliding_window", None),
+        tie_embeddings=bool(getattr(cfg, "tie_word_embeddings", False)),
+        ln_eps=cfg.rms_norm_eps,
+    )
+    sd = {k_: _np(v) for k_, v in hf_model.state_dict().items()}
+    pre = "model." if any(k_.startswith("model.") for k_ in sd) else ""
+    params = {
+        "wte": {"embedding": sd[f"{pre}embed_tokens.weight"]},
+        "decoder": {
+            "ln_final": {"scale": sd[f"{pre}norm.weight"]},
+        },
+    }
+    if not model.tie_embeddings:
+        params["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}layers.{i}."
+        moe_pre = h + "block_sparse_moe."
+        params["decoder"][f"block_{i}"] = {
+            "ln_attn": {"scale": sd[h + "input_layernorm.weight"]},
+            "ln_mlp": {"scale": sd[h + "post_attention_layernorm.weight"]},
+            "attn": {
+                "query": {"kernel": sd[h + "self_attn.q_proj.weight"].T
+                          .reshape(hidden, heads, hd)},
+                "key": {"kernel": sd[h + "self_attn.k_proj.weight"].T
+                        .reshape(hidden, kv, hd)},
+                "value": {"kernel": sd[h + "self_attn.v_proj.weight"].T
+                          .reshape(hidden, kv, hd)},
+                "out": {"kernel": sd[h + "self_attn.o_proj.weight"].T
+                        .reshape(heads, hd, hidden)},
+            },
+            "moe": {
+                "router": {"kernel": sd[moe_pre + "gate.weight"].T},
+                # per-expert [f, d] torch Linears stack to [E, d, f]/[E, f, d]
+                "experts_gate": np.stack(
+                    [sd[moe_pre + f"experts.{j}.w1.weight"].T
+                     for j in range(e)]
+                ),
+                "experts_fc1": np.stack(
+                    [sd[moe_pre + f"experts.{j}.w3.weight"].T
+                     for j in range(e)]
+                ),
+                "experts_fc2": np.stack(
+                    [sd[moe_pre + f"experts.{j}.w2.weight"].T
+                     for j in range(e)]
+                ),
+            },
+        }
+    return model, params
+
+
+def mixtral_to_hf(model, params):
+    """A transformers MixtralForCausalLM carrying `params` — the inverse
+    of `mixtral_from_hf`: expert stacks unstack into per-expert w1/w2/w3
+    Linears, the router transposes back to gate.weight."""
+    import transformers
+
+    e = model.num_experts
+    k = model.experts_per_token
+    if (model.position != "rope" or model.norm != "rms"
+            or model.mlp_act != "swiglu" or model.use_bias
+            or e <= 0 or model.moe_every != 1
+            or model.qkv_bias or model.head_bias
+            or model.embed_scale is not None
+            or model.norm_style != "pre" or model.rope_dim is not None):
+        raise NotImplementedError(
+            "mixtral_to_hf requires the Mixtral arrangement (LLaMA-style "
+            "attention/norms with every layer's MLP routed, bias-free "
+            "swiglu experts) — dense models export via llama_to_hf"
+        )
+    if model.moe_capacity_factor < float(e) / k:
+        # HF Mixtral has no capacity concept: it computes EVERY token. A
+        # model fine-tuned with drops learned around them — exporting it
+        # as drop-free would silently change its logits.
+        raise NotImplementedError(
+            f"moe_capacity_factor {model.moe_capacity_factor} < E/k = "
+            f"{float(e) / k}: this model can drop overflow tokens, which "
+            f"HF Mixtral (capacity-free) cannot express — raise the "
+            f"factor to E/k (exact) before exporting"
+        )
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = model.head_dim or hidden // heads
+    kv = model.num_kv_heads or heads
+    cfg = transformers.MixtralConfig(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        num_key_value_heads=kv, intermediate_size=model.mlp_dim,
+        num_local_experts=e, num_experts_per_tok=k, head_dim=hd,
+        max_position_embeddings=model.max_position,
+        rope_theta=model.rope_theta, rms_norm_eps=model.ln_eps,
+        sliding_window=model.sliding_window,
+        tie_word_embeddings=model.tie_embeddings,
+        attention_dropout=0.0, router_aux_loss_coef=0.0,
+    )
+    hf = transformers.MixtralForCausalLM(cfg)
+
+    def moe_mlp_fn(sd, h, blk):
+        moe = blk["moe"]
+        moe_pre = h + "block_sparse_moe."
+        sd[moe_pre + "gate.weight"] = _t(
+            np.asarray(moe["router"]["kernel"]).T
+        )
+        gate_s = np.asarray(moe["experts_gate"])
+        up_s = np.asarray(moe["experts_fc1"])
+        down_s = np.asarray(moe["experts_fc2"])
+        for j in range(e):
+            sd[moe_pre + f"experts.{j}.w1.weight"] = _t(gate_s[j].T)
+            sd[moe_pre + f"experts.{j}.w3.weight"] = _t(up_s[j].T)
+            sd[moe_pre + f"experts.{j}.w2.weight"] = _t(down_s[j].T)
+
+    sd = _llama_style_sd(model, params, mlp_fn=moe_mlp_fn)
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "rotary_emb" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
 def falcon_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(GPT, params) from a transformers FalconForCausalLM.
 
@@ -1202,10 +1367,12 @@ def gpt2_to_hf(model, params):
     return hf
 
 
-def _llama_style_sd(model, params) -> dict:
-    """The transformers state dict for a LLaMA-arranged gated-MLP decoder
-    (model.layers.* keys) — shared by `llama_to_hf` (LLaMA/Mistral/Qwen2)
-    and `gemma_to_hf` (which un-folds the zero-centered norms on top)."""
+def _llama_style_sd(model, params, mlp_fn=None) -> dict:
+    """The transformers state dict for a LLaMA-arranged decoder
+    (model.layers.* keys) — shared by `llama_to_hf` (LLaMA/Mistral/Qwen2),
+    `gemma_to_hf` (which un-folds the zero-centered norms on top), and
+    `mixtral_to_hf` (which swaps the dense-MLP writer for the routed
+    expert stacks via `mlp_fn(sd, layer_prefix, block_params)`)."""
     heads = model.num_heads
     hidden = model.hidden_size
     hd = model.head_dim or hidden // heads
@@ -1248,15 +1415,18 @@ def _llama_style_sd(model, params) -> dict:
             sd[h + "self_attn.v_proj.bias"] = _t(
                 np.asarray(a["value"]["bias"]).reshape(kv * hd)
             )
-        sd[h + "mlp.gate_proj.weight"] = _t(
-            np.asarray(blk["mlp"]["gate"]["kernel"]).T
-        )
-        sd[h + "mlp.up_proj.weight"] = _t(
-            np.asarray(blk["mlp"]["fc1"]["kernel"]).T
-        )
-        sd[h + "mlp.down_proj.weight"] = _t(
-            np.asarray(blk["mlp"]["fc2"]["kernel"]).T
-        )
+        if mlp_fn is not None:
+            mlp_fn(sd, h, blk)
+        else:
+            sd[h + "mlp.gate_proj.weight"] = _t(
+                np.asarray(blk["mlp"]["gate"]["kernel"]).T
+            )
+            sd[h + "mlp.up_proj.weight"] = _t(
+                np.asarray(blk["mlp"]["fc1"]["kernel"]).T
+            )
+            sd[h + "mlp.down_proj.weight"] = _t(
+                np.asarray(blk["mlp"]["fc2"]["kernel"]).T
+            )
     return sd
 
 
@@ -2089,6 +2259,7 @@ _FAMILIES = {
     "opt": ("OPTForCausalLM", "opt_from_hf"),
     "t5": ("T5ForConditionalGeneration", "t5_from_hf"),
     "falcon": ("FalconForCausalLM", "falcon_from_hf"),
+    "mixtral": ("MixtralForCausalLM", "mixtral_from_hf"),
 }
 
 
@@ -2162,7 +2333,7 @@ def load_converted(artifact_dir: str, dtype=None):
 
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
            "qwen2": GPT, "phi": GPT, "neox": GPT, "bigcode": GPT,
-           "opt": GPT, "falcon": GPT, "bert": Bert,
+           "opt": GPT, "falcon": GPT, "mixtral": GPT, "bert": Bert,
            "bert-classifier": BertClassifier, "t5": T5}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
@@ -2209,6 +2380,7 @@ def _cli(argv=None) -> str:
             "bigcode": bigcode_to_hf, "opt": opt_to_hf,
             "bert": bert_to_hf, "bert-classifier": bert_classifier_to_hf,
             "t5": t5_to_hf, "falcon": falcon_to_hf,
+            "mixtral": mixtral_to_hf,
         }[args.family]
         hf = to_hf(model, params)
         hf.save_pretrained(args.out_dir)

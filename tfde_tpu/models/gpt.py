@@ -42,6 +42,8 @@ class GPT(nn.Module):
     # (models/moe.py) — train under ExpertParallelStrategy to shard experts
     num_experts: int = 0
     moe_every: int = 2
+    experts_per_token: int = 2
+    moe_capacity_factor: float = 1.25  # models/moe.py MoEMlp
     router_z_loss_weight: float = 0.0  # ST-MoE stabilizer (models/moe.py)
     # autoregressive serving mode (inference/decode.py): KV caches in the
     # "cache" collection; positions continue from the cached prefix
@@ -170,6 +172,8 @@ class GPT(nn.Module):
             remat=self.remat,
             num_experts=self.num_experts,
             moe_every=self.moe_every,
+            experts_per_token=self.experts_per_token,
+            moe_capacity_factor=self.moe_capacity_factor,
             router_z_loss_weight=self.router_z_loss_weight,
             name="decoder",
         )(x, train=train)

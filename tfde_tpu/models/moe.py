@@ -76,6 +76,11 @@ class MoEMlp(nn.Module):
     mlp_dim: int
     experts_per_token: int = 2
     capacity_factor: float = 1.25
+    # 'gelu' (Switch/GShard) | 'swiglu' (Mixtral: per-expert gated-silu,
+    # bias-free — a parallel experts_gate projection beside the up
+    # projection, the expert-wise analog of transformer.Mlp's swiglu)
+    act: str = "gelu"
+    use_bias: bool = True
     aux_loss_weight: float = 0.01
     # router z-loss (ST-MoE): penalizes mean(logsumexp(router logits)^2),
     # keeping logit magnitudes bounded so fp32 routing stays stable over
@@ -138,20 +143,31 @@ class MoEMlp(nn.Module):
             self.sow("losses", "moe_z",
                      self.router_z_loss_weight * jnp.mean(z * z))
 
+        if self.act not in ("gelu", "swiglu"):
+            raise ValueError(
+                f"act must be 'gelu' or 'swiglu', got {self.act!r}"
+            )
         w1 = self.param(
             "experts_fc1",
             nn.initializers.lecun_normal(batch_axis=0),
             (e, d, self.mlp_dim), jnp.float32,
         )
-        b1 = self.param("experts_b1", nn.initializers.zeros,
-                        (e, 1, self.mlp_dim), jnp.float32)
         w2 = self.param(
             "experts_fc2",
             nn.initializers.lecun_normal(batch_axis=0),
             (e, self.mlp_dim, d), jnp.float32,
         )
-        b2 = self.param("experts_b2", nn.initializers.zeros,
-                        (e, 1, d), jnp.float32)
+        if self.use_bias:
+            b1 = self.param("experts_b1", nn.initializers.zeros,
+                            (e, 1, self.mlp_dim), jnp.float32)
+            b2 = self.param("experts_b2", nn.initializers.zeros,
+                            (e, 1, d), jnp.float32)
+        if self.act == "swiglu":
+            wg = self.param(
+                "experts_gate",
+                nn.initializers.lecun_normal(batch_axis=0),
+                (e, d, self.mlp_dim), jnp.float32,
+            )
 
         # [e, g, c, d]: expert-major so the expert shard is dim 0, the
         # (data-sharded) group dim rides along — the token<->expert layout
@@ -161,16 +177,30 @@ class MoEMlp(nn.Module):
             preferred_element_type=jnp.float32,
         ).astype(self.dtype)
         xin = constrain(xin, "expert", b_axes)
-        h = jnp.einsum(
-            "egcd,edf->egcf", xin, w1.astype(self.dtype),
-            preferred_element_type=jnp.float32,
-        ) + b1.astype(jnp.float32)[:, None]
-        h = nn.gelu(h.astype(self.dtype))
+
+        def expert_dense(w, rhs):
+            return jnp.einsum(
+                "egcd,edf->egcf", rhs, w.astype(self.dtype),
+                preferred_element_type=jnp.float32,
+            )
+
+        h = expert_dense(w1, xin)
+        if self.use_bias:
+            h = h + b1.astype(jnp.float32)[:, None]
+        if self.act == "swiglu":
+            # gated-silu (Mixtral): gate and up are both expert-sharded on
+            # dim 0, so the product crosses no shard boundary
+            gate = expert_dense(wg, xin)
+            h = nn.silu(gate.astype(self.dtype)) * h.astype(self.dtype)
+        else:
+            h = nn.gelu(h.astype(self.dtype))
         h = constrain(h, "expert", b_axes)
         out_e = jnp.einsum(
             "egcf,efd->egcd", h, w2.astype(self.dtype),
             preferred_element_type=jnp.float32,
-        ) + b2.astype(jnp.float32)[:, None]
+        )
+        if self.use_bias:
+            out_e = out_e + b2.astype(jnp.float32)[:, None]
         out_e = constrain(out_e.astype(self.dtype), "expert", b_axes)
         y = jnp.einsum(
             "gmec,egcd->gmd", combine.astype(self.dtype), out_e,

@@ -374,6 +374,7 @@ class TransformerBlock(nn.Module):
     ln_eps: float = 1e-6  # checkpoint fidelity: GPT-2 1e-5, BERT 1e-12
     num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
     experts_per_token: int = 2
+    moe_capacity_factor: float = 1.25  # MoEMlp.capacity_factor
     router_z_loss_weight: float = 0.0  # ST-MoE stabilizer (models/moe.py)
 
     @nn.compact
@@ -409,11 +410,14 @@ class TransformerBlock(nn.Module):
             name="attn",
         )
         if self.num_experts > 0:
-            if self.mlp_act != "gelu" or not self.use_bias:
+            if (self.mlp_act, self.use_bias) not in (
+                ("gelu", True), ("swiglu", False),
+            ):
                 raise NotImplementedError(
-                    "MoE expert MLPs are gelu+bias today; num_experts > 0 "
-                    "with mlp_act/use_bias overrides would silently build a "
-                    "different architecture than requested"
+                    "MoE expert MLPs are gelu+bias (Switch/GShard) or "
+                    "bias-free swiglu (Mixtral); other mlp_act/use_bias "
+                    "combinations would silently build a different "
+                    "architecture than requested"
                 )
             if self.quant is not None:
                 raise NotImplementedError(
@@ -426,6 +430,9 @@ class TransformerBlock(nn.Module):
                 num_experts=self.num_experts,
                 mlp_dim=self.mlp_dim,
                 experts_per_token=self.experts_per_token,
+                capacity_factor=self.moe_capacity_factor,
+                act=self.mlp_act,
+                use_bias=self.use_bias,
                 router_z_loss_weight=self.router_z_loss_weight,
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
@@ -517,6 +524,7 @@ class Encoder(nn.Module):
     remat: Any = False
     num_experts: int = 0   # > 0: MoE MLP in every `moe_every`-th block
     experts_per_token: int = 2
+    moe_capacity_factor: float = 1.25
     router_z_loss_weight: float = 0.0
     moe_every: int = 2     # GShard convention: alternate dense / MoE
 
@@ -569,6 +577,7 @@ class Encoder(nn.Module):
                 ln_eps=self.ln_eps,
                 num_experts=self.num_experts if is_moe else 0,
                 experts_per_token=self.experts_per_token,
+                moe_capacity_factor=self.moe_capacity_factor,
                 router_z_loss_weight=self.router_z_loss_weight,
                 name=f"block_{i}",
             )
