@@ -76,7 +76,7 @@ def beam_search(
     b, p = prompt.shape
     k = num_beams
     total = validate_budget(model, p, max_new_tokens)
-    decode_model = _decode_clone(model)
+    decode_model = _decode_clone(model, rolling=True)
     prompt = prompt.astype(jnp.int32)
 
     base_step = _make_model_step(decode_model, params)
@@ -91,7 +91,7 @@ def beam_search(
     # cache starts correctly beam-expanded (a [B, P] prefill + tile of the
     # cache pytree would save K-1x prefill compute at the cost of knowing
     # the cache layout here; prefill is one forward — simplicity wins).
-    cache = init_cache(model, b * k, total)
+    cache = init_cache(model, b * k, total, rolling=True)
     expanded = jnp.repeat(prompt, k, axis=0)
     cache, logp = model_step(cache, expanded)  # logp [B*K, V]
     vocab = logp.shape[-1]

@@ -56,10 +56,17 @@ def _make_model_step(decode_model, params):
     return model_step
 
 
-def _decode_clone(model):
+def _decode_clone(model, rolling: bool = False):
     """The serving twin of a training model: decode on, remat off (remat
     only shapes the backward pass, which decode doesn't have — a training
-    config with remat must not make the model unservable)."""
+    config with remat must not make the model unservable).
+
+    rolling=True engages the window-bounded rolling KV cache
+    (transformer.MultiHeadAttention.rolling_cache) when the model has a
+    sliding window — decode memory O(window) instead of O(budget). Only
+    paths that NEVER rewind the cache may pass it (generate /
+    generate_ragged / beam_search); speculative decoding's rewind would
+    alias committed slots."""
     if not hasattr(model, "decode"):
         raise ValueError(
             f"{type(model).__name__} has no decode mode — autoregressive "
@@ -68,6 +75,9 @@ def _decode_clone(model):
     kw = {"decode": True}
     if getattr(model, "remat", False):
         kw["remat"] = False
+    if (rolling and getattr(model, "sliding_window", None)
+            and hasattr(model, "rolling_cache")):
+        kw["rolling_cache"] = True
     return model.clone(**kw)
 
 
@@ -92,15 +102,17 @@ def validate_budget(model, prompt_len: int, max_new_tokens: int) -> int:
     return total
 
 
-def init_cache(model, batch_size: int, max_len: int):
+def init_cache(model, batch_size: int, max_len: int,
+               rolling: bool = False):
     """Zero-filled "cache" collection for `model.clone(decode=True)` sized to
-    a [batch_size, max_len] generation budget.
+    a [batch_size, max_len] generation budget (window-bounded when
+    `rolling` — must match the decode clone's flag).
 
     Uses `jax.eval_shape` on the decode-mode init, so no model compute (and
     no real parameter init) runs — only the cache pytree's shapes/dtypes are
     derived, then materialized as zeros.
     """
-    decode_model = _decode_clone(model)
+    decode_model = _decode_clone(model, rolling=rolling)
     tokens = jax.ShapeDtypeStruct((batch_size, max_len), jnp.int32)
 
     def _init(tokens):
@@ -216,8 +228,8 @@ def generate(
         rng = jax.random.key(0)
     b, p = prompt.shape
     total = validate_budget(model, p, max_new_tokens)
-    decode_model = _decode_clone(model)
-    cache = init_cache(model, b, total)
+    decode_model = _decode_clone(model, rolling=True)
+    cache = init_cache(model, b, total, rolling=True)
     prompt = prompt.astype(jnp.int32)
     model_step = _make_model_step(decode_model, params)
     sample = functools.partial(sample_logits, temperature=temperature,
@@ -351,8 +363,8 @@ def _generate_ragged(model, params, prompt, prompt_lengths, max_new_tokens,
                      eos_id, pad_id):
     b, p_max = prompt.shape
     total = validate_budget(model, p_max, max_new_tokens)
-    decode_model = _decode_clone(model)
-    cache = init_cache(model, b, total)
+    decode_model = _decode_clone(model, rolling=True)
+    cache = init_cache(model, b, total, rolling=True)
     sample = functools.partial(sample_logits, temperature=temperature,
                                top_k=top_k, top_p=top_p, min_p=min_p)
     model_step = _make_model_step(decode_model, params)

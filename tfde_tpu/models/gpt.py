@@ -48,6 +48,10 @@ class GPT(nn.Module):
     # autoregressive serving mode (inference/decode.py): KV caches in the
     # "cache" collection; positions continue from the cached prefix
     decode: bool = False
+    # window-bounded rolling decode cache (transformer.MultiHeadAttention
+    # rolling_cache) — set by _decode_clone(rolling=True) on paths that
+    # never rewind the cache
+    rolling_cache: bool = False
     ln_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5 (models/convert.py)
     # 'learned' = GPT-2 absolute wpe table; 'rope' = rotary q/k rotation
     # (ops/rotary.py) — no position table, relative-position attention,
@@ -163,6 +167,7 @@ class GPT(nn.Module):
             fused_qkv=self.fused_qkv,
             quant=self.quant,
             window=self.sliding_window,
+            rolling_cache=self.rolling_cache,
             norm=self.norm,
             norm_style=self.norm_style,
             mlp_act=self.mlp_act,

@@ -96,6 +96,15 @@ class MultiHeadAttention(nn.Module):
     # decode cache (the validity mask carries the band), the flash kernel
     # (windowed tile skip), and the 'seq' ring (band on global positions)
     window: Optional[int] = None
+    # rolling KV cache (decode + window only): the cache holds min(budget,
+    # window) slots, each token writing slot (position mod len) — decode
+    # memory bounded by the window, not the generation budget (the Mistral
+    # rolling-buffer serving lever). OPT-IN because cache REWIND
+    # (speculative decoding) breaks it: a rejected draft's write can alias
+    # the slot of a committed token one window back; paths that never
+    # rewind (inference/decode.generate/generate_ragged/beam_search) turn
+    # it on via _decode_clone(rolling=True).
+    rolling_cache: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -214,10 +223,14 @@ class MultiHeadAttention(nn.Module):
         the cache to prompt + max_new_tokens exactly and can never overflow;
         direct drivers of this layer own the same invariant."""
         is_filled = self.has_variable("cache", "cached_key")
+        rolling = self.rolling_cache and self.window is not None
+        cache_shape = list(k.shape)
+        if rolling:
+            cache_shape[1] = min(cache_shape[1], self.window)
         cached_key = self.variable("cache", "cached_key", jnp.zeros,
-                                   k.shape, k.dtype)
+                                   tuple(cache_shape), k.dtype)
         cached_value = self.variable("cache", "cached_value", jnp.zeros,
-                                     v.shape, v.dtype)
+                                     tuple(cache_shape), v.dtype)
         cache_index = self.variable("cache", "cache_index",
                                     lambda: jnp.zeros((), jnp.int32))
 
@@ -229,13 +242,17 @@ class MultiHeadAttention(nn.Module):
                                               window=self.window)
         sq = q.shape[1]
         max_len = cached_key.value.shape[1]
-        if sq > max_len:
+        if sq > max_len and not rolling:
             raise ValueError(
                 f"input length {sq} exceeds the cache budget {max_len}; "
                 f"re-init the cache with a larger max_len"
             )
         idx = cache_index.value
         q, k = self._rotate(q, k, idx)
+        if rolling:
+            return self._rolling_attention(
+                q, k, v, batch, cached_key, cached_value, cache_index
+            )
         if idx.ndim == 0:
             # shared index (generate / batch-1 speculation): one cheap
             # dynamic_update_slice covers every row
@@ -289,6 +306,90 @@ class MultiHeadAttention(nn.Module):
         # with GQA the kv_heads-shaped cache feeds the einsum directly (no
         # expanded copy on the bandwidth-bound decode path)
         return attn_lib.grouped_attention(q, k_all, v_all, mask=valid)
+
+    def _rolling_attention(self, q, k, v, batch, cached_key, cached_value,
+                           cache_index) -> jax.Array:
+        """Window-bounded rolling KV cache: the token at absolute position
+        p lives in slot p mod Wc (Wc = min(budget, window)), so decode
+        memory is O(window) regardless of how long the generation runs.
+
+        The mask is reconstructed from slot arithmetic instead of stored
+        positions: after this call's writes the newest absolute position
+        is P, so slot j's content is the token at b_j = P - ((P - j) mod
+        Wc) — the latest position congruent to j. A query at position p
+        attends slot j iff 0 <= b_j <= p and p - b_j < window.
+
+        Caller invariant (STRICTER than "no rewind"): ONE prefill from
+        position 0, then single-token (sq == 1) steps. A multi-token
+        write onto a filled cache would clobber in-window keys its own
+        earlier queries still need (e.g. a 4-token chunk at positions
+        8-11 with window 4 destroys keys 5-7 before the query at 8 reads
+        them), and cache_index is traced so no runtime check can fire.
+        generate / generate_ragged / beam_search all satisfy this (their
+        scans are strictly one token per step after the prefill);
+        speculative decoding violates it twice over (multi-token verify
+        steps AND rewind) and therefore never enables rolling.
+
+        A prompt longer than the cache (sq > Wc) attends in-batch (valid
+        only at cache position 0 — the generate prefill; every key a
+        band-limited query needs is in the batch) and keeps the last Wc
+        tokens.
+        """
+        sq = q.shape[1]
+        wc = cached_key.value.shape[1]
+        idx = cache_index.value
+        kd = cached_key.value.dtype
+
+        if sq > wc:
+            if idx.ndim != 0:
+                raise ValueError(
+                    "per-row prefill longer than the rolling window cache "
+                    "is unsupported (rows would need in-batch keys beyond "
+                    "their own cache)"
+                )
+            # long prefill from position 0: band-limited queries only need
+            # in-batch keys; keep the newest Wc tokens
+            y = attn_lib.grouped_attention(q, k, v, causal=True,
+                                           window=self.window)
+            pos_last = idx + jnp.arange(sq - wc, sq, dtype=jnp.int32)
+            slots = pos_last % wc
+            k_all = cached_key.value.at[:, slots].set(
+                k[:, -wc:].astype(kd)
+            )
+            v_all = cached_value.value.at[:, slots].set(
+                v[:, -wc:].astype(cached_value.value.dtype)
+            )
+        else:
+            cols = jnp.arange(wc, dtype=jnp.int32)
+            if idx.ndim == 0:
+                pos_q = idx + jnp.arange(sq, dtype=jnp.int32)
+                slots = pos_q % wc
+                k_all = cached_key.value.at[:, slots].set(k.astype(kd))
+                v_all = cached_value.value.at[:, slots].set(
+                    v.astype(cached_value.value.dtype)
+                )
+                last = idx + sq - 1
+                b = last - ((last - cols) % wc)  # [Wc] slot -> abs position
+                valid = ((b[None, :] >= 0)
+                         & (b[None, :] <= pos_q[:, None])
+                         & (pos_q[:, None] - b[None, :] < self.window))
+                valid = valid[None, None]  # [1, 1, Sq, Wc]
+            else:
+                # no rolling-enabled driver produces per-row indices:
+                # generate/ragged/beam share a scalar cache_index, and the
+                # [B]-index producer (speculative rewind) never rolls.
+                # Refuse rather than ship a never-executed branch.
+                raise NotImplementedError(
+                    "rolling_cache with per-row cache indices is "
+                    "unsupported — the per-row paths (speculative "
+                    "decoding, row-recycling servers) use the full-budget "
+                    "cache"
+                )
+            y = attn_lib.grouped_attention(q, k_all, v_all, mask=valid)
+        cached_key.value = constrain(k_all, batch, None, "tensor")
+        cached_value.value = constrain(v_all, batch, None, "tensor")
+        cache_index.value = idx + sq
+        return y
 
 
 class Mlp(nn.Module):
@@ -364,6 +465,7 @@ class TransformerBlock(nn.Module):
     fused_qkv: bool = False  # one-GEMM qkv projection (MultiHeadAttention)
     quant: Optional[str] = None  # int8 serving twins (MultiHeadAttention)
     window: Optional[int] = None  # sliding window (MultiHeadAttention)
+    rolling_cache: bool = False  # window-bounded decode cache (MHA)
     norm_style: str = "pre"
     # 'pre' | 'post' | 'parallel' (Phi: one LN, x + attn(ln(x)) + mlp(ln(x)))
     # | 'parallel2' (NeoX/Pythia: parallel residual, separate attn/MLP LNs)
@@ -405,6 +507,7 @@ class TransformerBlock(nn.Module):
             fused_qkv=self.fused_qkv,
             quant=self.quant,
             window=self.window,
+            rolling_cache=self.rolling_cache,
             use_bias=self.use_bias,
             qkv_bias=self.qkv_bias,
             name="attn",
@@ -515,6 +618,7 @@ class Encoder(nn.Module):
     fused_qkv: bool = False
     quant: Optional[str] = None
     window: Optional[int] = None
+    rolling_cache: bool = False
     norm_style: str = "pre"
     norm: str = "layer"
     mlp_act: str = "gelu"
@@ -569,6 +673,7 @@ class Encoder(nn.Module):
                 fused_qkv=self.fused_qkv,
                 quant=self.quant,
                 window=self.window,
+                rolling_cache=self.rolling_cache,
                 norm_style=self.norm_style,
                 norm=self.norm,
                 mlp_act=self.mlp_act,
