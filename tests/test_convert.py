@@ -1119,3 +1119,115 @@ def test_mixtral_to_hf_refuses_droppy_capacity(hf_mixtral):
     droppy = model.clone(moe_capacity_factor=1.25)
     with pytest.raises(NotImplementedError, match="capacity"):
         mixtral_to_hf(droppy, params)
+
+
+@pytest.mark.parametrize("scaling", ["llama3", "linear"])
+def test_llama_rope_scaling_logits_match(scaling, rng):
+    """Llama-3.1-style rope scaling (and linear position interpolation):
+    the scaled-frequency rule (ops/rotary.scale_frequencies) must
+    reproduce transformers' logits — the gate on converting every
+    Llama-3.1+ checkpoint."""
+    from tfde_tpu.models.convert import llama_from_hf
+
+    if scaling == "llama3":
+        rs = {"rope_type": "llama3", "factor": 8.0,
+              "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+              "original_max_position_embeddings": 32}
+    else:
+        rs = {"rope_type": "linear", "factor": 4.0}
+    cfg = transformers.LlamaConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, attention_dropout=0.0,
+        tie_word_embeddings=False, rope_scaling=dict(rs),
+    )
+    torch.manual_seed(50)
+    hf = transformers.LlamaForCausalLM(cfg)
+    hf.eval()
+    model, params = llama_from_hf(hf, dtype=jnp.float32)
+    assert model.rope_scaling is not None
+    # long enough that scaled and unscaled frequencies visibly diverge
+    ids = rng.integers(0, 101, (2, 48)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+    # and the scaling actually changes the math (not silently ignored)
+    plain = model.clone(rope_scaling=None)
+    other = np.asarray(plain.apply({"params": params}, jnp.asarray(ids)))
+    assert np.abs(other - ref).max() > 1e-3
+
+
+def test_llama_rope_scaling_roundtrip_and_artifact(tmp_path, rng):
+    """to_hf re-emits the rope_scaling config; the conversion artifact
+    persists the tuple through save/load (json list -> tuple)."""
+    from tfde_tpu.models.convert import (
+        _cli,
+        llama_from_hf,
+        llama_to_hf,
+        load_converted,
+    )
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, attention_dropout=0.0,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32},
+    )
+    torch.manual_seed(51)
+    hf = transformers.LlamaForCausalLM(cfg)
+    hf.eval()
+    model, params = llama_from_hf(hf, dtype=jnp.float32)
+    hf2 = llama_to_hf(model, params)
+    assert hf2.config.rope_scaling["rope_type"] == "llama3"
+    ids = torch.tensor(rng.integers(0, 101, (2, 40)).astype(np.int64))
+    with torch.no_grad():
+        assert float((hf(ids).logits - hf2(ids).logits).abs().max()) < 1e-4
+
+    src = str(tmp_path / "hf")
+    art = str(tmp_path / "art")
+    hf.save_pretrained(src)
+    _cli(["llama", src, art])
+    m2, p2 = load_converted(art, dtype=jnp.float32)
+    assert isinstance(m2.rope_scaling, tuple) and m2.rope_scaling[0] == "llama3"
+    a = np.asarray(model.apply({"params": params},
+                               jnp.asarray(ids.numpy(), jnp.int32)))
+    b = np.asarray(m2.apply({"params": p2},
+                            jnp.asarray(ids.numpy(), jnp.int32)))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_rope_scaling_yarn_refused():
+    from tfde_tpu.models.convert import _rope_scaling_tuple
+
+    with pytest.raises(NotImplementedError, match="yarn"):
+        _rope_scaling_tuple({"rope_type": "yarn", "factor": 4.0})
+    assert _rope_scaling_tuple(None) is None
+    assert _rope_scaling_tuple({"rope_type": "default"}) is None
+
+
+def test_gemma_rope_scaling_roundtrips(rng):
+    """gemma_to_hf must re-emit rope_scaling (review r5: dropping it
+    exported unscaled rope — silently wrong long-context logits)."""
+    from tfde_tpu.models.convert import gemma_from_hf, gemma_to_hf
+
+    cfg = transformers.GemmaConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=256, attention_dropout=0.0,
+        hidden_activation="gelu_pytorch_tanh",
+        rope_scaling={"rope_type": "linear", "factor": 4.0},
+    )
+    torch.manual_seed(52)
+    hf = transformers.GemmaForCausalLM(cfg)
+    hf.eval()
+    model, params = gemma_from_hf(hf, dtype=jnp.float32)
+    assert model.rope_scaling == ("linear", 4.0)
+    hf2 = gemma_to_hf(model, params)
+    assert hf2.config.rope_scaling["factor"] == 4.0
+    ids = torch.tensor(rng.integers(0, 101, (2, 40)).astype(np.int64))
+    with torch.no_grad():
+        assert float((hf(ids).logits - hf2(ids).logits).abs().max()) < 1e-4

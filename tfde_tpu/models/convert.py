@@ -107,6 +107,48 @@ def gpt2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def _rope_scaling_tuple(rs) -> "Optional[tuple]":
+    """HF rope_scaling dict -> the hashable tuple ops/rotary understands:
+    ('linear', factor) or ('llama3', factor, low, high, orig_max) — the
+    Llama-3.1 long-context convention. None passes through; yarn /
+    dynamic-NTK / longrope are refused (their frequency rules are not
+    implemented — converting would produce silently wrong logits)."""
+    if not rs:
+        return None
+    kind = rs.get("rope_type") or rs.get("type")
+    if kind == "linear":
+        return ("linear", float(rs["factor"]))
+    if kind == "llama3":
+        return (
+            "llama3", float(rs["factor"]),
+            float(rs["low_freq_factor"]), float(rs["high_freq_factor"]),
+            float(rs["original_max_position_embeddings"]),
+        )
+    if kind == "default":
+        return None
+    raise NotImplementedError(
+        f"rope_scaling type {kind!r} is not supported (only 'linear' and "
+        f"'llama3'); converting would produce silently wrong logits"
+    )
+
+
+def _rope_scaling_dict(scaling) -> "Optional[dict]":
+    """The inverse of _rope_scaling_tuple, for to_hf exports."""
+    if scaling is None:
+        return None
+    scaling = tuple(scaling)
+    if scaling[0] == "linear":
+        return {"rope_type": "linear", "factor": float(scaling[1])}
+    if scaling[0] == "llama3":
+        return {
+            "rope_type": "llama3", "factor": float(scaling[1]),
+            "low_freq_factor": float(scaling[2]),
+            "high_freq_factor": float(scaling[3]),
+            "original_max_position_embeddings": int(scaling[4]),
+        }
+    raise NotImplementedError(f"unknown rope scaling {scaling!r}")
+
+
 def llama_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(GPT, params) from a transformers LlamaForCausalLM — the LLaMA
     family maps onto GPT(position='rope', num_kv_heads=..., norm='rms',
@@ -118,13 +160,7 @@ def llama_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     from tfde_tpu.models.gpt import GPT
 
     cfg = hf_model.config
-    if getattr(cfg, "rope_scaling", None):
-        raise NotImplementedError(
-            f"rope_scaling={cfg.rope_scaling!r} is not supported "
-            f"(Llama-3.x frequency scaling); converting would produce "
-            f"silently wrong logits — only plain rope_theta checkpoints "
-            f"convert today"
-        )
+    rope_scaling = _rope_scaling_tuple(getattr(cfg, "rope_scaling", None))
     if getattr(cfg, "attention_bias", False) or getattr(cfg, "mlp_bias", False):
         raise NotImplementedError(
             "checkpoints with attention_bias/mlp_bias are not supported by "
@@ -148,6 +184,7 @@ def llama_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
         dtype=dtype if dtype is not None else jnp.bfloat16,
         position="rope",
         rope_theta=float(cfg.rope_theta),
+        rope_scaling=rope_scaling,
         num_kv_heads=kv,
         norm="rms",
         mlp_act="swiglu",
@@ -1456,7 +1493,9 @@ def llama_to_hf(model, params):
         num_hidden_layers=model.depth, num_attention_heads=heads,
         num_key_value_heads=kv, intermediate_size=model.mlp_dim,
         max_position_embeddings=model.max_position,
-        rope_theta=model.rope_theta, rms_norm_eps=model.ln_eps,
+        rope_theta=model.rope_theta,
+        rope_scaling=_rope_scaling_dict(model.rope_scaling),
+        rms_norm_eps=model.ln_eps,
         tie_word_embeddings=model.tie_embeddings, attention_dropout=0.0,
     )
     if model.qkv_bias:
@@ -1518,7 +1557,11 @@ def gemma_to_hf(model, params):
         num_key_value_heads=model.num_kv_heads or heads,
         intermediate_size=model.mlp_dim, head_dim=hd,
         max_position_embeddings=model.max_position,
-        rope_theta=model.rope_theta, rms_norm_eps=model.ln_eps,
+        rope_theta=model.rope_theta,
+        # re-emit frequency scaling: dropping it would export unscaled
+        # rope — silently wrong logits at long context
+        rope_scaling=_rope_scaling_dict(model.rope_scaling),
+        rms_norm_eps=model.ln_eps,
         tie_word_embeddings=True, attention_dropout=0.0,
         # our geglu gate IS the tanh approximation — the exact match
         hidden_activation="gelu_pytorch_tanh",
@@ -2291,12 +2334,20 @@ def save_converted(model, params, out_dir: str, family: str) -> str:
                          f"{sorted(_FAMILIES)}")
     fs.makedirs(out_dir, exist_ok=True)
     write_params_npz(fs.join(out_dir, "params.npz"), params)
+    def _persistable(v) -> bool:
+        scalar = (int, float, str, bool, type(None))
+        if isinstance(v, scalar):
+            return True
+        # scalar tuples persist too (rope_scaling); json stores them as
+        # lists, which load_converted re-tuples for hashability
+        return (isinstance(v, (tuple, list))
+                and all(isinstance(x, scalar) for x in v))
+
     config = {
         f.name: getattr(model, f.name)
         for f in dataclasses.fields(model)
         if f.name not in ("parent", "name")
-        and isinstance(getattr(model, f.name), (int, float, str, bool,
-                                                type(None)))
+        and _persistable(getattr(model, f.name))
     }
     config["family"] = family
     config["dtype"] = str(np.dtype(model.dtype))
@@ -2324,7 +2375,12 @@ def load_converted(artifact_dir: str, dtype=None):
     conf = _read_config(artifact_dir)
     family = conf.pop("family")
     recorded = conf.pop("dtype")
-    kwargs = dict(conf)
+    kwargs = {
+        # json stores tuples as lists; re-tuple so the rebuilt module's
+        # config stays hashable (rope_scaling)
+        k: tuple(v) if isinstance(v, list) else v
+        for k, v in conf.items()
+    }
     kwargs["dtype"] = jnp.dtype(dtype if dtype is not None else recorded)
 
     from tfde_tpu.models.bert import Bert, BertClassifier

@@ -58,6 +58,10 @@ class GPT(nn.Module):
     # better length extrapolation
     position: str = "learned"
     rope_theta: float = 10_000.0
+    # RoPE frequency rescaling (ops/rotary.scale_frequencies tuple):
+    # ('linear', factor) | ('llama3', factor, low, high, orig_max) — the
+    # Llama-3.1+ long-context checkpoints carry this
+    rope_scaling: Optional[Any] = None
     # partial rotary (the Phi family): only the first rope_dim features of
     # each head rotate; None = full head_dim
     rope_dim: Optional[int] = None
@@ -162,6 +166,8 @@ class GPT(nn.Module):
             decode=self.decode,
             rope=self.position == "rope",
             rope_theta=self.rope_theta,
+            rope_scaling=(tuple(self.rope_scaling)
+                          if self.rope_scaling is not None else None),
             rope_dim=self.rope_dim,
             num_kv_heads=self.num_kv_heads,
             fused_qkv=self.fused_qkv,

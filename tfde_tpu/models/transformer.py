@@ -69,6 +69,11 @@ class MultiHeadAttention(nn.Module):
     decode: bool = False
     rope: bool = False  # rotary q/k rotation (ops/rotary.py) inside the layer
     rope_theta: float = 10_000.0
+    # RoPE frequency rescaling tuple (ops/rotary.scale_frequencies):
+    # ('linear', factor) or ('llama3', factor, low, high, orig_max) — the
+    # Llama-3.1 long-context convention. Tuple (not dict) so the module
+    # config stays hashable.
+    rope_scaling: Optional[tuple] = None
     # partial rotary (Phi convention): only the first rope_dim features of
     # each head rotate; None = full head_dim
     rope_dim: Optional[int] = None
@@ -205,9 +210,11 @@ class MultiHeadAttention(nn.Module):
             q.shape[1], dtype=jnp.int32
         )  # scalar -> [S] (shape-(1,) start broadcasts away), [B] -> [B, S]
         return (apply_rotary(q, pos, self.rope_theta,
-                             rotary_dim=self.rope_dim),
+                             rotary_dim=self.rope_dim,
+                             scaling=self.rope_scaling),
                 apply_rotary(k, pos, self.rope_theta,
-                             rotary_dim=self.rope_dim))
+                             rotary_dim=self.rope_dim,
+                             scaling=self.rope_scaling))
 
     def _decode_attention(self, q, k, v, batch) -> jax.Array:
         """Write this call's K/V into the cache, attend q over the filled
@@ -460,6 +467,7 @@ class TransformerBlock(nn.Module):
     decode: bool = False
     rope: bool = False
     rope_theta: float = 10_000.0
+    rope_scaling: Optional[tuple] = None  # RoPE rescale (MultiHeadAttention)
     rope_dim: Optional[int] = None  # partial rotary (MultiHeadAttention)
     num_kv_heads: Optional[int] = None  # GQA (MultiHeadAttention)
     fused_qkv: bool = False  # one-GEMM qkv projection (MultiHeadAttention)
@@ -502,6 +510,7 @@ class TransformerBlock(nn.Module):
             decode=self.decode,
             rope=self.rope,
             rope_theta=self.rope_theta,
+            rope_scaling=self.rope_scaling,
             rope_dim=self.rope_dim,
             num_kv_heads=self.num_kv_heads,
             fused_qkv=self.fused_qkv,
@@ -613,6 +622,7 @@ class Encoder(nn.Module):
     decode: bool = False
     rope: bool = False
     rope_theta: float = 10_000.0
+    rope_scaling: Optional[tuple] = None
     rope_dim: Optional[int] = None
     num_kv_heads: Optional[int] = None
     fused_qkv: bool = False
@@ -668,6 +678,7 @@ class Encoder(nn.Module):
                 decode=self.decode,
                 rope=self.rope,
                 rope_theta=self.rope_theta,
+                rope_scaling=self.rope_scaling,
                 rope_dim=self.rope_dim,
                 num_kv_heads=self.num_kv_heads,
                 fused_qkv=self.fused_qkv,

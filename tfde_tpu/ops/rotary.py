@@ -22,27 +22,68 @@ import jax
 import jax.numpy as jnp
 
 
+def scale_frequencies(freqs: jax.Array, scaling) -> jax.Array:
+    """RoPE frequency rescaling for long-context fine-tunes.
+
+    `scaling` is a tuple (hashable — it lives on flax module configs):
+      ('linear', factor) — position-interpolation (Llama-2-long style):
+          every frequency divided by factor.
+      ('llama3', factor, low_freq_factor, high_freq_factor,
+       original_max_position) — the Llama-3.1 rule (HF
+       `_compute_llama3_parameters` math): wavelengths shorter than
+       original_max/high_freq_factor keep their frequency, longer than
+       original_max/low_freq_factor divide by factor, and the band
+       between interpolates smoothly.
+    """
+    import math
+
+    kind = scaling[0]
+    if kind == "linear":
+        return freqs / float(scaling[1])
+    if kind == "llama3":
+        _, factor, low_f, high_f, orig_max = scaling
+        factor, low_f, high_f = float(factor), float(low_f), float(high_f)
+        orig_max = float(orig_max)
+        wavelen = 2.0 * math.pi / freqs
+        low_wl = orig_max / low_f
+        high_wl = orig_max / high_f
+        smooth = (orig_max / wavelen - low_f) / (high_f - low_f)
+        interpolated = (1.0 - smooth) * freqs / factor + smooth * freqs
+        return jnp.where(
+            wavelen < high_wl, freqs,
+            jnp.where(wavelen > low_wl, freqs / factor, interpolated),
+        )
+    raise ValueError(
+        f"rope scaling kind must be 'linear' or 'llama3', got {kind!r}"
+    )
+
+
 def rotary_angles(positions: jax.Array, dim: int,
-                  theta: float = 10_000.0) -> tuple:
+                  theta: float = 10_000.0, scaling=None) -> tuple:
     """(cos, sin) [..., dim/2] for integer `positions` [...]."""
     if dim % 2:
         raise ValueError(f"rotary head_dim must be even, got {dim}")
     freqs = theta ** (
         -jnp.arange(0, dim, 2, dtype=jnp.float32) / dim
     )  # [dim/2]
+    if scaling is not None:
+        freqs = scale_frequencies(freqs, scaling)
     ang = positions.astype(jnp.float32)[..., None] * freqs  # [..., dim/2]
     return jnp.cos(ang), jnp.sin(ang)
 
 
 def apply_rotary(x: jax.Array, positions: jax.Array,
                  theta: float = 10_000.0,
-                 rotary_dim=None) -> jax.Array:
+                 rotary_dim=None, scaling=None) -> jax.Array:
     """Rotate [B, S, H, D] by per-token angles; `positions` is [S] or
     [B, S] absolute token positions. fp32 trig, result in x.dtype.
 
     rotary_dim: PARTIAL rotary (the Phi/GPT-NeoX partial_rotary_factor
     convention) — only the first `rotary_dim` features rotate, the rest
-    pass through untouched. None/D = full rotation."""
+    pass through untouched. None/D = full rotation.
+
+    scaling: RoPE frequency rescaling tuple (see scale_frequencies) —
+    the Llama-3.1 long-context convention."""
     d = x.shape[-1]
     if rotary_dim is not None and rotary_dim != d:
         if not 0 < rotary_dim < d:
@@ -51,9 +92,10 @@ def apply_rotary(x: jax.Array, positions: jax.Array,
             )
         rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
         return jnp.concatenate(
-            [apply_rotary(rot, positions, theta), rest], axis=-1
+            [apply_rotary(rot, positions, theta, scaling=scaling), rest],
+            axis=-1,
         )
-    cos, sin = rotary_angles(positions, d, theta)  # [..., S, d/2]
+    cos, sin = rotary_angles(positions, d, theta, scaling)  # [..., S, d/2]
     # broadcast to [B, S, 1, d/2] over heads
     if cos.ndim == 2:  # [S, d/2] -> [1, S, 1, d/2]
         cos, sin = cos[None, :, None], sin[None, :, None]
