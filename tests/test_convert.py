@@ -1231,3 +1231,99 @@ def test_gemma_rope_scaling_roundtrips(rng):
     ids = torch.tensor(rng.integers(0, 101, (2, 40)).astype(np.int64))
     with torch.no_grad():
         assert float((hf(ids).logits - hf2(ids).logits).abs().max()) < 1e-4
+
+
+@pytest.fixture(scope="module")
+def hf_qwen3():
+    cfg = transformers.Qwen3Config(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, attention_dropout=0.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(60)
+    m = transformers.Qwen3ForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_qwen3_logits_match(hf_qwen3, rng):
+    """Qwen3 = bias-free LLaMA arrangement + per-head q/k RMSNorm before
+    rotary (GPT(qk_norm=True)) + decoupled head_dim."""
+    from tfde_tpu.models.convert import qwen3_from_hf
+
+    model, params = qwen3_from_hf(hf_qwen3, dtype=jnp.float32)
+    assert model.qk_norm and not model.qkv_bias and model.head_dim == 16
+    assert "q_norm" in params["decoder"]["block_0"]["attn"]
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_qwen3(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+    # qk_norm actually participates (not a silently ignored knob)
+    off = model.clone(qk_norm=False)
+    p2 = jax.tree_util.tree_map(lambda x: x, params)
+    for i in range(2):
+        p2["decoder"][f"block_{i}"]["attn"].pop("q_norm")
+        p2["decoder"][f"block_{i}"]["attn"].pop("k_norm")
+    other = np.asarray(off.apply({"params": p2}, jnp.asarray(ids)))
+    assert np.abs(other - ref).max() > 1e-3
+
+
+def test_qwen3_converted_generates_like_hf(hf_qwen3, rng):
+    """qk_norm through the KV-cache decode path (norm applied before the
+    cache write, matching the training forward)."""
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import qwen3_from_hf
+
+    model, params = qwen3_from_hf(hf_qwen3, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_qwen3.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_qwen3_roundtrip_to_hf(hf_qwen3, rng):
+    from tfde_tpu.models.convert import qwen3_from_hf, qwen3_to_hf
+
+    model, params = qwen3_from_hf(hf_qwen3, dtype=jnp.float32)
+    hf2 = qwen3_to_hf(model, params)
+    ids = torch.tensor(rng.integers(0, 101, (2, 10)).astype(np.int64))
+    with torch.no_grad():
+        assert float((hf_qwen3(ids).logits - hf2(ids).logits).abs().max()) \
+            < 1e-4
+
+
+def test_mixtral_rope_scaling_roundtrips(rng):
+    """Mixtral consumes and re-emits rope_scaling like the llama family
+    (review r5: it was left out of the scaling sweep)."""
+    from tfde_tpu.models.convert import mixtral_from_hf, mixtral_to_hf
+
+    cfg = transformers.MixtralConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=256, attention_dropout=0.0,
+        sliding_window=None, tie_word_embeddings=False,
+        rope_scaling={"rope_type": "linear", "factor": 4.0},
+    )
+    torch.manual_seed(41)
+    hf = transformers.MixtralForCausalLM(cfg)
+    hf.eval()
+    model, params = mixtral_from_hf(hf, dtype=jnp.float32)
+    assert model.rope_scaling == ("linear", 4.0)
+    ids = torch.tensor(rng.integers(0, 101, (2, 40)).astype(np.int64))
+    with torch.no_grad():
+        ref = hf(ids).logits.numpy()
+    ours = np.asarray(model.apply(
+        {"params": params}, jnp.asarray(ids.numpy(), jnp.int32)
+    ))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+    hf2 = mixtral_to_hf(model, params)
+    assert hf2.config.rope_scaling["factor"] == 4.0
+    with torch.no_grad():
+        assert float((hf(ids).logits - hf2(ids).logits).abs().max()) < 1e-4

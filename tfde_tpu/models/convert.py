@@ -329,6 +329,77 @@ def qwen2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def qwen3_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers Qwen3ForCausalLM.
+
+    Qwen3 is the LLaMA arrangement (bias-free this generation — Qwen2's
+    qkv biases are gone) plus per-head RMSNorm on q and k before rotary
+    (`GPT(qk_norm=True)`, one [head_dim] scale each shared across heads)
+    and a decoupled head_dim. Delegates the weight mapping to
+    `llama_from_hf` and adds the two norm scales per layer."""
+    cfg = hf_model.config
+    model, params = llama_from_hf(hf_model, dtype=dtype)
+    model = model.clone(qk_norm=True)
+    sd = hf_model.state_dict()
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}layers.{i}.self_attn."
+        attn = params["decoder"][f"block_{i}"]["attn"]
+        attn["q_norm"] = {"scale": _np(sd[h + "q_norm.weight"])}
+        attn["k_norm"] = {"scale": _np(sd[h + "k_norm.weight"])}
+    return model, params
+
+
+def qwen3_to_hf(model, params):
+    """A transformers Qwen3ForCausalLM carrying `params` — the inverse of
+    `qwen3_from_hf`: the LLaMA-style state dict plus the per-layer
+    q_norm/k_norm scales."""
+    import transformers
+
+    if (model.position != "rope" or model.norm != "rms"
+            or model.mlp_act != "swiglu" or model.use_bias
+            or model.qkv_bias or not model.qk_norm
+            or model.embed_scale is not None or model.head_bias
+            or model.norm_style != "pre" or model.rope_dim is not None
+            or model.sliding_window is not None):
+        raise NotImplementedError(
+            "qwen3_to_hf requires the Qwen3 arrangement (LLaMA-style "
+            "bias-free blocks with per-head q/k RMSNorm) — models without "
+            "qk_norm export via llama_to_hf"
+        )
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = model.head_dim or hidden // heads
+    cfg = transformers.Qwen3Config(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        num_key_value_heads=model.num_kv_heads or heads,
+        intermediate_size=model.mlp_dim, head_dim=hd,
+        max_position_embeddings=model.max_position,
+        rope_theta=model.rope_theta,
+        rope_scaling=_rope_scaling_dict(model.rope_scaling),
+        rms_norm_eps=model.ln_eps,
+        tie_word_embeddings=model.tie_embeddings,
+        attention_bias=False, attention_dropout=0.0,
+        use_sliding_window=False,
+    )
+    hf = transformers.Qwen3ForCausalLM(cfg)
+    sd = _llama_style_sd(model, params)
+    dec = params["decoder"]
+    for i in range(model.depth):
+        a = dec[f"block_{i}"]["attn"]
+        h = f"model.layers.{i}.self_attn."
+        sd[h + "q_norm.weight"] = _t(a["q_norm"]["scale"])
+        sd[h + "k_norm.weight"] = _t(a["k_norm"]["scale"])
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "rotary_emb" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
 def phi_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(GPT, params) from a transformers PhiForCausalLM.
 
@@ -899,6 +970,8 @@ def mixtral_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
         dtype=dtype if dtype is not None else jnp.bfloat16,
         position="rope",
         rope_theta=float(cfg.rope_theta),
+        rope_scaling=_rope_scaling_tuple(getattr(cfg, "rope_scaling",
+                                                 None)),
         num_kv_heads=kv,
         use_bias=False,
         norm="rms",
@@ -996,7 +1069,9 @@ def mixtral_to_hf(model, params):
         num_key_value_heads=kv, intermediate_size=model.mlp_dim,
         num_local_experts=e, num_experts_per_tok=k, head_dim=hd,
         max_position_embeddings=model.max_position,
-        rope_theta=model.rope_theta, rms_norm_eps=model.ln_eps,
+        rope_theta=model.rope_theta,
+        rope_scaling=_rope_scaling_dict(model.rope_scaling),
+        rms_norm_eps=model.ln_eps,
         sliding_window=model.sliding_window,
         tie_word_embeddings=model.tie_embeddings,
         attention_dropout=0.0, router_aux_loss_coef=0.0,
@@ -2303,6 +2378,7 @@ _FAMILIES = {
     "t5": ("T5ForConditionalGeneration", "t5_from_hf"),
     "falcon": ("FalconForCausalLM", "falcon_from_hf"),
     "mixtral": ("MixtralForCausalLM", "mixtral_from_hf"),
+    "qwen3": ("Qwen3ForCausalLM", "qwen3_from_hf"),
 }
 
 
@@ -2389,7 +2465,8 @@ def load_converted(artifact_dir: str, dtype=None):
 
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
            "qwen2": GPT, "phi": GPT, "neox": GPT, "bigcode": GPT,
-           "opt": GPT, "falcon": GPT, "mixtral": GPT, "bert": Bert,
+           "opt": GPT, "falcon": GPT, "mixtral": GPT, "qwen3": GPT,
+           "bert": Bert,
            "bert-classifier": BertClassifier, "t5": T5}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
@@ -2436,7 +2513,7 @@ def _cli(argv=None) -> str:
             "bigcode": bigcode_to_hf, "opt": opt_to_hf,
             "bert": bert_to_hf, "bert-classifier": bert_classifier_to_hf,
             "t5": t5_to_hf, "falcon": falcon_to_hf,
-            "mixtral": mixtral_to_hf,
+            "mixtral": mixtral_to_hf, "qwen3": qwen3_to_hf,
         }[args.family]
         hf = to_hf(model, params)
         hf.save_pretrained(args.out_dir)

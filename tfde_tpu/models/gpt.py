@@ -74,6 +74,8 @@ class GPT(nn.Module):
     use_bias: bool = True    # False: LLaMA bias-free projections
     # Qwen2: biased q/k/v projections beside bias-free out/MLP
     qkv_bias: bool = False
+    # Qwen3: per-head RMSNorm on q and k before rotary (transformer.py)
+    qk_norm: bool = False
     # 'pre' (GPT-2/LLaMA) | 'parallel' (Phi: one LN per block, attention
     # and MLP side by side on it) | 'parallel2' (GPT-NeoX/Pythia: parallel
     # residual with separate attention/MLP LayerNorms)
@@ -179,6 +181,7 @@ class GPT(nn.Module):
             mlp_act=self.mlp_act,
             use_bias=self.use_bias,
             qkv_bias=self.qkv_bias,
+            qk_norm=self.qk_norm,
             ln_eps=self.ln_eps,
             remat=self.remat,
             num_experts=self.num_experts,

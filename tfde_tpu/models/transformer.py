@@ -86,6 +86,10 @@ class MultiHeadAttention(nn.Module):
     # True: bias on q/k/v (and fused qkv) even when use_bias=False — the
     # Qwen2 arrangement (qkv biased, out projection and MLP bias-free)
     qkv_bias: bool = False
+    # per-head RMSNorm on q and k after projection, BEFORE rotary — the
+    # Qwen3 arrangement (one [head_dim] scale each, shared across heads)
+    qk_norm: bool = False
+    ln_eps: float = 1e-6  # qk_norm epsilon (the block's rms_norm_eps)
     # one [embed, 3, heads, head_dim] projection instead of three
     # [embed, heads, head_dim] GEMMs: a 3x-wider matmul keeps the MXU
     # busier at small per-chip batch (the training MFU knob). Parameter
@@ -165,6 +169,13 @@ class MultiHeadAttention(nn.Module):
                      use_bias=in_bias)(x)
             v = proj(features=(self.kv_heads, self.head_dim),
                      name="value", use_bias=in_bias)(x)
+        if self.qk_norm:
+            qk_rms = functools.partial(
+                nn.RMSNorm, epsilon=self.ln_eps, dtype=jnp.float32,
+                param_dtype=jnp.float32,
+            )
+            q = qk_rms(name="q_norm")(q).astype(self.dtype)
+            k = qk_rms(name="k_norm")(k).astype(self.dtype)
         if self.rope and not self.decode:
             q, k = self._rotate(q, k, jnp.zeros((), jnp.int32))
         # [B, S, H, D]: heads carry the tensor-parallel shard.
@@ -481,6 +492,7 @@ class TransformerBlock(nn.Module):
     mlp_act: str = "gelu"  # Mlp.act
     use_bias: bool = True
     qkv_bias: bool = False  # Qwen2: biased q/k/v beside bias-free out/MLP
+    qk_norm: bool = False  # Qwen3: per-head q/k RMSNorm (MultiHeadAttention)
     ln_eps: float = 1e-6  # checkpoint fidelity: GPT-2 1e-5, BERT 1e-12
     num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
     experts_per_token: int = 2
@@ -519,6 +531,8 @@ class TransformerBlock(nn.Module):
             rolling_cache=self.rolling_cache,
             use_bias=self.use_bias,
             qkv_bias=self.qkv_bias,
+            qk_norm=self.qk_norm,
+            ln_eps=self.ln_eps,
             name="attn",
         )
         if self.num_experts > 0:
@@ -634,6 +648,7 @@ class Encoder(nn.Module):
     mlp_act: str = "gelu"
     use_bias: bool = True
     qkv_bias: bool = False
+    qk_norm: bool = False
     ln_eps: float = 1e-6
     remat: Any = False
     num_experts: int = 0   # > 0: MoE MLP in every `moe_every`-th block
@@ -690,6 +705,7 @@ class Encoder(nn.Module):
                 mlp_act=self.mlp_act,
                 use_bias=self.use_bias,
                 qkv_bias=self.qkv_bias,
+                qk_norm=self.qk_norm,
                 ln_eps=self.ln_eps,
                 num_experts=self.num_experts if is_moe else 0,
                 experts_per_token=self.experts_per_token,
