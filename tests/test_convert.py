@@ -1200,11 +1200,19 @@ def test_llama_rope_scaling_roundtrip_and_artifact(tmp_path, rng):
     np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
 
 
-def test_rope_scaling_yarn_refused():
+def test_rope_scaling_tuple_contract():
     from tfde_tpu.models.convert import _rope_scaling_tuple
 
-    with pytest.raises(NotImplementedError, match="yarn"):
+    # yarn without any original-max source cannot be computed
+    with pytest.raises(NotImplementedError, match="max_position"):
         _rope_scaling_tuple({"rope_type": "yarn", "factor": 4.0})
+    # ... but falls back to the config's max_position (the HF convention)
+    t = _rope_scaling_tuple({"rope_type": "yarn", "factor": 4.0},
+                            max_position=128)
+    assert t[0] == "yarn" and t[4] == 128.0
+    # still-unimplemented rules refuse loudly
+    with pytest.raises(NotImplementedError, match="longrope"):
+        _rope_scaling_tuple({"rope_type": "longrope", "factor": 4.0})
     assert _rope_scaling_tuple(None) is None
     assert _rope_scaling_tuple({"rope_type": "default"}) is None
 
@@ -1327,3 +1335,49 @@ def test_mixtral_rope_scaling_roundtrips(rng):
     assert hf2.config.rope_scaling["factor"] == 4.0
     with torch.no_grad():
         assert float((hf(ids).logits - hf2(ids).logits).abs().max()) < 1e-4
+
+
+@pytest.mark.parametrize("explicit_att", [False, True])
+def test_llama_yarn_rope_scaling(explicit_att, rng):
+    """YaRN (NTK-by-parts + attention temperature): the frequency blend
+    AND the cos/sin attention factor must reproduce transformers' logits
+    — with the factor both mscale-derived and explicit."""
+    from tfde_tpu.models.convert import llama_from_hf, llama_to_hf
+
+    rs = {"rope_type": "yarn", "factor": 4.0,
+          "original_max_position_embeddings": 32}
+    if explicit_att:
+        rs.update(beta_fast=16.0, beta_slow=2.0, attention_factor=1.1)
+    cfg = transformers.LlamaConfig(
+        vocab_size=101, hidden_size=32, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, attention_dropout=0.0,
+        tie_word_embeddings=False, rope_scaling=dict(rs),
+    )
+    torch.manual_seed(55)
+    hf = transformers.LlamaForCausalLM(cfg)
+    hf.eval()
+    model, params = llama_from_hf(hf, dtype=jnp.float32)
+    assert model.rope_scaling[0] == "yarn"
+    ids = torch.tensor(rng.integers(0, 101, (2, 48)).astype(np.int64))
+    with torch.no_grad():
+        ref = hf(ids).logits.numpy()
+    ours = np.asarray(model.apply(
+        {"params": params}, jnp.asarray(ids.numpy(), jnp.int32)
+    ))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+    hf2 = llama_to_hf(model, params)
+    assert hf2.config.rope_scaling["rope_type"] == "yarn"
+    with torch.no_grad():
+        assert float((hf(ids).logits - hf2(ids).logits).abs().max()) < 1e-4
+
+
+def test_qk_norm_models_refused_by_other_exporters(hf_qwen3):
+    """llama/mixtral/gemma exporters have no q_norm/k_norm keys to write
+    — they must refuse qk_norm models, not silently drop the norms
+    (review r5)."""
+    from tfde_tpu.models.convert import llama_to_hf, qwen3_from_hf
+
+    model, params = qwen3_from_hf(hf_qwen3, dtype=jnp.float32)
+    with pytest.raises(NotImplementedError, match="LLaMA arrangement"):
+        llama_to_hf(model, params)

@@ -107,12 +107,17 @@ def gpt2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
-def _rope_scaling_tuple(rs) -> "Optional[tuple]":
+def _rope_scaling_tuple(rs, max_position=None) -> "Optional[tuple]":
     """HF rope_scaling dict -> the hashable tuple ops/rotary understands:
-    ('linear', factor) or ('llama3', factor, low, high, orig_max) — the
-    Llama-3.1 long-context convention. None passes through; yarn /
-    dynamic-NTK / longrope are refused (their frequency rules are not
-    implemented — converting would produce silently wrong logits)."""
+    ('linear', factor), ('llama3', factor, low, high, orig_max), or
+    ('yarn', factor, beta_fast, beta_slow, orig_max, attention_factor,
+    truncate). None passes through; dynamic-NTK / longrope are refused
+    (their frequency rules are not implemented — converting would produce
+    silently wrong logits). `max_position` is the config's
+    max_position_embeddings — yarn's original_max falls back to it, the
+    HF convention."""
+    import math
+
     if not rs:
         return None
     kind = rs.get("rope_type") or rs.get("type")
@@ -124,11 +129,41 @@ def _rope_scaling_tuple(rs) -> "Optional[tuple]":
             float(rs["low_freq_factor"]), float(rs["high_freq_factor"]),
             float(rs["original_max_position_embeddings"]),
         )
+    if kind == "yarn":
+        factor = float(rs["factor"])
+        att = rs.get("attention_factor")
+        if att is None:
+            # the paper's mscale rule (HF _compute_yarn_parameters)
+            def get_mscale(scale, m=1.0):
+                return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+            mscale = rs.get("mscale")
+            mscale_all = rs.get("mscale_all_dim")
+            if mscale and mscale_all:
+                att = get_mscale(factor, mscale) / get_mscale(factor,
+                                                              mscale_all)
+            else:
+                att = get_mscale(factor)
+        orig_max = (rs.get("original_max_position_embeddings")
+                    or max_position)
+        if orig_max is None:
+            raise NotImplementedError(
+                "yarn rope_scaling without original_max_position_"
+                "embeddings needs the config's max_position_embeddings"
+            )
+        return (
+            "yarn", factor,
+            float(rs.get("beta_fast") or 32.0),
+            float(rs.get("beta_slow") or 1.0),
+            float(orig_max), float(att),
+            bool(rs.get("truncate", True)),
+        )
     if kind == "default":
         return None
     raise NotImplementedError(
-        f"rope_scaling type {kind!r} is not supported (only 'linear' and "
-        f"'llama3'); converting would produce silently wrong logits"
+        f"rope_scaling type {kind!r} is not supported (only 'linear', "
+        f"'llama3' and 'yarn'); converting would produce silently wrong "
+        f"logits"
     )
 
 
@@ -146,6 +181,18 @@ def _rope_scaling_dict(scaling) -> "Optional[dict]":
             "high_freq_factor": float(scaling[3]),
             "original_max_position_embeddings": int(scaling[4]),
         }
+    if scaling[0] == "yarn":
+        return {
+            "rope_type": "yarn", "factor": float(scaling[1]),
+            "beta_fast": float(scaling[2]),
+            "beta_slow": float(scaling[3]),
+            "original_max_position_embeddings": int(scaling[4]),
+            # explicit attention_factor: guarantees the exported model
+            # computes the identical temperature even if the import
+            # derived it from mscale
+            "attention_factor": float(scaling[5]),
+            "truncate": bool(scaling[6]),
+        }
     raise NotImplementedError(f"unknown rope scaling {scaling!r}")
 
 
@@ -160,7 +207,10 @@ def llama_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     from tfde_tpu.models.gpt import GPT
 
     cfg = hf_model.config
-    rope_scaling = _rope_scaling_tuple(getattr(cfg, "rope_scaling", None))
+    rope_scaling = _rope_scaling_tuple(
+        getattr(cfg, "rope_scaling", None),
+        max_position=cfg.max_position_embeddings,
+    )
     if getattr(cfg, "attention_bias", False) or getattr(cfg, "mlp_bias", False):
         raise NotImplementedError(
             "checkpoints with attention_bias/mlp_bias are not supported by "
@@ -970,8 +1020,10 @@ def mixtral_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
         dtype=dtype if dtype is not None else jnp.bfloat16,
         position="rope",
         rope_theta=float(cfg.rope_theta),
-        rope_scaling=_rope_scaling_tuple(getattr(cfg, "rope_scaling",
-                                                 None)),
+        rope_scaling=_rope_scaling_tuple(
+            getattr(cfg, "rope_scaling", None),
+            max_position=cfg.max_position_embeddings,
+        ),
         num_kv_heads=kv,
         use_bias=False,
         norm="rms",
@@ -1041,6 +1093,7 @@ def mixtral_to_hf(model, params):
     if (model.position != "rope" or model.norm != "rms"
             or model.mlp_act != "swiglu" or model.use_bias
             or e <= 0 or model.moe_every != 1
+            or getattr(model, "qk_norm", False)
             or model.qkv_bias or model.head_bias
             or model.embed_scale is not None
             or model.norm_style != "pre" or model.rope_dim is not None):
@@ -1552,6 +1605,7 @@ def llama_to_hf(model, params):
     if (model.position != "rope" or model.norm != "rms"
             or model.mlp_act != "swiglu" or model.use_bias
             or model.embed_scale is not None or model.head_bias
+            or getattr(model, "qk_norm", False)
             or model.norm_style != "pre" or model.rope_dim is not None):
         raise NotImplementedError(
             "llama_to_hf requires the LLaMA arrangement (rope — full, not "
@@ -1613,6 +1667,7 @@ def gemma_to_hf(model, params):
     if (model.position != "rope" or model.norm != "rms"
             or model.mlp_act != "geglu" or model.use_bias
             or not model.tie_embeddings or model.qkv_bias
+            or getattr(model, "qk_norm", False)
             or model.head_bias or model.sliding_window is not None
             or model.norm_style != "pre" or model.rope_dim is not None
             or model.embed_scale is None

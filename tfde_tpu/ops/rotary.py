@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 
 
-def scale_frequencies(freqs: jax.Array, scaling) -> jax.Array:
+def scale_frequencies(freqs: jax.Array, scaling,
+                      theta: float = 10_000.0) -> jax.Array:
     """RoPE frequency rescaling for long-context fine-tunes.
 
     `scaling` is a tuple (hashable — it lives on flax module configs):
@@ -34,12 +35,45 @@ def scale_frequencies(freqs: jax.Array, scaling) -> jax.Array:
        original_max/high_freq_factor keep their frequency, longer than
        original_max/low_freq_factor divide by factor, and the band
        between interpolates smoothly.
+      ('yarn', factor, beta_fast, beta_slow, original_max,
+       attention_factor, truncate) — NTK-by-parts (HF
+       `_compute_yarn_parameters` math): dimensions rotating faster than
+       beta_fast turns over the original context keep their frequency
+       (extrapolation), slower than beta_slow divide by factor
+       (interpolation), with a linear ramp between; attention_factor
+       additionally scales cos/sin (applied in rotary_angles).
+       `theta` must be the same base the frequencies were built with —
+       the correction range is computed in its log space.
     """
     import math
 
     kind = scaling[0]
     if kind == "linear":
         return freqs / float(scaling[1])
+    if kind == "yarn":
+        _, factor, beta_fast, beta_slow, orig_max, _att, truncate = scaling
+        factor = float(factor)
+        dim = freqs.shape[0] * 2
+
+        def corr_dim(num_rot: float) -> float:
+            return (dim * math.log(float(orig_max)
+                                   / (num_rot * 2 * math.pi))
+                    ) / (2 * math.log(theta))
+
+        low = corr_dim(float(beta_fast))
+        high = corr_dim(float(beta_slow))
+        if truncate:
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0), min(high, dim - 1)
+        if low == high:
+            high += 0.001  # prevent singularity (the HF guard)
+        ramp = jnp.clip(
+            (jnp.arange(dim // 2, dtype=jnp.float32) - low) / (high - low),
+            0.0, 1.0,
+        )
+        extrapolation_factor = 1.0 - ramp
+        return (freqs / factor) * (1.0 - extrapolation_factor) \
+            + freqs * extrapolation_factor
     if kind == "llama3":
         _, factor, low_f, high_f, orig_max = scaling
         factor, low_f, high_f = float(factor), float(low_f), float(high_f)
@@ -54,7 +88,8 @@ def scale_frequencies(freqs: jax.Array, scaling) -> jax.Array:
             jnp.where(wavelen > low_wl, freqs / factor, interpolated),
         )
     raise ValueError(
-        f"rope scaling kind must be 'linear' or 'llama3', got {kind!r}"
+        f"rope scaling kind must be 'linear', 'llama3' or 'yarn', "
+        f"got {kind!r}"
     )
 
 
@@ -67,9 +102,15 @@ def rotary_angles(positions: jax.Array, dim: int,
         -jnp.arange(0, dim, 2, dtype=jnp.float32) / dim
     )  # [dim/2]
     if scaling is not None:
-        freqs = scale_frequencies(freqs, scaling)
+        freqs = scale_frequencies(freqs, scaling, theta)
     ang = positions.astype(jnp.float32)[..., None] * freqs  # [..., dim/2]
-    return jnp.cos(ang), jnp.sin(ang)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    if scaling is not None and scaling[0] == "yarn":
+        # yarn's attention temperature: cos/sin scale by the attention
+        # factor (HF multiplies the cached cos/sin the same way)
+        att = float(scaling[5])
+        cos, sin = cos * att, sin * att
+    return cos, sin
 
 
 def apply_rotary(x: jax.Array, positions: jax.Array,
