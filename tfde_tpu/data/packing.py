@@ -1,0 +1,150 @@
+"""Sequence packing for causal-LM training: variable-length documents
+packed into fixed [B, S] rows with block-diagonal attention.
+
+Padding each document to the max length wastes compute proportional to the
+length variance; packing several documents per row recovers it — the
+standard LM-pretraining input discipline. TPU-fit: shapes stay static (the
+packed batch is an ordinary [B, S] int array plus a same-shaped segment-id
+plane), the model's attention composes the segment mask with its causal
+triangle (models/gpt.py `segment_ids=`), and the loss masks cross-document
+boundary predictions. With rope positions the packed forward is EXACT per
+document (rope attention depends only on relative in-segment position and
+cross-segment pairs are masked — tests/test_packing.py pins packed logits
+== solo logits).
+
+Note: the segment mask routes attention to the reference einsum (the flash
+kernel and the seq ring take causal/key-padding masks only) — packing is a
+host-side throughput lever, not a kernel-side one.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+IGNORE_ID = -100
+
+
+def pack_documents(
+    docs: Sequence[np.ndarray],
+    seq_len: int,
+    pad_id: int = 0,
+    max_open_rows: int = 1024,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Greedy first-fit packing over a BOUNDED pool of open rows: each
+    document lands whole in the first open row with room (documents
+    longer than seq_len split into seq_len chunks first). Returns
+    (tokens [N, S], segment_ids [N, S]) with segment ids 1..k per row
+    and 0 marking padding.
+
+    Every input token appears exactly once, in order, within its segment
+    (tested); rows are created on demand, so N adapts to the corpus.
+    `max_open_rows` caps how many partially-filled rows stay candidates
+    (oldest closes first past the cap; full rows close immediately), so
+    packing stays O(pieces * max_open_rows) instead of quadratic at
+    corpus scale, at a negligible density cost.
+    """
+    if seq_len < 1:
+        raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+    pieces: List[np.ndarray] = []
+    for d in docs:
+        d = np.asarray(d)
+        if d.ndim != 1:
+            raise ValueError(
+                f"each document must be a 1-D token array, got shape "
+                f"{d.shape}"
+            )
+        if len(d) == 0:
+            continue
+        for start in range(0, len(d), seq_len):
+            pieces.append(d[start:start + seq_len])
+
+    rows: List[List[np.ndarray]] = []
+    space: List[int] = []
+    open_rows: List[int] = []  # indices into rows/space, oldest first
+    for p in pieces:
+        placed = False
+        for j, i in enumerate(open_rows):
+            if len(p) <= space[i]:
+                rows[i].append(p)
+                space[i] -= len(p)
+                if space[i] == 0:
+                    open_rows.pop(j)
+                placed = True
+                break
+        if not placed:
+            rows.append([p])
+            space.append(seq_len - len(p))
+            if space[-1] > 0:
+                open_rows.append(len(rows) - 1)
+                if len(open_rows) > max_open_rows:
+                    open_rows.pop(0)
+
+    n = max(len(rows), 1)
+    tokens = np.full((n, seq_len), pad_id, dtype=np.int32)
+    segment_ids = np.zeros((n, seq_len), dtype=np.int32)
+    for i, row in enumerate(rows):
+        at = 0
+        for seg, p in enumerate(row, start=1):
+            tokens[i, at:at + len(p)] = p
+            segment_ids[i, at:at + len(p)] = seg
+            at += len(p)
+    return tokens, segment_ids
+
+
+def valid_targets(segment_ids):
+    """[B, S-1] bool: position i+1 is a valid next-token target of
+    position i — same segment, not padding. The ONE definition of the
+    boundary rule, shared by the host-side `packed_labels` and the
+    on-device `packed_next_token_loss` (numpy and jnp arrays both
+    accepted — only elementwise ops are used)."""
+    seg = segment_ids
+    return (seg[:, 1:] > 0) & (seg[:, 1:] == seg[:, :-1])
+
+
+def packed_labels(tokens: np.ndarray, segment_ids: np.ndarray,
+                  ignore_id: int = IGNORE_ID) -> np.ndarray:
+    """Next-token labels for a packed batch, aligned to the shifted loss
+    (label[i] is the target of position i-1): positions whose PREDICTION
+    would cross a document boundary — the first token of every segment
+    and all padding — are `ignore_id`."""
+    tokens = np.asarray(tokens)
+    seg = np.asarray(segment_ids)
+    labels = tokens.copy().astype(np.int32)
+    valid = np.zeros_like(seg, dtype=bool)
+    valid[:, 1:] = valid_targets(seg)
+    labels[~valid] = ignore_id
+    return labels
+
+
+def packed_next_token_loss(state, params, batch, rng):
+    """(loss, metrics) for make_custom_train_step over packed batches:
+    batch = (tokens, segment_ids). Shifted CE over in-segment positions
+    only (cross-boundary and padding predictions are masked), with
+    `grad_weight` carrying the target count so gradient accumulation
+    reproduces the exact full-batch update on unevenly-packed
+    microbatches (training/step.py). Applies with mutable=["losses"] so
+    a routed (MoE) GPT's sown balance losses join the objective here
+    exactly as in next_token_loss."""
+    from tfde_tpu.ops.losses import masked_lm_loss
+    from tfde_tpu.training.step import sown_losses_by_name
+
+    tokens, segment_ids = batch
+    logits, mutated = state.apply_fn(
+        {"params": params}, tokens, train=True, segment_ids=segment_ids,
+        rngs={"dropout": rng}, mutable=["losses"],
+    )
+    seg = segment_ids.astype(jnp.int32)
+    labels = tokens[:, 1:].astype(jnp.int32)
+    valid = valid_targets(seg)
+    labels = jnp.where(valid, labels, IGNORE_ID)
+    loss, acc = masked_lm_loss(logits[:, :-1], labels)
+    n_targets = jnp.sum(valid.astype(jnp.float32))
+    metrics = {"packed_accuracy": acc, "grad_weight": n_targets}
+    for name, total in sown_losses_by_name(
+            mutated.get("losses", {})).items():
+        loss = loss + total
+        metrics[name] = total
+    return loss, metrics

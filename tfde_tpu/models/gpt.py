@@ -104,12 +104,48 @@ class GPT(nn.Module):
     sliding_window: Optional[int] = None
 
     @nn.compact
-    def __call__(self, input_ids: jax.Array, train: bool = False) -> jax.Array:
+    def __call__(self, input_ids: jax.Array, train: bool = False,
+                 segment_ids: Optional[jax.Array] = None) -> jax.Array:
+        """segment_ids [B, S]: sequence-packing support (data/packing.py)
+        — tokens attend only within their own segment (block-diagonal
+        causal mask; padding is segment 0 and attends only other padding,
+        keeping its softmax rows finite). Positions stay GLOBAL within
+        the packed row: exact for rope (attention depends only on
+        relative position, and cross-segment pairs are masked), offset
+        but consistent for learned positions. Training-side only —
+        decode mode refuses it."""
         if self.quant is not None and train:
             raise ValueError(
                 "quant='int8' is a serving-only mode (round() has zero "
                 "gradient) — train the fp model, then quantize_model it"
             )
+        seg_mask = None
+        if segment_ids is not None:
+            if self.decode:
+                raise NotImplementedError(
+                    "segment_ids (sequence packing) is a training-side "
+                    "capability; the decode cache has no segment plane"
+                )
+            if self.sliding_window is not None:
+                raise NotImplementedError(
+                    "segment_ids does not compose with sliding_window "
+                    "yet (the band would need per-segment offsets)"
+                )
+            from tfde_tpu.ops.attention import _seq_parallel_active
+
+            if _seq_parallel_active():
+                # auto-dispatch would pick the seq ring, which takes
+                # key-padding masks only — fail HERE with the cause named
+                # instead of a mask-shape error deep inside the ring
+                raise NotImplementedError(
+                    "segment_ids (sequence packing) does not compose "
+                    "with sequence parallelism — the ring would need a "
+                    "sharded segment plane; train packed batches under "
+                    "dp/fsdp/tp"
+                )
+            seg = segment_ids.astype(jnp.int32)
+            # [B, 1, S, S]; the causal triangle composes inside attention
+            seg_mask = (seg[:, None, :, None] == seg[:, None, None, :])
         b = batch_axes()
         seq = input_ids.shape[1]
         if self.quant is not None:
@@ -190,7 +226,7 @@ class GPT(nn.Module):
             moe_capacity_factor=self.moe_capacity_factor,
             router_z_loss_weight=self.router_z_loss_weight,
             name="decoder",
-        )(x, train=train)
+        )(x, mask=seg_mask, train=train)
         if self.tie_embeddings:
             if self.head_bias:
                 raise ValueError(
