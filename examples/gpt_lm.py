@@ -81,6 +81,11 @@ def main(argv=None):
     parser.add_argument("--tensor", type=int, default=1,
                         help="with --pipeline: Megatron tensor-parallel "
                              "size inside each stage (dp x pp x tp, 3D)")
+    parser.add_argument("--packed", action="store_true",
+                        help="sequence packing (data/packing.py): pack "
+                             "variable-length synthetic documents into "
+                             "fixed rows with block-diagonal attention "
+                             "and boundary-masked loss")
     parser.add_argument("--moe", type=int, default=0,
                         help="experts per MoE block; shards them over an "
                              "'expert' mesh axis (expert parallelism)")
@@ -216,9 +221,34 @@ def main(argv=None):
     if args.seq_len % max(args.seq_parallel, 1) != 0:
         raise ValueError("--seq-len must divide evenly by --seq-parallel")
 
-    tokens = datasets.synthetic_tokens(
-        args.train_examples, args.seq_len, vocab=model.vocab_size
-    )
+    seg = None
+    if args.packed:
+        if args.pipeline > 1 or args.seq_parallel > 1:
+            raise ValueError(
+                "--packed doesn't compose with --pipeline/--seq-parallel "
+                "(the segment mask needs the plain dp/tp attention path)"
+            )
+        if args.sliding_window > 0:
+            raise ValueError("--packed doesn't compose with --sliding-window")
+        from tfde_tpu.data.packing import pack_documents
+
+        # one [N, S] stream trimmed to per-document lengths: every row is
+        # an independent Markov sequence (a fixed per-doc seed would make
+        # equal-length documents bit-identical and the corpus degenerate)
+        nrng0 = np.random.default_rng(7)
+        stream = datasets.synthetic_tokens(
+            args.train_examples, args.seq_len, vocab=model.vocab_size
+        )
+        lengths = nrng0.integers(args.seq_len // 4, args.seq_len,
+                                 args.train_examples)
+        docs = [stream[i, : int(n)] for i, n in enumerate(lengths)]
+        tokens, seg = pack_documents(docs, args.seq_len)
+        log.info("packed %d docs into %d rows (fill %.0f%%)",
+                 len(docs), len(tokens), 100 * (seg > 0).mean())
+    else:
+        tokens = datasets.synthetic_tokens(
+            args.train_examples, args.seq_len, vocab=model.vocab_size
+        )
 
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, args.learning_rate,
@@ -272,6 +302,10 @@ def main(argv=None):
         from tfde_tpu.models.pipelined import pipelined_next_token_loss
 
         loss_fn = pipelined_next_token_loss
+    elif args.packed:
+        from tfde_tpu.data.packing import packed_next_token_loss
+
+        loss_fn = packed_next_token_loss
     else:
         loss_fn = next_token_loss
     step_fn = make_custom_train_step(strategy, state, loss_fn,
@@ -282,7 +316,8 @@ def main(argv=None):
     metrics = {}
     for step in range(args.max_steps):
         idx = nrng.integers(0, len(tokens), global_batch)
-        state, metrics = step_fn(state, (tokens[idx],), rng)
+        batch = (tokens[idx], seg[idx]) if seg is not None else (tokens[idx],)
+        state, metrics = step_fn(state, batch, rng)
         if (step + 1) % 100 == 0:
             vals = {k: float(jax.device_get(v)) for k, v in metrics.items()}
             sps = 100 / (time.time() - t0)

@@ -167,3 +167,13 @@ def test_t5_seq2seq_example_smoke():
     )
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
     assert int(jax.device_get(state.step)) == 2
+
+
+def test_gpt_lm_packed_smoke():
+    from examples import gpt_lm
+
+    state, metrics = gpt_lm.main(
+        ["--tiny", "--rope", "--packed", "--seq-len", "32", "--max-steps",
+         "2", "--batch-size", "16", "--train-examples", "64"]
+    )
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
