@@ -222,6 +222,10 @@ def main(argv=None):
         raise ValueError("--seq-len must divide evenly by --seq-parallel")
 
     seg = None
+    # one corpus-construction site for both branches
+    tokens = datasets.synthetic_tokens(
+        args.train_examples, args.seq_len, vocab=model.vocab_size
+    )
     if args.packed:
         if args.pipeline > 1 or args.seq_parallel > 1:
             raise ValueError(
@@ -232,23 +236,16 @@ def main(argv=None):
             raise ValueError("--packed doesn't compose with --sliding-window")
         from tfde_tpu.data.packing import pack_documents
 
-        # one [N, S] stream trimmed to per-document lengths: every row is
-        # an independent Markov sequence (a fixed per-doc seed would make
+        # trim the [N, S] stream to per-document lengths: every row is an
+        # independent Markov sequence (a fixed per-doc seed would make
         # equal-length documents bit-identical and the corpus degenerate)
         nrng0 = np.random.default_rng(7)
-        stream = datasets.synthetic_tokens(
-            args.train_examples, args.seq_len, vocab=model.vocab_size
-        )
         lengths = nrng0.integers(args.seq_len // 4, args.seq_len,
                                  args.train_examples)
-        docs = [stream[i, : int(n)] for i, n in enumerate(lengths)]
+        docs = [tokens[i, : int(n)] for i, n in enumerate(lengths)]
         tokens, seg = pack_documents(docs, args.seq_len)
         log.info("packed %d docs into %d rows (fill %.0f%%)",
                  len(docs), len(tokens), 100 * (seg > 0).mean())
-    else:
-        tokens = datasets.synthetic_tokens(
-            args.train_examples, args.seq_len, vocab=model.vocab_size
-        )
 
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, args.learning_rate,
@@ -325,7 +322,18 @@ def main(argv=None):
             log.info("step %d: %s (%.2f steps/s)", step + 1, vals, sps)
 
     if args.generate > 0:
-        prompt = tokens[:2, : min(16, args.seq_len)]
+        if seg is not None:
+            # packed rows hold several documents: prompting across a
+            # boundary would condition on context training explicitly
+            # masked. Prompt from each row's FIRST document only, at a
+            # common length.
+            keep = min(
+                int((seg[0] == 1).sum()), int((seg[1] == 1).sum()),
+                16, args.seq_len,
+            )
+            prompt = tokens[:2, :keep]
+        else:
+            prompt = tokens[:2, : min(16, args.seq_len)]
         # one sampling config for the in-process decode AND the export, so
         # the artifact reproduces exactly what was just logged
         sampling = dict(temperature=0.8, top_k=40)
