@@ -1,0 +1,136 @@
+"""Raw text -> packed LM training batches: the end-to-end text input
+pipeline (tokenize offline, pack with data/packing.py, stream fixed-shape
+batches).
+
+The reference's data story starts at numpy arrays / TFDS
+(/root/reference/mnist_keras_distributed.py:123-148); for the language
+families this framework adds, training starts at text files. Everything
+is host-side numpy + an offline transformers tokenizer (a LOCAL
+save_pretrained() directory — nothing downloads), producing the static
+[B, S] token + segment-id batches the packed training path consumes
+(models/gpt.py segment_ids, data/packing.packed_next_token_loss).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tfde_tpu.data.packing import pack_documents
+
+
+def load_tokenizer(tokenizer_dir: str):
+    """Offline AutoTokenizer from a local save_pretrained() directory
+    (the serve_gpt.py convention — this CLI surface never downloads)."""
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(tokenizer_dir,
+                                         local_files_only=True)
+
+
+def read_documents(
+    paths: Sequence[str],
+    split: str = "paragraph",
+) -> List[str]:
+    """Text files -> document strings. split: 'paragraph' (blank-line
+    separated — the common pretraining convention), 'line', or 'file'.
+    Empty documents are dropped."""
+    docs: List[str] = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            content = f.read()
+        if split == "file":
+            parts = [content]
+        elif split == "line":
+            parts = content.splitlines()
+        elif split == "paragraph":
+            parts = content.split("\n\n")
+        else:
+            raise ValueError(
+                f"split must be 'paragraph', 'line' or 'file', got "
+                f"{split!r}"
+            )
+        docs.extend(p.strip() for p in parts if p.strip())
+    return docs
+
+
+def tokenize_documents(
+    docs: Sequence[str],
+    tokenizer,
+    append_eos: bool = True,
+    vocab_limit: Optional[int] = None,
+) -> List[np.ndarray]:
+    """Documents -> int32 token arrays. append_eos terminates each
+    document with the tokenizer's eos (documents pack back-to-back, and
+    the model should learn where one ends). vocab_limit (the model's
+    vocab_size) makes an oversized tokenizer fail HERE with the ids
+    named, not as a device-side gather surprise mid-training."""
+    eos = None
+    if append_eos:
+        eos = tokenizer.eos_token_id
+        if eos is None:
+            raise ValueError(
+                "append_eos=True but the tokenizer has no eos_token — a "
+                "model trained on unterminated documents never learns to "
+                "stop; pass append_eos=False to pack without terminators"
+            )
+    out: List[np.ndarray] = []
+    for d in docs:
+        ids = tokenizer(d, add_special_tokens=False)["input_ids"]
+        if eos is not None:
+            ids = list(ids) + [eos]
+        if not ids:
+            continue
+        arr = np.asarray(ids, np.int32)
+        if vocab_limit is not None and arr.max() >= vocab_limit:
+            raise ValueError(
+                f"token id {int(arr.max())} >= model vocab {vocab_limit}: "
+                f"tokenizer and model do not match"
+            )
+        out.append(arr)
+    return out
+
+
+def packed_text_batches(
+    paths: Sequence[str],
+    tokenizer,
+    seq_len: int,
+    batch_size: int,
+    split: str = "paragraph",
+    append_eos: bool = True,
+    vocab_limit: Optional[int] = None,
+    seed: int = 0,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """The whole pipeline as one infinite batch stream: read -> tokenize
+    -> pack once, then yield shuffled (tokens [B, S], segment_ids [B, S])
+    batches forever (rows re-shuffled each epoch; the final partial batch
+    of an epoch is dropped, keeping shapes static).
+
+    Feed each yielded pair to `packed_next_token_loss` via
+    `make_custom_train_step` — examples/gpt_lm.py's --packed loss path.
+    """
+    docs = read_documents(paths, split=split)
+    if not docs:
+        raise ValueError(f"no documents found in {list(paths)!r}")
+    token_docs = tokenize_documents(docs, tokenizer,
+                                    append_eos=append_eos,
+                                    vocab_limit=vocab_limit)
+    tokens, seg = pack_documents(token_docs, seq_len)
+    if len(tokens) < batch_size:
+        # replicate rows up to one batch rather than failing a small
+        # corpus — smoke configs and tests hit this constantly
+        reps = -(-batch_size // len(tokens))
+        tokens = np.tile(tokens, (reps, 1))
+        seg = np.tile(seg, (reps, 1))
+    # the tested shuffle/repeat/batch fast path (data/pipeline.py) — one
+    # stream implementation, not a hand-rolled twin that can drift
+    from tfde_tpu.data.pipeline import Dataset
+
+    ds = (
+        Dataset.from_tensor_slices((tokens, seg))
+        .shuffle(len(tokens), seed=seed)
+        .repeat()
+        .batch(batch_size, drop_remainder=True)
+    )
+    yield from iter(ds)
