@@ -19,3 +19,14 @@ from tfde_tpu.data.streaming import (  # noqa: F401
     StreamingTFRecordLoader,
     shard_files,
 )
+from tfde_tpu.data.packing import (  # noqa: F401
+    pack_documents,
+    packed_labels,
+    packed_next_token_loss,
+)
+from tfde_tpu.data.text import (  # noqa: F401
+    load_tokenizer,
+    packed_text_batches,
+    read_documents,
+    tokenize_documents,
+)

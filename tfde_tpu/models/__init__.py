@@ -19,3 +19,11 @@ from tfde_tpu.models.bert import Bert, BertBase, BertLarge, bert_tiny_test  # no
 from tfde_tpu.models.gpt import GPT, GPT2Small, GPT2Medium, gpt_tiny_test  # noqa: F401
 from tfde_tpu.models.moe import MoEMlp  # noqa: F401
 from tfde_tpu.models.pipelined import PipelinedLM, pipelined_tiny_test  # noqa: F401
+from tfde_tpu.models.t5 import (  # noqa: F401
+    T5,
+    T5Base,
+    T5Small,
+    t5_generate,
+    t5_seq2seq_loss,
+    t5_tiny_test,
+)

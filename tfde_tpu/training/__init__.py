@@ -3,6 +3,11 @@ train-and-evaluate driver."""
 
 from tfde_tpu.training.train_state import TrainState  # noqa: F401
 from tfde_tpu.training.step import make_train_step, make_eval_step, init_state  # noqa: F401
+from tfde_tpu.training.optimizers import (  # noqa: F401
+    adamw,
+    ema_params,
+    with_param_ema,
+)
 from tfde_tpu.training.lora import (  # noqa: F401
     LoraConfig,
     init_lora,
