@@ -1381,3 +1381,87 @@ def test_qk_norm_models_refused_by_other_exporters(hf_qwen3):
     model, params = qwen3_from_hf(hf_qwen3, dtype=jnp.float32)
     with pytest.raises(NotImplementedError, match="LLaMA arrangement"):
         llama_to_hf(model, params)
+
+
+@pytest.fixture(scope="module")
+def hf_phi3():
+    cfg = transformers.Phi3Config(
+        vocab_size=101, hidden_size=32, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=64, num_hidden_layers=2,
+        max_position_embeddings=64, pad_token_id=0, attention_dropout=0.0,
+        resid_pdrop=0.0, embd_pdrop=0.0, sliding_window=None,
+    )
+    torch.manual_seed(70)
+    m = transformers.Phi3ForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_phi3_logits_match(hf_phi3, rng):
+    """Phi-3 = LLaMA arrangement with FUSED checkpoint layouts: qkv_proj
+    splits into q/k/v (GQA widths), gate_up_proj into gate/up."""
+    from tfde_tpu.models.convert import phi3_from_hf
+
+    model, params = phi3_from_hf(hf_phi3, dtype=jnp.float32)
+    assert model.mlp_act == "swiglu" and model.num_kv_heads == 2
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_phi3(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_phi3_converted_generates_like_hf(hf_phi3, rng):
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import phi3_from_hf
+
+    model, params = phi3_from_hf(hf_phi3, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_phi3.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_phi3_roundtrip_to_hf(hf_phi3, rng):
+    from tfde_tpu.models.convert import phi3_from_hf, phi3_to_hf
+
+    model, params = phi3_from_hf(hf_phi3, dtype=jnp.float32)
+    hf2 = phi3_to_hf(model, params)
+    ids = torch.tensor(rng.integers(0, 101, (2, 10)).astype(np.int64))
+    with torch.no_grad():
+        assert float((hf_phi3(ids).logits - hf2(ids).logits).abs().max()) \
+            < 1e-4
+
+
+def test_phi3_longrope_refused():
+    from tfde_tpu.models.convert import phi3_from_hf
+
+    cfg = transformers.Phi3Config(
+        vocab_size=53, hidden_size=16, num_attention_heads=2,
+        num_key_value_heads=1, intermediate_size=32, num_hidden_layers=1,
+        max_position_embeddings=64,
+        original_max_position_embeddings=32, pad_token_id=0,
+        rope_scaling={"type": "longrope",
+                      "short_factor": [1.0] * 4,
+                      "long_factor": [2.0] * 4},
+    )
+    torch.manual_seed(0)
+    m = transformers.Phi3ForCausalLM(cfg)
+    with pytest.raises(NotImplementedError, match="longrope"):
+        phi3_from_hf(m, dtype=jnp.float32)
+
+
+def test_phi3_to_hf_refuses_rope_scaling(hf_phi3):
+    """Phi3Config only validates longrope-format scaling dicts; exporting
+    a linear/llama3/yarn-scaled model must refuse cleanly, not crash in
+    the config validator (review r5)."""
+    from tfde_tpu.models.convert import phi3_from_hf, phi3_to_hf
+
+    model, params = phi3_from_hf(hf_phi3, dtype=jnp.float32)
+    scaled = model.clone(rope_scaling=("linear", 2.0))
+    with pytest.raises(NotImplementedError, match="longrope"):
+        phi3_to_hf(scaled, params)

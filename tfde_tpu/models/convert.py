@@ -379,6 +379,163 @@ def qwen2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def phi3_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers Phi3ForCausalLM (Phi-3/3.5-mini).
+
+    The Phi-3 arrangement is LLaMA-shaped (rope + GQA + RMSNorm +
+    gated-silu + bias-free + untied head) with FUSED checkpoint layouts:
+    qkv_proj packs [q | k | v] rows flat, gate_up_proj packs
+    [gate | up] — split here into the standard kernels. Long-context
+    variants carry rope_scaling='longrope', which _rope_scaling_tuple
+    refuses loudly (the 4k-context releases ship rope_scaling null).
+    partial_rotary_factor < 1 maps to GPT(rope_dim=...)."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    if getattr(cfg, "hidden_act", "silu") != "silu":
+        raise NotImplementedError(
+            f"hidden_act {cfg.hidden_act!r} is not supported (Phi-3 "
+            f"releases use silu)"
+        )
+    heads = cfg.num_attention_heads
+    hidden = cfg.hidden_size
+    hd = hidden // heads
+    kv = cfg.num_key_value_heads
+    prf = float(getattr(cfg, "partial_rotary_factor", 1.0))
+    rope_dim = None if prf == 1.0 else int(hd * prf)
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.num_hidden_layers,
+        num_heads=heads,
+        mlp_dim=cfg.intermediate_size,
+        max_position=cfg.max_position_embeddings,
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        position="rope",
+        rope_theta=float(cfg.rope_theta),
+        rope_scaling=_rope_scaling_tuple(
+            getattr(cfg, "rope_scaling", None),
+            max_position=cfg.max_position_embeddings,
+        ),
+        rope_dim=rope_dim,
+        num_kv_heads=kv,
+        use_bias=False,
+        norm="rms",
+        mlp_act="swiglu",
+        sliding_window=getattr(cfg, "sliding_window", None),
+        tie_embeddings=bool(getattr(cfg, "tie_word_embeddings", False)),
+        ln_eps=cfg.rms_norm_eps,
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    params = {
+        "wte": {"embedding": sd[f"{pre}embed_tokens.weight"]},
+        "decoder": {
+            "ln_final": {"scale": sd[f"{pre}norm.weight"]},
+        },
+    }
+    if not model.tie_embeddings:
+        params["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    f = cfg.intermediate_size
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}layers.{i}."
+        qkv = sd[h + "self_attn.qkv_proj.weight"].T  # [d, H + 2*kv*hd]
+        qw, kw, vw = np.split(
+            qkv, [heads * hd, heads * hd + kv * hd], axis=1
+        )
+        gate_up = sd[h + "mlp.gate_up_proj.weight"].T  # [d, 2F]
+        gw, uw = np.split(gate_up, [f], axis=1)
+        params["decoder"][f"block_{i}"] = {
+            "ln_attn": {"scale": sd[h + "input_layernorm.weight"]},
+            "ln_mlp": {"scale": sd[h + "post_attention_layernorm.weight"]},
+            "attn": {
+                "query": {"kernel": qw.reshape(hidden, heads, hd)},
+                "key": {"kernel": kw.reshape(hidden, kv, hd)},
+                "value": {"kernel": vw.reshape(hidden, kv, hd)},
+                "out": {"kernel": sd[h + "self_attn.o_proj.weight"].T
+                        .reshape(heads, hd, hidden)},
+            },
+            "mlp": {
+                "gate": {"kernel": gw},
+                "fc1": {"kernel": uw},
+                "fc2": {"kernel": sd[h + "mlp.down_proj.weight"].T},
+            },
+        }
+    return model, params
+
+
+def phi3_to_hf(model, params):
+    """A transformers Phi3ForCausalLM carrying `params` — the inverse of
+    `phi3_from_hf`: the shared LLaMA-style state dict with q/k/v fused
+    back into qkv_proj and gate/up into gate_up_proj."""
+    import torch
+    import transformers
+
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = hidden // heads
+    kv = model.num_kv_heads or heads
+    if (model.position != "rope" or model.norm != "rms"
+            or model.mlp_act != "swiglu" or model.use_bias
+            or model.qkv_bias or model.head_bias
+            or getattr(model, "qk_norm", False)
+            or model.embed_scale is not None or model.head_dim is not None
+            or model.norm_style != "pre"):
+        raise NotImplementedError(
+            "phi3_to_hf requires the Phi-3 arrangement (LLaMA-style "
+            "bias-free gated-silu blocks with fused-checkpoint layouts) "
+            "— other families export via their own inverses"
+        )
+    if model.rope_scaling is not None:
+        # Phi3Config validates rope_scaling as longrope-format only
+        # ({type, short_factor, long_factor}); the linear/llama3/yarn
+        # tuples this framework carries have no Phi-3 representation
+        raise NotImplementedError(
+            f"rope_scaling {tuple(model.rope_scaling)!r} has no Phi-3 "
+            f"config representation (Phi-3 long-context is 'longrope', "
+            f"which is not implemented) — export via llama_to_hf instead"
+        )
+    prf = 1.0 if model.rope_dim is None else model.rope_dim / hd
+    cfg = transformers.Phi3Config(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        num_key_value_heads=kv, intermediate_size=model.mlp_dim,
+        max_position_embeddings=model.max_position,
+        rope_theta=model.rope_theta,
+        partial_rotary_factor=prf,
+        rms_norm_eps=model.ln_eps,
+        sliding_window=model.sliding_window,
+        tie_word_embeddings=model.tie_embeddings,
+        attention_dropout=0.0, resid_pdrop=0.0, embd_pdrop=0.0,
+        pad_token_id=0,
+    )
+    hf = transformers.Phi3ForCausalLM(cfg)
+    # the ONE llama-style builder, then fuse its per-layer keys into the
+    # Phi-3 checkpoint layout
+    sd = _llama_style_sd(model, params)
+    for i in range(model.depth):
+        h = f"model.layers.{i}."
+        sd[h + "self_attn.qkv_proj.weight"] = torch.cat(
+            [sd.pop(h + "self_attn.q_proj.weight"),
+             sd.pop(h + "self_attn.k_proj.weight"),
+             sd.pop(h + "self_attn.v_proj.weight")], dim=0,
+        )
+        sd[h + "mlp.gate_up_proj.weight"] = torch.cat(
+            [sd.pop(h + "mlp.gate_proj.weight"),
+             sd.pop(h + "mlp.up_proj.weight")], dim=0,
+        )
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "rotary_emb" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
 def qwen3_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(GPT, params) from a transformers Qwen3ForCausalLM.
 
@@ -2434,6 +2591,7 @@ _FAMILIES = {
     "falcon": ("FalconForCausalLM", "falcon_from_hf"),
     "mixtral": ("MixtralForCausalLM", "mixtral_from_hf"),
     "qwen3": ("Qwen3ForCausalLM", "qwen3_from_hf"),
+    "phi3": ("Phi3ForCausalLM", "phi3_from_hf"),
 }
 
 
@@ -2521,7 +2679,7 @@ def load_converted(artifact_dir: str, dtype=None):
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
            "qwen2": GPT, "phi": GPT, "neox": GPT, "bigcode": GPT,
            "opt": GPT, "falcon": GPT, "mixtral": GPT, "qwen3": GPT,
-           "bert": Bert,
+           "phi3": GPT, "bert": Bert,
            "bert-classifier": BertClassifier, "t5": T5}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
@@ -2569,6 +2727,7 @@ def _cli(argv=None) -> str:
             "bert": bert_to_hf, "bert-classifier": bert_classifier_to_hf,
             "t5": t5_to_hf, "falcon": falcon_to_hf,
             "mixtral": mixtral_to_hf, "qwen3": qwen3_to_hf,
+            "phi3": phi3_to_hf,
         }[args.family]
         hf = to_hf(model, params)
         hf.save_pretrained(args.out_dir)
