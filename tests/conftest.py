@@ -67,6 +67,13 @@ SMOKE = {
     "test_rotary.py",  # whole file: tiny pure-math checks            (RoPE)
     "test_lora.py::test_zero_init_is_identity",            # LoRA adapters
     "test_bert_classifier.py::test_classifier_shapes_and_mask",  # clf head
+    # round-5 subsystems
+    "test_t5.py::test_t5_cache_decode_equals_full_forward",  # T5 seq2seq
+    "test_packing.py::test_packed_forward_equals_solo_forward",  # packing
+    "test_rolling_cache.py::test_rolling_cache_is_window_bounded",
+    "test_preemption.py::test_preemption_guard_sets_flag_and_restores_handler",
+    "test_ema.py::test_ema_tracks_post_update_params",     # param EMA
+    "test_bench_logic.py::test_emit_fallback_provenance",  # outage fallback
 }
 
 
