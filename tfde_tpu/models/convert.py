@@ -341,6 +341,218 @@ def gemma_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def gemma2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers Gemma2ForCausalLM.
+
+    Gemma-2 extends the Gemma arrangement with: SANDWICH norms (each
+    sublayer normed both sides — `norm_style='sandwich'`, four RMSNorms
+    per block), attention logit softcapping and a custom query scale
+    (`attn_logit_cap`, `attn_scale = query_pre_attn_scalar^-0.5`), final
+    logit softcapping, and ALTERNATING local/global attention (even
+    blocks sliding-window, odd full — `sliding_window_pattern=
+    'alternate'`). All five norm kinds carry the zero-centered 1+w fold
+    like Gemma-1."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    act = getattr(cfg, "hidden_activation", "gelu_pytorch_tanh")
+    if act not in ("gelu_pytorch_tanh", "gelu_tanh"):
+        raise NotImplementedError(
+            f"hidden_activation {act!r} is not supported (expected the "
+            f"Gemma tanh-gelu)"
+        )
+    if not bool(getattr(cfg, "tie_word_embeddings", True)):
+        raise NotImplementedError(
+            "untied Gemma-2 checkpoints are not supported"
+        )
+    if bool(getattr(cfg, "attention_bias", False)):
+        raise NotImplementedError(
+            "attention_bias=True checkpoints are not supported (the bias "
+            "tensors would be silently dropped)"
+        )
+    lt = getattr(cfg, "layer_types", None)
+    if lt is not None:
+        expect = ["sliding_attention", "full_attention"]
+        if any(t != expect[i % 2] for i, t in enumerate(lt)):
+            raise NotImplementedError(
+                f"layer_types {lt!r} does not match the Gemma-2 "
+                f"even-sliding/odd-full interleave this model expresses"
+            )
+    heads = cfg.num_attention_heads
+    hidden = cfg.hidden_size
+    hd = cfg.head_dim
+    kv = cfg.num_key_value_heads
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.num_hidden_layers,
+        num_heads=heads,
+        head_dim=None if hd == hidden // heads else hd,
+        mlp_dim=cfg.intermediate_size,
+        max_position=cfg.max_position_embeddings,
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        position="rope",
+        rope_theta=float(cfg.rope_theta),
+        num_kv_heads=kv,
+        use_bias=False,
+        norm="rms",
+        norm_style="sandwich",
+        mlp_act="geglu",
+        tie_embeddings=True,
+        embed_scale=float(hidden) ** 0.5,
+        ln_eps=cfg.rms_norm_eps,
+        sliding_window=cfg.sliding_window,
+        sliding_window_pattern="alternate",
+        attn_scale=float(cfg.query_pre_attn_scalar) ** -0.5,
+        attn_logit_cap=(float(cfg.attn_logit_softcapping)
+                        if cfg.attn_logit_softcapping else None),
+        final_logit_cap=(float(cfg.final_logit_softcapping)
+                         if cfg.final_logit_softcapping else None),
+    )
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    def fold(w):  # zero-centered RMSNorm weights: stored scale = 1 + w
+        return 1.0 + w
+
+    params = {
+        "wte": {"embedding": sd[f"{pre}embed_tokens.weight"]},
+        "decoder": {
+            "ln_final": {"scale": fold(sd[f"{pre}norm.weight"])},
+        },
+    }
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}layers.{i}."
+        params["decoder"][f"block_{i}"] = {
+            "ln_attn": {"scale": fold(sd[h + "input_layernorm.weight"])},
+            "ln_attn_post": {
+                "scale": fold(sd[h + "post_attention_layernorm.weight"])
+            },
+            "ln_mlp": {
+                "scale": fold(sd[h + "pre_feedforward_layernorm.weight"])
+            },
+            "ln_mlp_post": {
+                "scale": fold(sd[h + "post_feedforward_layernorm.weight"])
+            },
+            "attn": {
+                "query": {"kernel": sd[h + "self_attn.q_proj.weight"].T
+                          .reshape(hidden, heads, hd)},
+                "key": {"kernel": sd[h + "self_attn.k_proj.weight"].T
+                        .reshape(hidden, kv, hd)},
+                "value": {"kernel": sd[h + "self_attn.v_proj.weight"].T
+                          .reshape(hidden, kv, hd)},
+                "out": {"kernel": sd[h + "self_attn.o_proj.weight"].T
+                        .reshape(heads, hd, hidden)},
+            },
+            "mlp": {
+                "gate": {"kernel": sd[h + "mlp.gate_proj.weight"].T},
+                "fc1": {"kernel": sd[h + "mlp.up_proj.weight"].T},
+                "fc2": {"kernel": sd[h + "mlp.down_proj.weight"].T},
+            },
+        }
+    return model, params
+
+
+def gemma2_to_hf(model, params):
+    """A transformers Gemma2ForCausalLM carrying `params` — the inverse
+    of `gemma2_from_hf` (all five norm kinds un-fold 1+w)."""
+    import transformers
+
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = model.head_dim or hidden // heads
+    if (model.position != "rope" or model.norm != "rms"
+            or model.mlp_act != "geglu" or model.use_bias
+            or not model.tie_embeddings or model.qkv_bias
+            or getattr(model, "qk_norm", False) or model.head_bias
+            or model.norm_style != "sandwich"
+            or model.rope_dim is not None
+            or model.rope_scaling is not None
+            or model.sliding_window is None
+            or model.sliding_window_pattern != "alternate"
+            or model.attn_scale is None
+            or model.embed_scale is None
+            or abs(model.embed_scale - hidden ** 0.5) > 1e-6):
+        raise NotImplementedError(
+            "gemma2_to_hf requires the Gemma-2 arrangement (sandwich "
+            "norms, geglu, tied scaled embeddings, alternating sliding "
+            "window, custom query scale) — Gemma-1 models export via "
+            "gemma_to_hf"
+        )
+    cfg = transformers.Gemma2Config(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        num_key_value_heads=model.num_kv_heads or heads,
+        intermediate_size=model.mlp_dim, head_dim=hd,
+        max_position_embeddings=model.max_position,
+        rope_theta=model.rope_theta, rms_norm_eps=model.ln_eps,
+        sliding_window=int(model.sliding_window),
+        query_pre_attn_scalar=float(model.attn_scale) ** -2.0,
+        attn_logit_softcapping=(float(model.attn_logit_cap)
+                                if model.attn_logit_cap else None),
+        final_logit_softcapping=(float(model.final_logit_cap)
+                                 if model.final_logit_cap else None),
+        tie_word_embeddings=True, attention_dropout=0.0,
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    hf = transformers.Gemma2ForCausalLM(cfg)
+    sd = {}
+    sd["model.embed_tokens.weight"] = _t(params["wte"]["embedding"])
+    dec = params["decoder"]
+
+    def unfold(s):  # stored 1 + w -> the HF zero-centered weight
+        return _t(np.asarray(s) - 1.0)
+
+    sd["model.norm.weight"] = unfold(dec["ln_final"]["scale"])
+    sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    kv = model.num_kv_heads or heads
+    for i in range(model.depth):
+        blk = dec[f"block_{i}"]
+        h = f"model.layers.{i}."
+        sd[h + "input_layernorm.weight"] = unfold(blk["ln_attn"]["scale"])
+        sd[h + "post_attention_layernorm.weight"] = unfold(
+            blk["ln_attn_post"]["scale"]
+        )
+        sd[h + "pre_feedforward_layernorm.weight"] = unfold(
+            blk["ln_mlp"]["scale"]
+        )
+        sd[h + "post_feedforward_layernorm.weight"] = unfold(
+            blk["ln_mlp_post"]["scale"]
+        )
+        a = blk["attn"]
+        sd[h + "self_attn.q_proj.weight"] = _t(
+            np.asarray(a["query"]["kernel"]).reshape(hidden, heads * hd).T
+        )
+        sd[h + "self_attn.k_proj.weight"] = _t(
+            np.asarray(a["key"]["kernel"]).reshape(hidden, kv * hd).T
+        )
+        sd[h + "self_attn.v_proj.weight"] = _t(
+            np.asarray(a["value"]["kernel"]).reshape(hidden, kv * hd).T
+        )
+        sd[h + "self_attn.o_proj.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(heads * hd, hidden).T
+        )
+        sd[h + "mlp.gate_proj.weight"] = _t(
+            np.asarray(blk["mlp"]["gate"]["kernel"]).T
+        )
+        sd[h + "mlp.up_proj.weight"] = _t(
+            np.asarray(blk["mlp"]["fc1"]["kernel"]).T
+        )
+        sd[h + "mlp.down_proj.weight"] = _t(
+            np.asarray(blk["mlp"]["fc2"]["kernel"]).T
+        )
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k for k in missing if "rotary_emb" not in k]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
 def qwen2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(GPT, params) from a transformers Qwen2ForCausalLM.
 
@@ -2592,6 +2804,7 @@ _FAMILIES = {
     "mixtral": ("MixtralForCausalLM", "mixtral_from_hf"),
     "qwen3": ("Qwen3ForCausalLM", "qwen3_from_hf"),
     "phi3": ("Phi3ForCausalLM", "phi3_from_hf"),
+    "gemma2": ("Gemma2ForCausalLM", "gemma2_from_hf"),
 }
 
 
@@ -2679,7 +2892,7 @@ def load_converted(artifact_dir: str, dtype=None):
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
            "qwen2": GPT, "phi": GPT, "neox": GPT, "bigcode": GPT,
            "opt": GPT, "falcon": GPT, "mixtral": GPT, "qwen3": GPT,
-           "phi3": GPT, "bert": Bert,
+           "phi3": GPT, "gemma2": GPT, "bert": Bert,
            "bert-classifier": BertClassifier, "t5": T5}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
@@ -2727,7 +2940,7 @@ def _cli(argv=None) -> str:
             "bert": bert_to_hf, "bert-classifier": bert_classifier_to_hf,
             "t5": t5_to_hf, "falcon": falcon_to_hf,
             "mixtral": mixtral_to_hf, "qwen3": qwen3_to_hf,
-            "phi3": phi3_to_hf,
+            "phi3": phi3_to_hf, "gemma2": gemma2_to_hf,
         }[args.family]
         hf = to_hf(model, params)
         hf.save_pretrained(args.out_dir)

@@ -102,6 +102,13 @@ class GPT(nn.Module):
     # backward currently masks but still scans all tiles (full-causal
     # cost). The decode cache mask carries the band. None = full causal.
     sliding_window: Optional[int] = None
+    # 'all' | 'alternate' (Gemma-2: even blocks windowed, odd blocks full)
+    sliding_window_pattern: str = "all"
+    # Gemma-2 attention deltas (transformer.MultiHeadAttention)
+    attn_scale: Optional[float] = None
+    attn_logit_cap: Optional[float] = None
+    # Gemma-2 final logit softcapping: logits = cap * tanh(logits / cap)
+    final_logit_cap: Optional[float] = None
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, train: bool = False,
@@ -211,7 +218,10 @@ class GPT(nn.Module):
             fused_qkv=self.fused_qkv,
             quant=self.quant,
             window=self.sliding_window,
+            window_pattern=self.sliding_window_pattern,
             rolling_cache=self.rolling_cache,
+            attn_scale=self.attn_scale,
+            attn_logit_cap=self.attn_logit_cap,
             norm=self.norm,
             norm_style=self.norm_style,
             mlp_act=self.mlp_act,
@@ -248,6 +258,10 @@ class GPT(nn.Module):
                 self.vocab_size, use_bias=self.head_bias, dtype=self.dtype,
                 param_dtype=jnp.float32, name="lm_head",
             )(x.astype(self.dtype)).astype(jnp.float32)
+        if self.final_logit_cap is not None:
+            logits = self.final_logit_cap * jnp.tanh(
+                logits / self.final_logit_cap
+            )
         return constrain(logits, b, "seq", "tensor")
 
 

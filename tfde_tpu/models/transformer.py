@@ -105,6 +105,14 @@ class MultiHeadAttention(nn.Module):
     # decode cache (the validity mask carries the band), the flash kernel
     # (windowed tile skip), and the 'seq' ring (band on global positions)
     window: Optional[int] = None
+    # Gemma-2 attention deltas: attn_scale overrides the 1/sqrt(head_dim)
+    # score scale (query_pre_attn_scalar^-0.5); attn_logit_cap softcaps
+    # scores (cap * tanh(s/cap)). Either set routes attention to the
+    # grouped einsum directly — the flash kernel and the seq ring do not
+    # implement them, and a silent fallback that DROPPED the cap would be
+    # a different model.
+    attn_scale: Optional[float] = None
+    attn_logit_cap: Optional[float] = None
     # rolling KV cache (decode + window only): the cache holds min(budget,
     # window) slots, each token writing slot (position mod len) — decode
     # memory bounded by the window, not the generation budget (the Mistral
@@ -193,14 +201,30 @@ class MultiHeadAttention(nn.Module):
                 )
             y = self._decode_attention(q, k, v, b)
         else:
-            # GQA included: K/V stay kv_heads-shaped end to end — the
-            # dispatcher routes to the flash kernel (GQA head-folding index
-            # maps), the seq ring (kv_heads-sized shards rotate), or the
-            # grouped einsum; never a repeat-then-attend expansion
-            y = attn_lib.attention(
-                q, k, v, mask=mask, causal=self.causal, impl=self.attn_impl,
-                window=self.window,
-            )
+            if self.attn_scale is not None or self.attn_logit_cap is not None:
+                from tfde_tpu.ops.attention import _seq_parallel_active
+
+                if _seq_parallel_active():
+                    raise NotImplementedError(
+                        "attn_scale/attn_logit_cap (the Gemma-2 attention "
+                        "deltas) do not compose with sequence parallelism "
+                        "— the ring does not implement them"
+                    )
+                y = attn_lib.grouped_attention(
+                    q, k, v, mask=mask, causal=self.causal,
+                    window=self.window, scale=self.attn_scale,
+                    logit_cap=self.attn_logit_cap,
+                )
+            else:
+                # GQA included: K/V stay kv_heads-shaped end to end — the
+                # dispatcher routes to the flash kernel (GQA head-folding
+                # index maps), the seq ring (kv_heads-sized shards
+                # rotate), or the grouped einsum; never a
+                # repeat-then-attend expansion
+                y = attn_lib.attention(
+                    q, k, v, mask=mask, causal=self.causal,
+                    impl=self.attn_impl, window=self.window,
+                )
         y = constrain(y, b, "seq", "tensor")
         y = proj(features=x.shape[-1], axis=(-2, -1), name="out")(y)
         y = constrain(y, b, "seq")
@@ -256,8 +280,10 @@ class MultiHeadAttention(nn.Module):
             # init pass: variables were just created from this call's shapes
             # (the [B, max_len] budget input) — plain causal attention.
             q, k = self._rotate(q, k, jnp.zeros((), jnp.int32))
-            return attn_lib.grouped_attention(q, k, v, causal=True,
-                                              window=self.window)
+            return attn_lib.grouped_attention(
+                q, k, v, causal=True, window=self.window,
+                scale=self.attn_scale, logit_cap=self.attn_logit_cap,
+            )
         sq = q.shape[1]
         max_len = cached_key.value.shape[1]
         if sq > max_len and not rolling:
@@ -323,7 +349,10 @@ class MultiHeadAttention(nn.Module):
         # grouped_attention == reference_attention at kv_heads == num_heads;
         # with GQA the kv_heads-shaped cache feeds the einsum directly (no
         # expanded copy on the bandwidth-bound decode path)
-        return attn_lib.grouped_attention(q, k_all, v_all, mask=valid)
+        return attn_lib.grouped_attention(
+            q, k_all, v_all, mask=valid, scale=self.attn_scale,
+            logit_cap=self.attn_logit_cap,
+        )
 
     def _rolling_attention(self, q, k, v, batch, cached_key, cached_value,
                            cache_index) -> jax.Array:
@@ -367,8 +396,10 @@ class MultiHeadAttention(nn.Module):
                 )
             # long prefill from position 0: band-limited queries only need
             # in-batch keys; keep the newest Wc tokens
-            y = attn_lib.grouped_attention(q, k, v, causal=True,
-                                           window=self.window)
+            y = attn_lib.grouped_attention(
+                q, k, v, causal=True, window=self.window,
+                scale=self.attn_scale, logit_cap=self.attn_logit_cap,
+            )
             pos_last = idx + jnp.arange(sq - wc, sq, dtype=jnp.int32)
             slots = pos_last % wc
             k_all = cached_key.value.at[:, slots].set(
@@ -403,7 +434,10 @@ class MultiHeadAttention(nn.Module):
                     "decoding, row-recycling servers) use the full-budget "
                     "cache"
                 )
-            y = attn_lib.grouped_attention(q, k_all, v_all, mask=valid)
+            y = attn_lib.grouped_attention(
+                q, k_all, v_all, mask=valid, scale=self.attn_scale,
+                logit_cap=self.attn_logit_cap,
+            )
         cached_key.value = constrain(k_all, batch, None, "tensor")
         cached_value.value = constrain(v_all, batch, None, "tensor")
         cache_index.value = idx + sq
@@ -485,6 +519,8 @@ class TransformerBlock(nn.Module):
     quant: Optional[str] = None  # int8 serving twins (MultiHeadAttention)
     window: Optional[int] = None  # sliding window (MultiHeadAttention)
     rolling_cache: bool = False  # window-bounded decode cache (MHA)
+    attn_scale: Optional[float] = None    # Gemma-2 (MultiHeadAttention)
+    attn_logit_cap: Optional[float] = None
     norm_style: str = "pre"
     # 'pre' | 'post' | 'parallel' (Phi: one LN, x + attn(ln(x)) + mlp(ln(x)))
     # | 'parallel2' (NeoX/Pythia: parallel residual, separate attn/MLP LNs)
@@ -529,6 +565,8 @@ class TransformerBlock(nn.Module):
             quant=self.quant,
             window=self.window,
             rolling_cache=self.rolling_cache,
+            attn_scale=self.attn_scale,
+            attn_logit_cap=self.attn_logit_cap,
             use_bias=self.use_bias,
             qkv_bias=self.qkv_bias,
             qk_norm=self.qk_norm,
@@ -597,9 +635,18 @@ class TransformerBlock(nn.Module):
             ym = ln(name="ln_mlp")(x).astype(self.dtype)
             return (x + attn(ya, mask=mask, train=train)
                     + mlp(ym, train=train))
+        if self.norm_style == "sandwich":
+            # the Gemma-2 arrangement: each sublayer normed BOTH sides —
+            # x + post_ln(sub(pre_ln(x))) — taming residual-stream growth
+            y = ln(name="ln_attn")(x).astype(self.dtype)
+            a = attn(y, mask=mask, train=train)
+            x = x + ln(name="ln_attn_post")(a).astype(self.dtype)
+            y = ln(name="ln_mlp")(x).astype(self.dtype)
+            h = mlp(y, train=train)
+            return x + ln(name="ln_mlp_post")(h).astype(self.dtype)
         raise ValueError(
-            f"norm_style must be 'pre', 'post', 'parallel' or 'parallel2', "
-            f"got {self.norm_style!r}"
+            f"norm_style must be 'pre', 'post', 'parallel', 'parallel2' "
+            f"or 'sandwich', got {self.norm_style!r}"
         )
 
 
@@ -642,7 +689,12 @@ class Encoder(nn.Module):
     fused_qkv: bool = False
     quant: Optional[str] = None
     window: Optional[int] = None
+    # 'all': every block windowed; 'alternate': blocks 0, 2, ... windowed,
+    # odd blocks full attention (the Gemma-2 local/global interleave)
+    window_pattern: str = "all"
     rolling_cache: bool = False
+    attn_scale: Optional[float] = None
+    attn_logit_cap: Optional[float] = None
     norm_style: str = "pre"
     norm: str = "layer"
     mlp_act: str = "gelu"
@@ -664,6 +716,12 @@ class Encoder(nn.Module):
         mask: Optional[jax.Array] = None,
         train: bool = False,
     ) -> jax.Array:
+        if self.window_pattern not in ("all", "alternate"):
+            raise ValueError(
+                f"window_pattern must be 'all' or 'alternate', got "
+                f"{self.window_pattern!r}"
+            )
+
         def body(mdl: TransformerBlock, h: jax.Array) -> jax.Array:
             # mask/train close over: constants to jax.checkpoint (no grads
             # flow to them — mask is boolean, train is a Python bool).
@@ -698,8 +756,12 @@ class Encoder(nn.Module):
                 num_kv_heads=self.num_kv_heads,
                 fused_qkv=self.fused_qkv,
                 quant=self.quant,
-                window=self.window,
+                window=(self.window
+                        if self.window_pattern == "all" or i % 2 == 0
+                        else None),
                 rolling_cache=self.rolling_cache,
+                attn_scale=self.attn_scale,
+                attn_logit_cap=self.attn_logit_cap,
                 norm_style=self.norm_style,
                 norm=self.norm,
                 mlp_act=self.mlp_act,
