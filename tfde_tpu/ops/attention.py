@@ -73,6 +73,7 @@ def grouped_attention(
     window: Optional[int] = None,
     bias: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    logit_cap: Optional[float] = None,
 ) -> jax.Array:
     """Grouped-query attention: q [B,Sq,H,D] against k/v [B,Sk,Kv,D] with
     H = Kv * groups — each KV head serves a contiguous group of query heads.
@@ -91,7 +92,13 @@ def grouped_attention(
 
     scale: score multiplier; None = the standard 1/sqrt(d). T5 runs
     UNSCALED attention (the scale is folded into its init) — its module
-    passes scale=1.0, keeping one einsum path for both conventions.
+    passes scale=1.0; Gemma-2 passes query_pre_attn_scalar^-0.5 — one
+    einsum path for every convention.
+
+    logit_cap: attention logit softcapping (Gemma-2):
+    cap * tanh(score / cap) applied after scaling and bias, before the
+    mask — bounds score magnitudes without the hard clip's dead
+    gradient.
     """
     b, sq, h, d = q.shape
     kv = k.shape[2]
@@ -123,6 +130,8 @@ def grouped_attention(
         else:  # size-1 head dim broadcasts over [kv, g]
             bias = bias[:, :, None]
         logits = logits + bias.astype(jnp.float32)
+    if logit_cap is not None:
+        logits = logit_cap * jnp.tanh(logits / logit_cap)
     if causal:
         cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
         if window is not None:
