@@ -1531,3 +1531,64 @@ def test_gemma2_roundtrip_to_hf(hf_gemma2, rng):
     with torch.no_grad():
         assert float((hf_gemma2(ids).logits - hf2(ids).logits).abs().max()) \
             < 1e-4
+
+
+@pytest.fixture(scope="module")
+def hf_qwen2moe():
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=101, hidden_size=32, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=64,
+        moe_intermediate_size=24, shared_expert_intermediate_size=48,
+        num_experts=4, num_experts_per_tok=2, num_hidden_layers=2,
+        decoder_sparse_step=1, max_position_embeddings=64,
+        attention_dropout=0.0, tie_word_embeddings=False,
+    )
+    torch.manual_seed(90)
+    m = transformers.Qwen2MoeForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_qwen2moe_logits_match(hf_qwen2moe, rng):
+    """Qwen2-MoE: biased q/k/v + every layer routed with RAW top-k
+    combine weights (norm_topk_prob=False) + a sigmoid-gated dense
+    shared expert — exact at the no-drop capacity."""
+    from tfde_tpu.models.convert import qwen2moe_from_hf
+
+    model, params = qwen2moe_from_hf(hf_qwen2moe, dtype=jnp.float32)
+    assert model.qkv_bias and not model.moe_normalize_topk
+    assert model.moe_shared_expert_dim == 48 and model.moe_every == 1
+    assert "shared_expert_gate" in params["decoder"]["block_0"]["moe"]
+    ids = rng.integers(0, 101, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_qwen2moe(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2moe_converted_generates_like_hf(hf_qwen2moe, rng):
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.models.convert import qwen2moe_from_hf
+
+    model, params = qwen2moe_from_hf(hf_qwen2moe, dtype=jnp.float32)
+    prompt = rng.integers(0, 101, (1, 5)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_qwen2moe.generate(
+            torch.tensor(prompt.astype(np.int64)), max_new_tokens=6,
+            do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours, _ = generate(model, params, jnp.asarray(prompt), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_qwen2moe_roundtrip_to_hf(hf_qwen2moe, rng):
+    from tfde_tpu.models.convert import qwen2moe_from_hf, qwen2moe_to_hf
+
+    model, params = qwen2moe_from_hf(hf_qwen2moe, dtype=jnp.float32)
+    hf2 = qwen2moe_to_hf(model, params)
+    assert hf2.config.shared_expert_intermediate_size == 48
+    assert not hf2.config.norm_topk_prob
+    ids = torch.tensor(rng.integers(0, 101, (2, 10)).astype(np.int64))
+    with torch.no_grad():
+        assert float((hf_qwen2moe(ids).logits - hf2(ids).logits)
+                     .abs().max()) < 1e-4

@@ -591,6 +591,249 @@ def qwen2_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     return model, params
 
 
+def qwen2moe_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
+    """(GPT, params) from a transformers Qwen2MoeForCausalLM.
+
+    Qwen2-MoE = the Qwen2 attention arrangement (biased q/k/v beside
+    bias-free o/MLP) with EVERY layer's MLP routed, plus two deltas the
+    MoE layer grew for it: RAW top-k combine weights
+    (`moe_normalize_topk=False` when norm_topk_prob is off — the released
+    A2.7B config) and a dense SHARED expert beside the routed ones, its
+    output scaled by a learned sigmoid gate
+    (`moe_shared_expert_dim`). Conversion pins the no-drop capacity
+    (E/k) like Mixtral, making the converted forward exact."""
+    import jax.numpy as jnp
+
+    from tfde_tpu.models.gpt import GPT
+
+    cfg = hf_model.config
+    if list(getattr(cfg, "mlp_only_layers", []) or []):
+        raise NotImplementedError(
+            f"mlp_only_layers {cfg.mlp_only_layers!r} (dense layers "
+            f"interleaved among MoE) is not supported — the released "
+            f"Qwen2-MoE configs route every layer"
+        )
+    if int(getattr(cfg, "decoder_sparse_step", 1)) != 1:
+        raise NotImplementedError(
+            f"decoder_sparse_step {cfg.decoder_sparse_step} != 1 is not "
+            f"supported"
+        )
+    if bool(getattr(cfg, "use_sliding_window", False)):
+        raise NotImplementedError(
+            "use_sliding_window=True is not supported (per-layer windows)"
+        )
+    heads = cfg.num_attention_heads
+    hidden = cfg.hidden_size
+    hd = hidden // heads
+    kv = cfg.num_key_value_heads
+    e = cfg.num_experts
+    k = cfg.num_experts_per_tok
+    model = GPT(
+        vocab_size=cfg.vocab_size,
+        hidden_size=hidden,
+        depth=cfg.num_hidden_layers,
+        num_heads=heads,
+        mlp_dim=cfg.moe_intermediate_size,
+        max_position=cfg.max_position_embeddings,
+        dropout_rate=0.0,
+        dtype=dtype if dtype is not None else jnp.bfloat16,
+        position="rope",
+        rope_theta=float(cfg.rope_theta),
+        rope_scaling=_rope_scaling_tuple(
+            getattr(cfg, "rope_scaling", None),
+            max_position=cfg.max_position_embeddings,
+        ),
+        num_kv_heads=kv,
+        use_bias=False,
+        qkv_bias=True,
+        norm="rms",
+        mlp_act="swiglu",
+        num_experts=e,
+        moe_every=1,
+        experts_per_token=k,
+        moe_capacity_factor=float(e) / k,
+        moe_normalize_topk=bool(getattr(cfg, "norm_topk_prob", False)),
+        moe_shared_expert_dim=cfg.shared_expert_intermediate_size,
+        tie_embeddings=bool(getattr(cfg, "tie_word_embeddings", False)),
+        ln_eps=cfg.rms_norm_eps,
+    )
+    sd = {k_: _np(v) for k_, v in hf_model.state_dict().items()}
+    pre = "model." if any(k_.startswith("model.") for k_ in sd) else ""
+    params = {
+        "wte": {"embedding": sd[f"{pre}embed_tokens.weight"]},
+        "decoder": {
+            "ln_final": {"scale": sd[f"{pre}norm.weight"]},
+        },
+    }
+    if not model.tie_embeddings:
+        params["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    for i in range(cfg.num_hidden_layers):
+        h = f"{pre}layers.{i}."
+        moe_pre = h + "mlp."
+        params["decoder"][f"block_{i}"] = {
+            "ln_attn": {"scale": sd[h + "input_layernorm.weight"]},
+            "ln_mlp": {"scale": sd[h + "post_attention_layernorm.weight"]},
+            "attn": {
+                "query": {"kernel": sd[h + "self_attn.q_proj.weight"].T
+                          .reshape(hidden, heads, hd),
+                          "bias": sd[h + "self_attn.q_proj.bias"]
+                          .reshape(heads, hd)},
+                "key": {"kernel": sd[h + "self_attn.k_proj.weight"].T
+                        .reshape(hidden, kv, hd),
+                        "bias": sd[h + "self_attn.k_proj.bias"]
+                        .reshape(kv, hd)},
+                "value": {"kernel": sd[h + "self_attn.v_proj.weight"].T
+                          .reshape(hidden, kv, hd),
+                          "bias": sd[h + "self_attn.v_proj.bias"]
+                          .reshape(kv, hd)},
+                "out": {"kernel": sd[h + "self_attn.o_proj.weight"].T
+                        .reshape(heads, hd, hidden)},
+            },
+            "moe": {
+                "router": {"kernel": sd[moe_pre + "gate.weight"].T},
+                "experts_gate": np.stack(
+                    [sd[moe_pre + f"experts.{j}.gate_proj.weight"].T
+                     for j in range(e)]
+                ),
+                "experts_fc1": np.stack(
+                    [sd[moe_pre + f"experts.{j}.up_proj.weight"].T
+                     for j in range(e)]
+                ),
+                "experts_fc2": np.stack(
+                    [sd[moe_pre + f"experts.{j}.down_proj.weight"].T
+                     for j in range(e)]
+                ),
+                "shared_gate": {
+                    "kernel": sd[moe_pre + "shared_expert.gate_proj.weight"].T
+                },
+                "shared_fc1": {
+                    "kernel": sd[moe_pre + "shared_expert.up_proj.weight"].T
+                },
+                "shared_fc2": {
+                    "kernel": sd[moe_pre + "shared_expert.down_proj.weight"].T
+                },
+                "shared_expert_gate": {
+                    "kernel": sd[moe_pre + "shared_expert_gate.weight"].T
+                },
+            },
+        }
+    return model, params
+
+
+def qwen2moe_to_hf(model, params):
+    """A transformers Qwen2MoeForCausalLM carrying `params` — the inverse
+    of `qwen2moe_from_hf`."""
+    import transformers
+
+    e = model.num_experts
+    k = model.experts_per_token
+    if (model.position != "rope" or model.norm != "rms"
+            or model.mlp_act != "swiglu" or model.use_bias
+            or not model.qkv_bias or e <= 0 or model.moe_every != 1
+            or model.moe_shared_expert_dim is None
+            or getattr(model, "qk_norm", False) or model.head_bias
+            or model.embed_scale is not None or model.head_dim is not None
+            or model.norm_style != "pre" or model.rope_dim is not None
+            or model.sliding_window is not None):
+        raise NotImplementedError(
+            "qwen2moe_to_hf requires the Qwen2-MoE arrangement (biased "
+            "q/k/v, every layer routed, shared expert) — other families "
+            "export via their own inverses"
+        )
+    if model.moe_capacity_factor < float(e) / k:
+        raise NotImplementedError(
+            f"moe_capacity_factor {model.moe_capacity_factor} < E/k = "
+            f"{float(e) / k}: this model can drop overflow tokens, which "
+            f"capacity-free HF Qwen2-MoE cannot express"
+        )
+    heads = model.num_heads
+    hidden = model.hidden_size
+    hd = hidden // heads
+    kv = model.num_kv_heads or heads
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=model.vocab_size, hidden_size=hidden,
+        num_hidden_layers=model.depth, num_attention_heads=heads,
+        num_key_value_heads=kv,
+        # intermediate_size (the DENSE MLP width) is inert here: both
+        # directions pin mlp_only_layers=[] and decoder_sparse_step=1, so
+        # no dense layer is ever instantiated and the original value is
+        # not recorded by the import — set to the expert width, not a
+        # claim about the source config
+        intermediate_size=model.mlp_dim,
+        moe_intermediate_size=model.mlp_dim,
+        shared_expert_intermediate_size=model.moe_shared_expert_dim,
+        num_experts=e, num_experts_per_tok=k,
+        norm_topk_prob=model.moe_normalize_topk,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=model.max_position,
+        rope_theta=model.rope_theta,
+        rope_scaling=_rope_scaling_dict(model.rope_scaling),
+        rms_norm_eps=model.ln_eps,
+        tie_word_embeddings=model.tie_embeddings,
+        use_sliding_window=False, attention_dropout=0.0,
+        router_aux_loss_coef=0.0, output_router_logits=False,
+    )
+    hf = transformers.Qwen2MoeForCausalLM(cfg)
+    sd = {}
+    sd["model.embed_tokens.weight"] = _t(params["wte"]["embedding"])
+    dec = params["decoder"]
+    sd["model.norm.weight"] = _t(dec["ln_final"]["scale"])
+    sd["lm_head.weight"] = (
+        sd["model.embed_tokens.weight"] if model.tie_embeddings
+        else _t(np.asarray(params["lm_head"]["kernel"]).T)
+    )
+    for i in range(model.depth):
+        blk = dec[f"block_{i}"]
+        h = f"model.layers.{i}."
+        sd[h + "input_layernorm.weight"] = _t(blk["ln_attn"]["scale"])
+        sd[h + "post_attention_layernorm.weight"] = _t(
+            blk["ln_mlp"]["scale"]
+        )
+        a = blk["attn"]
+        for ours, theirs, nh in (("query", "q_proj", heads),
+                                 ("key", "k_proj", kv),
+                                 ("value", "v_proj", kv)):
+            sd[h + f"self_attn.{theirs}.weight"] = _t(
+                np.asarray(a[ours]["kernel"]).reshape(hidden, nh * hd).T
+            )
+            sd[h + f"self_attn.{theirs}.bias"] = _t(
+                np.asarray(a[ours]["bias"]).reshape(nh * hd)
+            )
+        sd[h + "self_attn.o_proj.weight"] = _t(
+            np.asarray(a["out"]["kernel"]).reshape(heads * hd, hidden).T
+        )
+        moe = blk["moe"]
+        sd[h + "mlp.gate.weight"] = _t(
+            np.asarray(moe["router"]["kernel"]).T
+        )
+        gate_s = np.asarray(moe["experts_gate"])
+        up_s = np.asarray(moe["experts_fc1"])
+        down_s = np.asarray(moe["experts_fc2"])
+        for j in range(e):
+            sd[h + f"mlp.experts.{j}.gate_proj.weight"] = _t(gate_s[j].T)
+            sd[h + f"mlp.experts.{j}.up_proj.weight"] = _t(up_s[j].T)
+            sd[h + f"mlp.experts.{j}.down_proj.weight"] = _t(down_s[j].T)
+        sd[h + "mlp.shared_expert.gate_proj.weight"] = _t(
+            np.asarray(moe["shared_gate"]["kernel"]).T
+        )
+        sd[h + "mlp.shared_expert.up_proj.weight"] = _t(
+            np.asarray(moe["shared_fc1"]["kernel"]).T
+        )
+        sd[h + "mlp.shared_expert.down_proj.weight"] = _t(
+            np.asarray(moe["shared_fc2"]["kernel"]).T
+        )
+        sd[h + "mlp.shared_expert_gate.weight"] = _t(
+            np.asarray(moe["shared_expert_gate"]["kernel"]).T
+        )
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    missing = [k_ for k_ in missing if "rotary_emb" not in k_]
+    if missing or unexpected:
+        raise RuntimeError(f"to_hf mapping drift: missing={missing} "
+                           f"unexpected={list(unexpected)}")
+    hf.eval()
+    return hf
+
+
 def phi3_from_hf(hf_model, dtype=None) -> Tuple[object, dict]:
     """(GPT, params) from a transformers Phi3ForCausalLM (Phi-3/3.5-mini).
 
@@ -2805,6 +3048,7 @@ _FAMILIES = {
     "qwen3": ("Qwen3ForCausalLM", "qwen3_from_hf"),
     "phi3": ("Phi3ForCausalLM", "phi3_from_hf"),
     "gemma2": ("Gemma2ForCausalLM", "gemma2_from_hf"),
+    "qwen2-moe": ("Qwen2MoeForCausalLM", "qwen2moe_from_hf"),
 }
 
 
@@ -2892,7 +3136,7 @@ def load_converted(artifact_dir: str, dtype=None):
     cls = {"gpt2": GPT, "llama": GPT, "mistral": GPT, "gemma": GPT,
            "qwen2": GPT, "phi": GPT, "neox": GPT, "bigcode": GPT,
            "opt": GPT, "falcon": GPT, "mixtral": GPT, "qwen3": GPT,
-           "phi3": GPT, "gemma2": GPT, "bert": Bert,
+           "phi3": GPT, "gemma2": GPT, "qwen2-moe": GPT, "bert": Bert,
            "bert-classifier": BertClassifier, "t5": T5}[family]
     model = cls(**kwargs)
     with fs.fs_open(fs.join(artifact_dir, "params.npz"), "rb") as f:
@@ -2941,6 +3185,7 @@ def _cli(argv=None) -> str:
             "t5": t5_to_hf, "falcon": falcon_to_hf,
             "mixtral": mixtral_to_hf, "qwen3": qwen3_to_hf,
             "phi3": phi3_to_hf, "gemma2": gemma2_to_hf,
+            "qwen2-moe": qwen2moe_to_hf,
         }[args.family]
         hf = to_hf(model, params)
         hf.save_pretrained(args.out_dir)

@@ -44,6 +44,8 @@ class GPT(nn.Module):
     moe_every: int = 2
     experts_per_token: int = 2
     moe_capacity_factor: float = 1.25  # models/moe.py MoEMlp
+    moe_normalize_topk: bool = True        # models/moe.py MoEMlp
+    moe_shared_expert_dim: Optional[int] = None  # Qwen2-MoE shared expert
     router_z_loss_weight: float = 0.0  # ST-MoE stabilizer (models/moe.py)
     # autoregressive serving mode (inference/decode.py): KV caches in the
     # "cache" collection; positions continue from the cached prefix
@@ -234,6 +236,8 @@ class GPT(nn.Module):
             moe_every=self.moe_every,
             experts_per_token=self.experts_per_token,
             moe_capacity_factor=self.moe_capacity_factor,
+            moe_normalize_topk=self.moe_normalize_topk,
+            moe_shared_expert_dim=self.moe_shared_expert_dim,
             router_z_loss_weight=self.router_z_loss_weight,
             name="decoder",
         )(x, mask=seg_mask, train=train)

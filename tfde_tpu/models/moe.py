@@ -81,6 +81,14 @@ class MoEMlp(nn.Module):
     # projection, the expert-wise analog of transformer.Mlp's swiglu)
     act: str = "gelu"
     use_bias: bool = True
+    # False (Qwen2-MoE): combine with the RAW softmax probabilities of the
+    # top-k experts instead of renormalizing them to sum to 1 (the
+    # Switch/Mixtral convention)
+    normalize_topk: bool = True
+    # Qwen2-MoE shared expert: a DENSE bias-free swiglu MLP of this width
+    # runs on every token beside the routed experts, its output scaled by
+    # a learned sigmoid gate — replicated weights (no expert axis)
+    shared_expert_dim: Optional[int] = None
     aux_loss_weight: float = 0.01
     # router z-loss (ST-MoE): penalizes mean(logsumexp(router logits)^2),
     # keeping logit magnitudes bounded so fp32 routing stays stable over
@@ -113,9 +121,10 @@ class MoEMlp(nn.Module):
         probs = jax.nn.softmax(logits, axis=-1)  # [g, m, e]
 
         gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [g, m, k]
-        gate_vals = gate_vals / jnp.maximum(
-            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
-        )
+        if self.normalize_topk:
+            gate_vals = gate_vals / jnp.maximum(
+                jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+            )
 
         # position of each (token, choice) within its expert's per-group
         # capacity: cumsum over the group's choice-major token stream
@@ -207,6 +216,29 @@ class MoEMlp(nn.Module):
             preferred_element_type=jnp.float32,
         )
         y = y.astype(x.dtype).reshape(bsz, seq, d)
+        if self.shared_expert_dim is not None:
+            if self.act != "swiglu" or self.use_bias:
+                raise NotImplementedError(
+                    "shared_expert_dim is the Qwen2-MoE arrangement: "
+                    "bias-free swiglu experts only"
+                )
+            dense = lambda feats, name: nn.Dense(
+                feats, use_bias=False, dtype=self.dtype,
+                param_dtype=jnp.float32, name=name,
+            )
+            sh = nn.silu(dense(self.shared_expert_dim, "shared_gate")(x)) \
+                * dense(self.shared_expert_dim, "shared_fc1")(x)
+            sh = dense(d, "shared_fc2")(sh)
+            # scalar sigmoid gate per token (fp32: a saturating gate is
+            # precision-sensitive)
+            gate = jax.nn.sigmoid(
+                nn.Dense(1, use_bias=False, dtype=jnp.float32,
+                         param_dtype=jnp.float32,
+                         name="shared_expert_gate")(
+                    x.astype(jnp.float32)
+                )
+            )
+            y = y + (gate * sh.astype(jnp.float32)).astype(x.dtype)
         if self.dropout_rate > 0.0:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return constrain(y, b_axes, "seq")

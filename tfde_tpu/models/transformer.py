@@ -533,6 +533,8 @@ class TransformerBlock(nn.Module):
     num_experts: int = 0  # > 0 swaps the dense MLP for a routed MoE MLP
     experts_per_token: int = 2
     moe_capacity_factor: float = 1.25  # MoEMlp.capacity_factor
+    moe_normalize_topk: bool = True        # MoEMlp.normalize_topk
+    moe_shared_expert_dim: Optional[int] = None  # MoEMlp.shared_expert_dim
     router_z_loss_weight: float = 0.0  # ST-MoE stabilizer (models/moe.py)
 
     @nn.compact
@@ -595,6 +597,8 @@ class TransformerBlock(nn.Module):
                 mlp_dim=self.mlp_dim,
                 experts_per_token=self.experts_per_token,
                 capacity_factor=self.moe_capacity_factor,
+                normalize_topk=self.moe_normalize_topk,
+                shared_expert_dim=self.moe_shared_expert_dim,
                 act=self.mlp_act,
                 use_bias=self.use_bias,
                 router_z_loss_weight=self.router_z_loss_weight,
@@ -706,6 +710,8 @@ class Encoder(nn.Module):
     num_experts: int = 0   # > 0: MoE MLP in every `moe_every`-th block
     experts_per_token: int = 2
     moe_capacity_factor: float = 1.25
+    moe_normalize_topk: bool = True
+    moe_shared_expert_dim: Optional[int] = None
     router_z_loss_weight: float = 0.0
     moe_every: int = 2     # GShard convention: alternate dense / MoE
 
@@ -772,6 +778,8 @@ class Encoder(nn.Module):
                 num_experts=self.num_experts if is_moe else 0,
                 experts_per_token=self.experts_per_token,
                 moe_capacity_factor=self.moe_capacity_factor,
+                moe_normalize_topk=self.moe_normalize_topk,
+                moe_shared_expert_dim=self.moe_shared_expert_dim,
                 router_z_loss_weight=self.router_z_loss_weight,
                 name=f"block_{i}",
             )
