@@ -122,7 +122,8 @@ def main(argv=None):
 
     if args.fake_devices:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+        from tfde_tpu.utils.devices import request_cpu_devices
+        request_cpu_devices(args.fake_devices)
 
     info = bootstrap()
     global_batch = args.batch_size * max(info.num_processes, 1)

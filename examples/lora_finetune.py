@@ -79,7 +79,8 @@ def main(argv=None):
 
     if args.fake_devices:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+        from tfde_tpu.utils.devices import request_cpu_devices
+        request_cpu_devices(args.fake_devices)
 
     # force: the axon site shim's early jax import already attached handlers
     logging.basicConfig(level=logging.INFO, format="%(message)s", force=True)

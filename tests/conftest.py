@@ -1,29 +1,62 @@
 """Test harness: 8 virtual CPU devices (SURVEY.md §4).
 
 The JAX-native analog of a fake backend: mesh/psum/sharding/checkpoint tests
-run hermetically with no TPU. Must run before any JAX backend is initialized;
-the axon site shim imports jax at interpreter start, so we override via
-jax.config (backend creation is lazy) rather than env vars.
+run hermetically with no TPU. The device count must be set before the CPU
+backend is created; the XLA_FLAGS env var works on every JAX release (the
+`jax_num_cpu_devices` config option does not exist on all of them), so it is
+the primary mechanism and the config update is a guarded extra for versions
+that prefer it.
 """
 
+import glob
+import mmap
 import os
+
+
+def _xla_flag_supported(flag: str) -> bool:
+    """True when the installed jaxlib knows `flag`. An unknown entry in
+    XLA_FLAGS is a hard process ABORT at backend creation (not an
+    exception), so each optional flag is probed against the jaxlib shared
+    objects — flag names are literal strings in the binary — before being
+    added."""
+    try:
+        import jaxlib
+
+        pat = flag.lstrip("-").split("=", 1)[0].encode()
+        root = os.path.dirname(jaxlib.__file__)
+        for so in glob.glob(os.path.join(root, "**", "*.so"), recursive=True):
+            with open(so, "rb") as f:
+                with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as m:
+                    if m.find(pat) != -1:
+                        return True
+    except Exception:
+        return False  # can't tell -> don't risk the abort
+    return False
+
 
 # XLA's in-process CPU collective rendezvous SIGABRTs the whole pytest
 # process when the box is oversubscribed (8 virtual devices on 1-2 cores
 # under a loaded CI: "Expected 8 threads to join ... only N arrived").
-# Raise the warn/terminate timeouts well past any scheduler hiccup; the
-# backend is created lazily, so setting the env here (before first device
-# use) takes effect, and subprocess-isolated tests inherit it.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
-    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
-)
+# Raise the warn/terminate timeouts well past any scheduler hiccup where the
+# jaxlib has the knobs; the backend is created lazily, so setting the env
+# here (before first device use) takes effect, and subprocess-isolated
+# tests inherit it.
+_flags = [" --xla_force_host_platform_device_count=8"]
+for _f in (
+    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300",
+    " --xla_cpu_collective_call_terminate_timeout_seconds=1200",
+):
+    if _xla_flag_supported(_f):
+        _flags.append(_f)
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + "".join(_flags)
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older/newer JAX without the option: XLA_FLAGS above covers it
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

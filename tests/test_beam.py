@@ -54,6 +54,7 @@ def test_beam1_equals_greedy(nano_lm, rng):
     np.testing.assert_array_equal(np.asarray(lengths[:, 0]), [8, 8])
 
 
+@pytest.mark.slow
 def test_beam_exhaustive_optimality(nano_lm, rng):
     """num_beams == vocab + 2 steps = exhaustive: the winner must be the
     brute-force argmax over all 49 continuations, and its reported score

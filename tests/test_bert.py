@@ -12,6 +12,7 @@ from tfde_tpu.models.bert import BertBase, bert_tiny_test
 from tfde_tpu.ops.losses import masked_lm_loss
 from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
 from tfde_tpu.training.step import init_state, make_custom_train_step
+import pytest
 
 
 def test_bert_base_param_count():
@@ -97,6 +98,7 @@ def test_masked_lm_loss_ignores_non_targets(rng):
     np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_bert_custom_train_step_loss_decreases(rng):
     strategy = MultiWorkerMirroredStrategy()
     m = bert_tiny_test()
@@ -123,6 +125,7 @@ def test_bert_custom_train_step_loss_decreases(rng):
     assert "mlm_accuracy" in metrics
 
 
+@pytest.mark.slow
 def test_bert_example_smoke():
     import os
     import sys

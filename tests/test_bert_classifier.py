@@ -58,6 +58,7 @@ def test_mlm_weights_graft(rng):
                                    ids)
 
 
+@pytest.mark.slow
 def test_hf_classifier_logits_match(rng):
     transformers = pytest.importorskip("transformers")
     torch = pytest.importorskip("torch")
@@ -82,6 +83,7 @@ def test_hf_classifier_logits_match(rng):
     np.testing.assert_allclose(ours, ref, rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_classifier_finetunes(rng):
     """A separable task: class = first-token bucket. The grafted classifier
     fine-tunes to high accuracy in a few steps (the GLUE-recipe smoke)."""

@@ -1,0 +1,127 @@
+"""runtime/cluster.py role mapping: the reference's TF_CONFIG contract onto
+SPMD ranks — master/chief -> 0, worker i offset when a master exists, the
+documented ps-entry drop — plus malformed CLUSTER_SPEC/TF_CONFIG errors."""
+
+import json
+
+import pytest
+
+from tfde_tpu.runtime.cluster import (
+    ClusterInfo,
+    _rank_from_tf_config,
+    coordinator_endpoint,
+    resolve_cluster,
+)
+
+CLUSTER = {
+    "master": ["host0:2222"],
+    "worker": ["host1:2222", "host2:2222"],
+    "ps": ["host3:2222"],
+}
+
+
+def _cfg(job_type, index, cluster=CLUSTER):
+    return {"cluster": cluster, "task": {"type": job_type, "index": index}}
+
+
+def test_master_maps_to_rank_zero():
+    num, pid, norm, idx, coord = _rank_from_tf_config(_cfg("master", 0))
+    assert pid == 0 and norm == "chief"
+    assert num == 3  # master + 2 workers; the ps entry is dropped
+    assert coord == "host0:2222"
+
+
+def test_chief_alias_maps_to_rank_zero():
+    cluster = {"chief": ["c:2222"], "worker": ["w:2222"]}
+    num, pid, norm, _, coord = _rank_from_tf_config(_cfg("chief", 0, cluster))
+    assert (num, pid, norm) == (2, 0, "chief")
+    assert coord == "c:2222"
+
+
+@pytest.mark.parametrize("i", [0, 1])
+def test_worker_offset_by_one_when_master_exists(i):
+    num, pid, norm, idx, _ = _rank_from_tf_config(_cfg("worker", i))
+    assert pid == i + 1  # master holds rank 0
+    assert norm == "worker" and idx == i and num == 3
+
+
+def test_worker_zero_without_chief_becomes_chief():
+    cluster = {"worker": ["w0:2222", "w1:2222"]}
+    _, pid0, norm0, _, _ = _rank_from_tf_config(_cfg("worker", 0, cluster))
+    _, pid1, norm1, _, _ = _rank_from_tf_config(_cfg("worker", 1, cluster))
+    assert (pid0, norm0) == (0, "chief")  # no chief entry: worker 0 is it
+    assert (pid1, norm1) == (1, "worker")
+
+
+def test_ps_entries_dropped_from_ranking():
+    num, _, _, _, _ = _rank_from_tf_config(_cfg("master", 0))
+    assert num == 3  # not 4: ps hosts provide no SPMD rank
+
+
+def test_ps_role_refuses_to_launch():
+    with pytest.raises(RuntimeError, match="JOB_NAME=ps"):
+        _rank_from_tf_config(_cfg("ps", 0))
+
+
+def test_malformed_cluster_spec_fails_loudly(monkeypatch):
+    monkeypatch.delenv("TF_CONFIG", raising=False)
+    monkeypatch.delenv("TFDE_NUM_PROCESSES", raising=False)
+    monkeypatch.setenv("CLUSTER_SPEC", "{not json")
+    with pytest.raises(ValueError, match="CLUSTER_SPEC"):
+        resolve_cluster()
+
+
+def test_malformed_tf_config_fails_loudly(monkeypatch):
+    monkeypatch.delenv("TFDE_NUM_PROCESSES", raising=False)
+    monkeypatch.setenv("TF_CONFIG", "][")
+    with pytest.raises(ValueError, match="TF_CONFIG"):
+        resolve_cluster()
+
+
+def test_cluster_spec_synthesis_roundtrip(monkeypatch):
+    # setenv-to-empty (falsy, parsed as absent) rather than delenv: the code
+    # under test writes the synthesized TF_CONFIG into os.environ, and
+    # monkeypatch only restores vars it touched — this guarantees teardown
+    # removes the leak
+    monkeypatch.setenv("TF_CONFIG", "")
+    monkeypatch.delenv("TFDE_NUM_PROCESSES", raising=False)
+    monkeypatch.setenv("CLUSTER_SPEC", json.dumps(CLUSTER))
+    monkeypatch.setenv("JOB_NAME", "worker")
+    monkeypatch.setenv("TASK_INDEX", "1")
+    info = resolve_cluster()
+    assert info.num_processes == 3
+    assert info.process_id == 2  # worker 1 behind the master
+    assert info.job_type == "worker" and info.task_index == 1
+    assert not info.is_chief and info.is_distributed
+    # the reference contract: the synthesized TF_CONFIG lands in the env
+    import os
+
+    synth = json.loads(os.environ["TF_CONFIG"])
+    assert synth["cluster"] == CLUSTER
+
+
+def test_native_contract_takes_precedence(monkeypatch):
+    monkeypatch.setenv("TFDE_NUM_PROCESSES", "4")
+    monkeypatch.setenv("TFDE_PROCESS_ID", "2")
+    monkeypatch.setenv("TFDE_COORDINATOR", "coord:1234")
+    monkeypatch.setenv("TF_CONFIG", "ignored garbage")  # never parsed
+    info = resolve_cluster()
+    assert info == ClusterInfo(4, 2, "coord:1234", "worker", 2)
+
+
+def test_no_env_is_local_single_process(monkeypatch):
+    for var in ("TF_CONFIG", "CLUSTER_SPEC", "TFDE_NUM_PROCESSES"):
+        monkeypatch.delenv(var, raising=False)
+    info = resolve_cluster()
+    assert info.num_processes == 1 and info.job_type == "local"
+    assert info.is_chief and not info.is_distributed
+
+
+def test_coordinator_endpoint_derives_port():
+    assert coordinator_endpoint("host0:2222") == "host0:3233"  # +1011
+    assert coordinator_endpoint("host0") == "host0:8476"  # no port: default
+
+
+def test_coordinator_endpoint_env_override(monkeypatch):
+    monkeypatch.setenv("TFDE_COORD_PORT", "9999")
+    assert coordinator_endpoint("host0:2222") == "host0:9999"

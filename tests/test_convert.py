@@ -1075,6 +1075,7 @@ def test_mixtral_roundtrip_to_hf(hf_mixtral, rng):
     assert float((a - b).abs().max()) < 1e-4
 
 
+@pytest.mark.slow
 def test_mixtral_trains_under_expert_parallelism(hf_mixtral, rng):
     """The converted Mixtral fine-tunes under ExpertParallelStrategy on
     the virtual mesh: expert stacks (including the new experts_gate)

@@ -35,6 +35,7 @@ def _full_forward_greedy(model, params, prompt, n_new):
     return toks
 
 
+@pytest.mark.slow
 def test_greedy_cache_matches_full_forward_rollout(tiny_lm, rng):
     model, params = tiny_lm
     prompt = jnp.asarray(rng.integers(0, 97, (2, 5)), jnp.int32)
@@ -135,6 +136,7 @@ def test_generate_rejects_over_budget_prompt(tiny_lm):
         generate(model, params, prompt, max_new_tokens=10)
 
 
+@pytest.mark.slow
 def test_moe_gpt_decodes(rng):
     """Routed-expert MLPs work per-token (capacity is per group, linear in
     this call's tokens — models/moe.py), so MoE-GPT must decode unchanged."""

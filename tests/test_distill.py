@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from tfde_tpu.data.datasets import synthetic_tokens
 from tfde_tpu.models.gpt import GPT, gpt_tiny_test
@@ -25,6 +26,7 @@ def _student():
                mlp_dim=32, max_position=64, dtype=jnp.float32)
 
 
+@pytest.mark.slow
 def test_distill_improves_agreement_and_speculation():
     """Runs in a subprocess: the 400-step train+distill loop is stable
     standalone but can abort inside pytest's process environment (an XLA
@@ -39,7 +41,8 @@ def test_distill_improves_agreement_and_speculation():
 import json
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from tfde_tpu.utils.devices import request_cpu_devices
+request_cpu_devices(8)
 import jax.numpy as jnp, numpy as np, optax
 from tfde_tpu.data.datasets import synthetic_tokens
 from tfde_tpu.inference.speculative import generate_speculative

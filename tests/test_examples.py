@@ -12,6 +12,7 @@ import pytest
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from examples import mnist_estimator, mnist_multiworker, mnist_tf2  # noqa: E402
+from tfde_tpu.utils import compat  # noqa: E402
 
 
 def test_multiworker_example_runs(tmp_path):
@@ -58,6 +59,7 @@ def test_tf2_example_estimator_path(tmp_path):
     assert np.isfinite(metrics["loss"])
 
 
+@pytest.mark.slow
 def test_cifar_resnet_example_smoke():
     from examples import cifar10_resnet
 
@@ -67,9 +69,13 @@ def test_cifar_resnet_example_smoke():
     assert int(jax.device_get(state.step)) == 2
 
 
-def test_gpt_lm_example_3d_and_moe_smoke():
-    """gpt_lm's round-3 surfaces: 3D (--pipeline x --tensor) and --moe run
-    a couple of steps end-to-end on the fake mesh."""
+@pytest.mark.skipif(
+    not compat.supports_partial_manual(),
+    reason="3D pp x tp needs partial-auto shard_map, unsupported on this jax",
+)
+def test_gpt_lm_example_3d_smoke():
+    """gpt_lm's 3D surface (--pipeline x --tensor) runs a couple of steps
+    end-to-end on the fake mesh."""
     from examples import gpt_lm
 
     state, metrics = gpt_lm.main(
@@ -78,6 +84,10 @@ def test_gpt_lm_example_3d_and_moe_smoke():
     )
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
 
+
+def test_gpt_lm_example_moe_smoke():
+    from examples import gpt_lm
+
     state, metrics = gpt_lm.main(
         ["--tiny", "--seq-len", "32", "--max-steps", "2", "--batch-size",
          "16", "--moe", "4"]
@@ -85,6 +95,7 @@ def test_gpt_lm_example_3d_and_moe_smoke():
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
 
 
+@pytest.mark.slow
 def test_lora_finetune_example():
     """The LoRA entrypoint end to end: inline base pretrain, q/v-adapter
     fine-tune, merge, generate from the merged params — all on the fake
@@ -156,6 +167,7 @@ def test_serve_gpt_example():
     assert all(len(toks) == 5 for _, toks in done)
 
 
+@pytest.mark.slow
 def test_t5_seq2seq_example_smoke():
     """The encoder-decoder entrypoint: seq2seq training + generation run
     end-to-end on the fake mesh."""

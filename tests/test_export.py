@@ -9,6 +9,7 @@ import numpy as np
 
 from tfde_tpu.export.serving import FinalExporter, export_serving, load_serving
 from tfde_tpu.models.cnn import BatchNormCNN, PlainCNN
+import pytest
 
 
 def _trained_vars():
@@ -89,6 +90,7 @@ def test_export_token_model_int_signature(tmp_path):
     np.testing.assert_allclose(probs.sum(-1), np.ones((3, 16)), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_savedmodel_export_serves_in_tensorflow(tmp_path):
     """Opt-in TF-Serving interop (reference FinalExporter writes a
     SavedModel, mnist_keras:151-162): the jax2tf-wrapped artifact must

@@ -13,6 +13,7 @@ from tfde_tpu.parallel.strategies import (
     SequenceParallelStrategy,
 )
 from tfde_tpu.training.step import init_state, make_custom_train_step
+import pytest
 
 
 def test_gpt2_small_param_count():
@@ -41,6 +42,7 @@ def test_gpt_is_causal(rng):
     assert not np.allclose(np.asarray(out)[:, 10:], np.asarray(out2)[:, 10:])
 
 
+@pytest.mark.slow
 def test_gpt_next_token_loss_learns_structure(rng):
     """The Markov synthetic stream is predictable; loss must fall well below
     the uniform floor ln(96) within a few steps on a tiny model."""
@@ -63,6 +65,7 @@ def test_gpt_next_token_loss_learns_structure(rng):
     assert float(metrics["next_token_accuracy"]) > 0.1
 
 
+@pytest.mark.slow
 def test_gpt_seq_parallel_matches_dp(rng):
     """Causal ring attention end-to-end: GPT train step on a data x seq mesh
     reproduces pure-DP numerics."""

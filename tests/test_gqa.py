@@ -31,6 +31,7 @@ def test_kv_param_and_cache_shrink(rng):
     assert ck.shape == (2, 16, 1, 8)
 
 
+@pytest.mark.slow
 def test_mqa_decode_matches_full_forward(rng):
     """Multi-query (kv=1) cached generation must equal the uncached
     full-forward rollout — the expansion happens identically either way."""
@@ -46,6 +47,7 @@ def test_mqa_decode_matches_full_forward(rng):
     np.testing.assert_array_equal(np.asarray(out), toks)
 
 
+@pytest.mark.slow
 def test_gqa2_rope_decode_matches_full_forward(rng):
     """GQA composes with RoPE through the cache (rotation applies to the
     kv_heads-shaped keys before the write)."""
@@ -93,6 +95,7 @@ def test_gqa_heads_share_kv(rng):
     assert not np.allclose(base, out)
 
 
+@pytest.mark.slow
 def test_gqa_trains(rng):
     import optax
 

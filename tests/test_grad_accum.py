@@ -49,6 +49,7 @@ def test_grad_accum_matches_full_batch_classification(rng):
     )
 
 
+@pytest.mark.slow
 def test_grad_accum_custom_loss_matches_under_fsdp(rng):
     """The custom-loss path, sharded: accum=2 on an FSDP mesh must match the
     accum=1 update. SGD, not adam: adam's bias-corrected first step is
@@ -100,6 +101,7 @@ def test_grad_accum_batchnorm_stats_chain(rng):
     assert moved, "BN stats did not update through the accumulation scan"
 
 
+@pytest.mark.slow
 def test_grad_accum_weighted_matches_masked_loss(rng):
     """Mask-normalized losses (denominator = per-microbatch target count)
     are a mean-of-means under uniform accumulation; the reserved
@@ -150,6 +152,7 @@ def test_grad_accum_weighted_matches_masked_loss(rng):
     assert "grad_weight" not in out[1][1] and "grad_weight" not in out[2][1]
 
 
+@pytest.mark.slow
 def test_grad_accum_all_zero_weights_is_noop_not_nan(rng):
     """Every microbatch weightless (an all-IGNORE MLM batch): the update
     must be a clean zero-gradient step, not 0 * inf = NaN params."""

@@ -1,0 +1,85 @@
+"""Heartbeat / stall-watchdog tests. Poll-style tests run in virtual time;
+the watchdog-thread test uses short real timeouts."""
+
+import time
+
+import pytest
+
+from tfde_tpu.observability import counters
+from tfde_tpu.resilience.health import Heartbeat, StallError
+
+
+class VirtualClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_check_passes_while_beating():
+    clk = VirtualClock()
+    hb = Heartbeat(stall_timeout_secs=10.0, clock=clk)
+    hb.beat(1)
+    clk.now += 5.0
+    hb.check()  # within budget
+    hb.beat(2)
+    clk.now += 9.9
+    hb.check()
+    assert hb.last_step == 2
+
+
+def test_check_raises_stall_error_with_context():
+    clk = VirtualClock()
+    hb = Heartbeat(stall_timeout_secs=10.0, clock=clk)
+    hb.beat(17)
+    clk.now += 10.1
+    with pytest.raises(StallError) as ei:
+        hb.check()
+    assert ei.value.last_step == 17
+    assert ei.value.age == pytest.approx(10.1)
+
+
+def test_no_beat_arms_on_first_observation():
+    clk = VirtualClock()
+    hb = Heartbeat(stall_timeout_secs=5.0, clock=clk)
+    hb.check()  # first check arms the timer instead of raising
+    clk.now += 5.1
+    with pytest.raises(StallError):
+        hb.check()
+
+
+def test_stalls_are_counted():
+    counters.reset("resilience/")
+    clk = VirtualClock()
+    hb = Heartbeat(stall_timeout_secs=1.0, clock=clk)
+    hb.beat()
+    clk.now += 2.0
+    with pytest.raises(StallError):
+        hb.check()
+    assert counters.value("resilience/stalls_detected") == 1
+
+
+def test_watchdog_thread_escalates_once_per_stall():
+    fired = []
+    hb = Heartbeat(stall_timeout_secs=0.2, on_stall=lambda: fired.append(1))
+    with hb:
+        hb.beat(1)
+        deadline = time.time() + 5.0
+        while not fired and time.time() < deadline:
+            time.sleep(0.02)
+        assert fired == [1]
+        # still stalled: must NOT re-fire until a beat re-arms
+        time.sleep(0.5)
+        assert fired == [1]
+        hb.beat(2)  # recover ...
+        deadline = time.time() + 5.0
+        while len(fired) < 2 and time.time() < deadline:
+            time.sleep(0.02)  # ... then wedge again -> second escalation
+        assert fired == [1, 1]
+    assert hb._thread is None  # stop() joined the watchdog
+
+
+def test_invalid_timeout_rejected():
+    with pytest.raises(ValueError):
+        Heartbeat(stall_timeout_secs=0.0)

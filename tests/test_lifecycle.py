@@ -39,6 +39,7 @@ def _input_fns(flatten=True, batch=64, eval_batch=None):
     return train_fn, eval_fn
 
 
+@pytest.mark.slow
 def test_train_and_evaluate_end_to_end(tmp_path):
     train_fn, eval_fn = _input_fns()
     cfg = RunConfig(
@@ -134,6 +135,7 @@ def test_evaluate_without_state_or_checkpoint_errors():
         est.evaluate(eval_fn)
 
 
+@pytest.mark.slow
 def test_profile_window_writes_trace(tmp_path):
     """RunConfig.profile_steps captures an XProf trace under
     <model_dir>/plugins/profile — the reference's ProfilerHook capability
@@ -188,6 +190,7 @@ def test_eval_distribute_matches_train_strategy_eval(tmp_path):
     assert int(jax.device_get(state.step)) == 6
 
 
+@pytest.mark.slow
 def test_profile_repeating_windows(tmp_path):
     """profile_steps="every:N" re-traces like the reference's
     ProfilerHook(save_steps=100): multiple windows from one training run."""

@@ -12,6 +12,7 @@ import optax
 import pytest
 
 from tfde_tpu.data.pipeline import Dataset
+from tfde_tpu.utils import compat
 from tfde_tpu.models.gpt import gpt_tiny_test, next_token_loss
 from tfde_tpu.ops.losses import masked_lm_loss
 from tfde_tpu.training.lifecycle import Estimator, EvalSpec, RunConfig, TrainSpec
@@ -42,6 +43,7 @@ def _token_input_fn(seed, n=256, batch=16, seq=16, repeat=None):
     return input_fn
 
 
+@pytest.mark.slow
 def test_lora_estimator_lifecycle(tmp_path):
     """LoRA through the FULL lifecycle: adapters-only TrainState (tiny
     checkpoints), resume-by-default, eval/predict on the MERGED params,
@@ -110,6 +112,7 @@ def test_lora_continuous_eval_from_checkpoint(tmp_path):
     assert np.isfinite(metrics["loss"])
 
 
+@pytest.mark.slow
 def test_lm_estimator_lifecycle_and_resume(tmp_path):
     cfg = RunConfig(model_dir=str(tmp_path), save_summary_steps=5,
                     save_checkpoints_steps=10, log_step_count_steps=10)
@@ -155,6 +158,7 @@ def test_lm_train_and_evaluate_interleaves(tmp_path):
     est.close()
 
 
+@pytest.mark.slow
 def test_lm_continuous_eval_from_checkpoint(tmp_path):
     """The evaluator job inherits the custom objective: a background
     evaluator on a custom-loss Estimator must run the eval_fn path, not
@@ -237,6 +241,10 @@ def test_partial_eval_batch_fails_with_named_cause(tmp_path):
         est.evaluate(ragged_input_fn, name="ragged")
 
 
+@pytest.mark.skipif(
+    not compat.supports_partial_manual(),
+    reason="partial-auto shard_map unsupported on this jax",
+)
 def test_pipelined_1f1b_estimator_lifecycle_and_resume(tmp_path):
     """The full Estimator machinery — checkpointing the pipe-sharded
     [S, L, ...] stage params via orbax, resume-by-default, throttled eval

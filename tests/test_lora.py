@@ -124,6 +124,7 @@ def test_adapter_size_is_rank_r(base):
         assert 2 in leaf.shape
 
 
+@pytest.mark.slow
 def test_lora_trains_base_frozen_and_merge_matches(base):
     model, params = base
     cfg = LoraConfig(rank=4, alpha=8.0)

@@ -6,6 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from tfde_tpu.models.moe import MoEMlp, dispatch_shape, group_capacity
@@ -183,6 +184,7 @@ def test_moe_grouped_routing_matches_reference_per_group(rng):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_moe_encoder_trains_and_ep_matches_dp():
     p_dp, first_dp, last_dp = _run_encoder(MultiWorkerMirroredStrategy())
     assert last_dp < first_dp  # training works with the sown aux loss
@@ -217,6 +219,7 @@ def test_ep_weights_actually_sharded():
     )
 
 
+@pytest.mark.slow
 def test_moe_gpt_custom_path_trains_with_sown_losses():
     """VERDICT r4 weak #5 follow-on: the custom-LM path (next_token_loss)
     must collect the sown MoE losses — sow() into an immutable collection

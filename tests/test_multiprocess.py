@@ -18,7 +18,8 @@ _CHILD = textwrap.dedent(
     import hashlib, json, sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    from tfde_tpu.utils.devices import request_cpu_devices
+    request_cpu_devices(1)
     import numpy as np, optax
     from tfde_tpu import bootstrap
     from tfde_tpu.data import device_prefetch
@@ -114,7 +115,8 @@ _LIFECYCLE_CHILD = textwrap.dedent(
     import json, sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    from tfde_tpu.utils.devices import request_cpu_devices
+    request_cpu_devices(1)
     import numpy as np, optax
     from tfde_tpu import bootstrap
     from tfde_tpu.data import Dataset
@@ -247,7 +249,8 @@ _FSDP_CHILD = textwrap.dedent(
     import hashlib, json, sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    from tfde_tpu.utils.devices import request_cpu_devices
+    request_cpu_devices(1)
     import numpy as np, optax
     from tfde_tpu import bootstrap
     from tfde_tpu.models.cnn import PlainCNN

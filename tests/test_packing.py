@@ -85,6 +85,7 @@ def test_packed_forward_equals_solo_forward(rng):
     assert np.abs(unmasked[0, 6:11] - solo2[0]).max() > 1e-3
 
 
+@pytest.mark.slow
 def test_packed_training_loss_falls(rng):
     import optax
 

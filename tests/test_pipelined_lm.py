@@ -18,6 +18,12 @@ from tfde_tpu.parallel.strategies import (
 )
 from tfde_tpu.runtime.mesh import make_mesh
 from tfde_tpu.training.step import init_state, make_custom_train_step
+from tfde_tpu.utils import compat
+
+_partial_auto = pytest.mark.skipif(
+    not compat.supports_partial_manual(),
+    reason="partial-auto shard_map unsupported on this jax",
+)
 
 
 @pytest.fixture(scope="module")
@@ -150,6 +156,7 @@ def test_loss_reduce_path_matches_broadcast_path(model, tokens):
     )
 
 
+@pytest.mark.slow
 def test_pipelined_train_reduce_path_matches_dp(model, tokens):
     """Training through pipelined_next_token_loss (last-stage reduction) at
     pipe=2 x data=2 == plain DP at data=4 — the VERDICT r2 #9 'done' bar."""
@@ -176,6 +183,7 @@ def test_pipelined_train_reduce_path_matches_dp(model, tokens):
     )
 
 
+@pytest.mark.slow
 def test_pipelined_dropout_in_pipe(tokens):
     """Dropout on (VERDICT r2 weak #8 capability cliff closed): the pipe
     path fires dropout deterministically per seed, with masks UNCORRELATED
@@ -225,6 +233,7 @@ def test_pipelined_dropout_in_pipe(tokens):
     assert np.isfinite(float(m["loss"]))
 
 
+@_partial_auto
 def test_3d_dp_pp_tp_matches_dp(model, tokens):
     """3D parallelism (dp=2 x pipe=2 x tensor=2, 8 devices): stage weights
     shard over BOTH 'pipe' (stage dim) and 'tensor' (Megatron column/row
@@ -274,6 +283,7 @@ def test_tensor_without_pipe_rejected():
         strat.params_spec({"stages": {"w": jnp.zeros((1, 2, 4, 4))}})
 
 
+@_partial_auto
 def test_3d_with_dropout_trains(tokens):
     """3D mesh + dropout: auto-mode global masks, one finite training step
     through the last-stage-reduction loss."""
@@ -288,6 +298,7 @@ def test_3d_with_dropout_trains(tokens):
     assert np.isfinite(float(m["loss"]))
 
 
+@_partial_auto
 def test_3d_with_remat_dots_trains(tokens):
     """jax.checkpoint('dots' policy) inside the partial-manual pipe: one
     finite training step on the 3D mesh."""
@@ -302,6 +313,7 @@ def test_3d_with_remat_dots_trains(tokens):
     assert np.isfinite(float(m["loss"]))
 
 
+@_partial_auto
 def test_flash_refused_inside_partial_manual_pipe(tokens):
     """Explicit flash inside the partial-manual 3D pipe must error with
     guidance (the kernel's custom-VJP variance doesn't compose with a
@@ -332,7 +344,7 @@ def test_auto_dispatch_skips_flash_under_abstract_mesh(monkeypatch):
         (chosen.append("reference"), q)[1],
     )
     q = jnp.zeros((1, 4096, 1, 4), jnp.bfloat16)
-    abstract = jax.sharding.AbstractMesh((2,), ("data",))
+    abstract = compat.abstract_mesh((2,), ("data",))
     with axes_lib.use_axes(abstract):
         att.attention(q, q, q)
     assert chosen == ["reference"]
@@ -376,6 +388,7 @@ def test_1f1b_loss_and_grads_match_gpipe(model, tokens):
     )
 
 
+@pytest.mark.slow
 def test_1f1b_train_matches_dp(tokens):
     """5 Adam steps through the 1F1B schedule at pipe=2 x data=2 == plain
     DP at data=4 — the same oracle as the GPipe path (VERDICT r3 #5 'done'
@@ -532,6 +545,7 @@ def test_pp_sp_forward_matches_sequential(model, tokens):
     )
 
 
+@pytest.mark.slow
 def test_pp_sp_train_matches_dp(model, tokens):
     """5 Adam steps at dp=2 x pipe=2 x seq=2 == plain DP at data=4 — the
     same numerics oracle as every other strategy family."""
@@ -584,6 +598,7 @@ def test_pp_sp_tp_refused(tokens):
                    np.zeros((8, 32), np.int32))
 
 
+@pytest.mark.slow
 def test_pp_sp_1f1b_refused(model, tokens):
     from tfde_tpu.models.pipelined import pipelined_next_token_loss
 

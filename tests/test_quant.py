@@ -190,6 +190,7 @@ def test_quantize_model_requires_quant_field():
         quantize_model(PlainCNN(), {"params": {}})
 
 
+@pytest.mark.slow
 def test_quant_llama_family_config(rng):
     """The LLaMA-shaped config (rope + GQA + swiglu + RMSNorm + bias-free
     + untied head) quantizes end to end: every projection kind the family

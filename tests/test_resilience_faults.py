@@ -1,0 +1,90 @@
+"""Fault-injection harness tests: schedules must be deterministic, actions
+must fire exactly at the scheduled call/step, and patching must restore."""
+
+import pytest
+
+from tfde_tpu.resilience.faults import (
+    DelayFault,
+    FaultInjector,
+    FaultSchedule,
+    RaiseFault,
+    StepFaults,
+)
+
+
+def test_fail_on_nth_call():
+    inj = FaultInjector(FaultSchedule.fail_on(2, 4))
+    calls = []
+    op = inj.wrap(lambda x: calls.append(x) or x)
+    assert op(1) == 1
+    with pytest.raises(IOError):
+        op(2)
+    assert op(3) == 3
+    with pytest.raises(IOError):
+        op(4)
+    assert op(5) == 5
+    assert calls == [1, 3, 5]  # faulted calls never reach the callable
+
+
+def test_custom_exception_type():
+    inj = FaultInjector(FaultSchedule.fail_on(1, exc_type=TimeoutError,
+                                              message="slow backend"))
+    with pytest.raises(TimeoutError, match="slow backend"):
+        inj.wrap(lambda: None)()
+
+
+def test_slow_on_injects_latency():
+    slept = []
+    sched = FaultSchedule.slow_on(2, seconds=1.5, sleep=slept.append)
+    op = FaultInjector(sched).wrap(lambda: "ok")
+    assert op() == "ok" and slept == []
+    assert op() == "ok" and slept == [1.5]  # delayed, not failed
+    assert op() == "ok" and slept == [1.5]
+
+
+def test_seeded_schedule_is_reproducible():
+    a = FaultSchedule.seeded(seed=42, n_calls=100, p_fail=0.3)
+    b = FaultSchedule.seeded(seed=42, n_calls=100, p_fail=0.3)
+    c = FaultSchedule.seeded(seed=43, n_calls=100, p_fail=0.3)
+    assert set(a.plan) == set(b.plan)
+    assert set(a.plan) != set(c.plan)
+    assert 10 < len(a.plan) < 50  # ~30 of 100
+
+
+def test_schedule_rejects_zero_index():
+    with pytest.raises(ValueError, match="1-based"):
+        FaultSchedule({0: RaiseFault()})
+
+
+def test_patch_restores_on_exit():
+    class Store:
+        def save(self, x):
+            return f"saved {x}"
+
+    s = Store()
+    orig = s.save
+    with FaultInjector(FaultSchedule.fail_on(1)).patch(s, "save"):
+        with pytest.raises(IOError):
+            s.save(1)
+        assert s.save(2) == "saved 2"
+    assert s.save.__func__ is orig.__func__ if hasattr(s.save, "__func__") else True
+    assert s.save(3) == "saved 3"
+
+
+def test_step_faults_fire_at_step_and_disarm():
+    slept = []
+    sf = StepFaults({3: DelayFault(seconds=9.0, sleep=slept.append)})
+    batches = list(sf.wrap(iter(range(10, 16))))
+    assert batches == [10, 11, 12, 13, 14, 15]  # batches unchanged
+    assert slept == [9.0]  # fired exactly once, at the 3rd draw
+    # a second pass (the restarted attempt) does not re-fire
+    assert list(sf.wrap(iter(range(3)))) == [0, 1, 2]
+    assert slept == [9.0]
+
+
+def test_step_faults_raise_interrupts_iteration():
+    sf = StepFaults({2: RaiseFault(exc_type=RuntimeError)})
+    it = sf.wrap(iter("abc"))
+    assert next(it) == "a"
+    with pytest.raises(RuntimeError):
+        next(it)

@@ -1,0 +1,167 @@
+"""Retry-policy unit tests — virtual time throughout (injected sleep/clock),
+so backoff/deadline behavior is tested without wall-clock waits."""
+
+import random
+
+import pytest
+
+from tfde_tpu.observability import counters
+from tfde_tpu.resilience.policy import (
+    NO_RETRY,
+    RetryBudgetExceeded,
+    RetryPolicy,
+    TransientError,
+    policy_from_env,
+    retry,
+    retry_call,
+)
+
+
+class Flaky:
+    """Fails the first `n_failures` calls with `exc`, then returns 'ok'."""
+
+    def __init__(self, n_failures, exc=IOError("blip")):
+        self.n_failures = n_failures
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.n_failures:
+            raise self.exc
+        return "ok"
+
+
+def _virtual():
+    """(sleep, clock, slept-log) sharing one virtual timeline."""
+    t = {"now": 0.0}
+    slept = []
+
+    def sleep(s):
+        slept.append(s)
+        t["now"] += s
+
+    return sleep, (lambda: t["now"]), slept
+
+
+def test_succeeds_after_transient_failures():
+    f = Flaky(2)
+    sleep, clock, slept = _virtual()
+    out = retry_call(f, policy=RetryPolicy(max_attempts=4, jitter=0.0),
+                     sleep=sleep, clock=clock)
+    assert out == "ok" and f.calls == 3
+    assert len(slept) == 2
+
+
+def test_backoff_is_exponential_and_capped():
+    p = RetryPolicy(initial_backoff=1.0, multiplier=2.0, max_backoff=3.0,
+                    jitter=0.0)
+    assert [p.backoff(i) for i in (1, 2, 3, 4)] == [1.0, 2.0, 3.0, 3.0]
+
+
+def test_jitter_is_seeded_and_bounded():
+    p = RetryPolicy(initial_backoff=1.0, jitter=0.25)
+    a = [p.backoff(1, random.Random(7)) for _ in range(3)]
+    b = [p.backoff(1, random.Random(7)) for _ in range(3)]
+    assert a == b  # same seed -> same schedule
+    assert all(0.75 <= x <= 1.25 for x in a)
+
+
+def test_budget_exhaustion_raises_with_cause():
+    f = Flaky(10)
+    sleep, clock, _ = _virtual()
+    with pytest.raises(RetryBudgetExceeded) as ei:
+        retry_call(f, policy=RetryPolicy(max_attempts=3, jitter=0.0),
+                   sleep=sleep, clock=clock)
+    assert f.calls == 3
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, IOError)
+    # OSError-compat: I/O call sites guarding with `except OSError` still
+    # catch the exhausted form
+    assert isinstance(ei.value, OSError)
+
+
+def test_non_retryable_propagates_immediately():
+    f = Flaky(10, exc=ValueError("poison"))
+    with pytest.raises(ValueError):
+        retry_call(f, policy=RetryPolicy(max_attempts=5))
+    assert f.calls == 1
+
+
+def test_deterministic_oserrors_are_not_retried():
+    f = Flaky(10, exc=FileNotFoundError("no such object"))
+    with pytest.raises(FileNotFoundError):
+        retry_call(f, policy=RetryPolicy(max_attempts=5))
+    assert f.calls == 1  # FileNotFoundError is OSError but never transient
+
+
+def test_transient_marker_forces_retry():
+    f = Flaky(1, exc=TransientError("wrapped"))
+    sleep, clock, _ = _virtual()
+    assert retry_call(f, policy=RetryPolicy(max_attempts=2, jitter=0.0),
+                      sleep=sleep, clock=clock) == "ok"
+
+
+def test_deadline_bounds_total_budget():
+    f = Flaky(10)
+    sleep, clock, slept = _virtual()
+    p = RetryPolicy(max_attempts=100, initial_backoff=1.0, multiplier=1.0,
+                    jitter=0.0, deadline=2.5)
+    with pytest.raises(RetryBudgetExceeded):
+        retry_call(f, policy=p, sleep=sleep, clock=clock)
+    # 1s + 1s sleeps fit the 2.5s budget; the third would exceed it
+    assert slept == [1.0, 1.0] and f.calls == 3
+
+
+def test_no_retry_policy_is_single_attempt():
+    f = Flaky(1)
+    with pytest.raises(RetryBudgetExceeded):
+        retry_call(f, policy=NO_RETRY)
+    assert f.calls == 1
+
+
+def test_decorator_form():
+    calls = {"n": 0}
+    sleep, clock, _ = _virtual()
+
+    @retry(RetryPolicy(max_attempts=3, jitter=0.0), sleep=sleep, clock=clock)
+    def op(x):
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise IOError("blip")
+        return x * 2
+
+    assert op(21) == 42 and calls["n"] == 2
+
+
+def test_policy_from_env(monkeypatch):
+    monkeypatch.setenv("TFDE_RETRY_MAX_ATTEMPTS", "7")
+    monkeypatch.setenv("TFDE_RETRY_INITIAL_BACKOFF", "0.5")
+    monkeypatch.setenv("TFDE_RETRY_DEADLINE", "12")
+    p = policy_from_env()
+    assert p.max_attempts == 7
+    assert p.initial_backoff == 0.5
+    assert p.deadline == 12.0
+    assert p.max_backoff == RetryPolicy().max_backoff  # untouched field
+
+
+def test_policy_from_env_rejects_garbage(monkeypatch):
+    monkeypatch.setenv("TFDE_RETRY_MAX_ATTEMPTS", "many")
+    with pytest.raises(ValueError, match="TFDE_RETRY_MAX_ATTEMPTS"):
+        policy_from_env()
+
+
+def test_retries_are_counted():
+    counters.reset("resilience/")
+    f = Flaky(2)
+    sleep, clock, _ = _virtual()
+    retry_call(f, policy=RetryPolicy(max_attempts=4, jitter=0.0),
+               sleep=sleep, clock=clock)
+    assert counters.value("resilience/retries") == 2
+
+
+def test_invalid_policy_rejected():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter=1.5)

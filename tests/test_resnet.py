@@ -22,6 +22,7 @@ def test_resnet50_imagenet_param_count():
     assert n == 25_557_032
 
 
+@pytest.mark.slow
 def test_resnet50_cifar_forward():
     m = resnet50_cifar()
     v = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
@@ -38,6 +39,7 @@ def test_resnet18_forward():
     assert logits.shape == (2, 10)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("strategy_cls", [MultiWorkerMirroredStrategy, FSDPStrategy])
 def test_resnet_sharded_train_step_loss_decreases(strategy_cls):
     # ResNet-18 fp32 keeps CPU runtime tolerable while exercising the same

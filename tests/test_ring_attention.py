@@ -148,6 +148,7 @@ def test_ring_rejects_dense_mask(rng):
         ring_attention(q, k, v, mask=dense, mesh=mesh)
 
 
+@pytest.mark.slow
 def test_bert_train_step_seq_parallel_matches_dp(rng):
     """End-to-end: a BERT train step on a data x seq mesh (ring attention
     engaged via auto-dispatch) reproduces pure-DP numerics."""

@@ -47,6 +47,7 @@ def test_rolling_cache_is_window_bounded(model_and_params):
     assert full["decoder"]["block_0"]["attn"]["cached_key"].shape[1] == 64
 
 
+@pytest.mark.slow
 def test_rolling_generate_matches_full_cache(model_and_params, rng):
     """Token-for-token equality with the full-budget cache, far past the
     window (budget 40 >> window 8): greedy generate through the rolling
@@ -80,6 +81,7 @@ def test_rolling_generate_matches_full_cache(model_and_params, rng):
     )
 
 
+@pytest.mark.slow
 def test_rolling_long_prompt_prefill(model_and_params, rng):
     """Prompt (20) longer than the window cache (8): the prefill attends
     in-batch and keeps only the newest window of K/V — continuations must
@@ -109,6 +111,7 @@ def test_rolling_long_prompt_prefill(model_and_params, rng):
     )
 
 
+@pytest.mark.slow
 def test_rolling_ragged_rows_match_solo(model_and_params, rng):
     """Ragged prompts under the rolling cache (generate_ragged
     teacher-forces rows on a SHARED scalar index — the per-row-index
@@ -135,6 +138,7 @@ def test_rolling_ragged_rows_match_solo(model_and_params, rng):
         )
 
 
+@pytest.mark.slow
 def test_rolling_off_for_speculation(model_and_params):
     """Speculative decoding rewinds the cache, which aliases rolling
     slots — its clone must stay on the full-budget cache."""

@@ -58,6 +58,7 @@ def test_rope_gpt_is_causal(rope_lm, rng):
                                np.asarray(out2)[:, :10], rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_rope_decode_matches_full_forward(rope_lm, rng):
     """Rotation rides the cache: cached greedy generation must equal the
     uncached full-forward rollout (the decode oracle, with per-position
@@ -75,6 +76,7 @@ def test_rope_decode_matches_full_forward(rope_lm, rng):
     np.testing.assert_array_equal(np.asarray(out), toks)
 
 
+@pytest.mark.slow
 def test_rope_ragged_matches_solo(rope_lm, rng):
     from tfde_tpu.inference.decode import generate, generate_ragged
 
@@ -93,6 +95,7 @@ def test_rope_ragged_matches_solo(rope_lm, rng):
                                       np.asarray(solo)[0])
 
 
+@pytest.mark.slow
 def test_rope_trains_and_matches_under_seq_mesh(rope_lm, rng):
     """Rotary is elementwise over the sequence, so the 'seq'-sharded
     forward must equal the unsharded one (ring attention underneath)."""

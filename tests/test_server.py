@@ -28,6 +28,7 @@ def _solo(model, params, prompt, n, **kw):
     return np.asarray(toks)[0, p : int(lengths[0])]
 
 
+@pytest.mark.slow
 def test_batch_of_varied_requests_matches_solo(lm, rng):
     model, params = lm
     srv = ContinuousBatcher(model, params, batch_size=3, max_len=48)
@@ -83,6 +84,7 @@ def test_eos_and_instant_finish(lm, rng):
     np.testing.assert_array_equal(done[one], free[:1])
 
 
+@pytest.mark.slow
 def test_rope_gqa_model(rng):
     m = GPT(vocab_size=97, hidden_size=32, depth=2, num_heads=4, mlp_dim=64,
             max_position=64, dtype=jnp.float32, position="rope",
@@ -122,6 +124,7 @@ def draft():
     return m, params
 
 
+@pytest.mark.slow
 def test_speculative_batcher_matches_solo(lm, draft, rng):
     from tfde_tpu.inference.server import SpeculativeContinuousBatcher
 

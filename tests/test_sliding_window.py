@@ -124,6 +124,7 @@ def test_gpt_sliding_window_is_banded(rng):
     assert float(jnp.max(jnp.abs(base[0, -1] - out_near[0, -1]))) > 1e-4
 
 
+@pytest.mark.slow
 def test_windowed_decode_matches_windowed_forward(rng):
     """Greedy generation with the cache must reproduce the windowed
     full-forward rollout token for token (the decode-path band mask is the
@@ -163,6 +164,7 @@ def test_windowed_decode_prefill_longer_than_window(rng):
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(cur))
 
 
+@pytest.mark.slow
 def test_windowed_decode_with_rope_and_gqa(rng):
     from tfde_tpu.inference.decode import generate
 

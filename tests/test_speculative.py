@@ -182,6 +182,7 @@ def test_batched_sampled_reproducible(target, draft):
     np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
+@pytest.mark.slow
 def test_sampled_mode_matches_target_distribution():
     """Speculative SAMPLING correctness (the Leviathan theorem): the round's
     committed token must be distributed as target-model sampling,

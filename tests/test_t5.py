@@ -201,6 +201,7 @@ def test_t5_save_load_cli_roundtrip(tmp_path, hf_t5, rng):
     np.testing.assert_allclose(b, ref, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_t5_trains_under_dp(rng):
     """Seq2seq training through make_custom_train_step on the virtual
     mesh: a copy task's loss must fall."""
@@ -305,6 +306,7 @@ def test_t5_loss_start_token_follows_model_pad_id(rng):
     assert np.isfinite(float(metr["loss"]))
 
 
+@pytest.mark.slow
 def test_t5_tp_matches_dp_numerics(rng):
     """T5 reuses the transformer vocabulary (query/key/value/out kernels,
     fc1/gate/fc2), so the Megatron TP rules shard it with NO T5-specific

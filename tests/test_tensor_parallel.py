@@ -14,6 +14,7 @@ from tfde_tpu.parallel.strategies import (
     TensorParallelStrategy,
 )
 from tfde_tpu.training.step import init_state, make_train_step
+import pytest
 
 
 def test_tp_spec_rules():
@@ -77,6 +78,7 @@ def test_tp_weights_actually_sharded():
     assert qkv.sharding.spec in (P(), P(None, None, None))  # 4 heads % 8 != 0
 
 
+@pytest.mark.slow
 def test_tp_zero1_composition_shards_opt_state_and_matches_dp():
     """ZeRO-1 layered on TP (Megatron+ZeRO): params keep the TP layout, Adam
     moments additionally shard their largest TP-unsharded dim over 'data' —

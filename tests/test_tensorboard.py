@@ -50,6 +50,7 @@ def test_event_file_structure(tmp_path):
     assert b"loss" in records[2]
 
 
+@pytest.mark.slow
 def test_events_parse_with_tensorflow_if_available(tmp_path):
     tf = pytest.importorskip("tensorflow")
     w = tb.SummaryWriter(str(tmp_path))

@@ -62,6 +62,7 @@ def test_tokenize_appends_eos_and_guards_vocab(tok_dir, corpus):
         tokenize_documents(docs, tok, vocab_limit=3)
 
 
+@pytest.mark.slow
 def test_packed_text_batches_train_end_to_end(tok_dir, corpus, rng):
     """The whole journey: text files -> tokenizer -> packed batches ->
     packed training step; loss falls on the tiny corpus."""

@@ -106,6 +106,7 @@ def test_multiple_files(tmp_path):
     assert [e[0] for e in iter(ds)] == [b"a", b"b"]
 
 
+@pytest.mark.slow
 def test_interop_tfdata_reads_our_files(tmp_path):
     """Cross-implementation wire-format check: records written by our
     TFRecordWriter must parse byte-for-byte in real tf.data (the consumer

@@ -55,6 +55,7 @@ def test_dp_loss_decreases_plain_cnn():
     assert np.mean(losses[-3:]) < np.mean(losses[:3]) * 0.9, losses
 
 
+@pytest.mark.slow
 def test_dp_loss_decreases_bn_cnn_with_dropout_and_stats():
     strat = MultiWorkerMirroredStrategy()
     batches = _mnist_batches(batch=64, steps=12, flatten=True)

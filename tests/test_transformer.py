@@ -79,6 +79,7 @@ def test_mha_megatron_weight_shapes(rng):
     assert y.shape == x.shape
 
 
+@pytest.mark.slow
 def test_encoder_remat_matches_plain(rng):
     x = jnp.asarray(rng.random((2, 5, 16), np.float32))
     kw = dict(depth=2, num_heads=2, head_dim=8, mlp_dim=32, dtype=jnp.float32)
@@ -135,6 +136,7 @@ def test_attention_dispatcher_reference_path(rng):
     )
 
 
+@pytest.mark.slow
 def test_remat_policies_match_no_remat_numerics(rng):
     """remat=False / 'full' / 'dots' are schedule choices, not math changes:
     identical forward values and gradients."""

@@ -9,6 +9,7 @@ import optax
 from tfde_tpu.models.vit import ViT_B16, vit_tiny_test
 from tfde_tpu.parallel.strategies import FSDPStrategy
 from tfde_tpu.training.step import init_state, make_train_step
+import pytest
 
 
 def test_vit_b16_param_count():
@@ -39,6 +40,7 @@ def test_vit_gap_pool_matches_seq_len(rng):
     assert v["params"]["pos_embed"].shape == (1, 64, 32)  # (32/4)^2 patches
 
 
+@pytest.mark.slow
 def test_vit_fsdp_train_loss_decreases(rng):
     strategy = FSDPStrategy(data=2, min_shard_elems=1)
     m = vit_tiny_test()

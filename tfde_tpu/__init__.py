@@ -23,5 +23,16 @@ citations throughout the docstrings.
 
 __version__ = "0.1.0"
 
+import jax as _jax
+
+# Sharding-invariant RNG is a framework invariant: params initialized under
+# an FSDP/TP sharding must equal the unsharded init, or "numerics identical
+# across strategies" dies at step 0. Newer jax defaults (or hardwires) this
+# on; older releases default it off — pin it. No-op where the flag is gone.
+try:
+    _jax.config.update("jax_threefry_partitionable", True)
+except AttributeError:
+    pass
+
 from tfde_tpu.runtime.mesh import MeshSpec, make_mesh  # noqa: F401
 from tfde_tpu.runtime.cluster import ClusterInfo, bootstrap  # noqa: F401

@@ -18,6 +18,8 @@ from typing import TYPE_CHECKING, Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from tfde_tpu.resilience.policy import RetryPolicy, policy_from_env, retry_call
+
 if TYPE_CHECKING:  # avoid the training<->checkpoint import cycle at runtime
     from tfde_tpu.training.train_state import TrainState
 
@@ -31,6 +33,12 @@ class CheckpointManager:
     not state). `restore_latest` returns a state with the *caller's* shardings
     — pass the live/abstract state so restored arrays land where training
     expects them.
+
+    Save/restore are fallible remote I/O (gs:// blips are routine at pod
+    scale), so both run under a retry policy — the operator's
+    ``TFDE_RETRY_*`` knobs by default, or an explicit `retry_policy`.
+    Retries only transient classes (OSError/timeouts); a structure-mismatch
+    ValueError still fails fast on the first attempt.
     """
 
     def __init__(
@@ -38,8 +46,10 @@ class CheckpointManager:
         directory: str,
         max_to_keep: Optional[int] = 5,
         async_save: bool = True,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         self._dir = directory
+        self._retry = retry_policy or policy_from_env()
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save,
@@ -51,10 +61,14 @@ class CheckpointManager:
         step = int(jax.device_get(state.step))
         if step in (self._mngr.all_steps() or ()):  # already on disk
             return False
-        saved = self._mngr.save(
+        saved = retry_call(
+            self._mngr.save,
             step,
             args=ocp.args.StandardSave(self._tree(state)),
             force=force,
+            policy=self._retry,
+            what=f"checkpoint save(step={step})",
+            counter="resilience/checkpoint_retries",
         )
         if saved:
             log.info("checkpoint saved at step %d -> %s", step, self._dir)
@@ -88,8 +102,13 @@ class CheckpointManager:
             self._tree(state),
         )
         try:
-            restored = self._mngr.restore(
-                step, args=ocp.args.StandardRestore(abstract)
+            restored = retry_call(
+                self._mngr.restore,
+                step,
+                args=ocp.args.StandardRestore(abstract),
+                policy=self._retry,
+                what=f"checkpoint restore(step={step})",
+                counter="resilience/checkpoint_retries",
             )
         except ValueError as e:
             # Reword ONLY genuine structure mismatches: compare the saved
@@ -140,7 +159,10 @@ class CheckpointManager:
         failure reading metadata returns False (the original error then
         propagates untouched)."""
         try:
-            meta = self._mngr.item_metadata(step).tree
+            meta = self._mngr.item_metadata(step)
+            # newer orbax wraps the tree in a metadata object; older
+            # returns the (dict) tree itself
+            meta = getattr(meta, "tree", meta)
             return (self._normalize_structure(meta)
                     != self._normalize_structure(abstract))
         except Exception:

@@ -259,17 +259,29 @@ def download(name: str, dest_dir: str = None, timeout: float = 600.0) -> str:
     import hashlib
     import urllib.request
 
+    from tfde_tpu.resilience.policy import policy_from_env, retry_call
+
     tmp = dest / (spec["filename"] + ".download")
-    h = hashlib.sha256()
-    with urllib.request.urlopen(spec["url"], timeout=timeout) as r, \
-            open(tmp, "wb") as f:
-        while True:
-            chunk = r.read(1 << 20)
-            if not chunk:
-                break
-            h.update(chunk)
-            f.write(chunk)
-    digest = h.hexdigest()
+
+    def fetch() -> str:
+        """One full download attempt; restarted from byte 0 on failure so a
+        half-written tmp file never poisons the digest. urllib raises
+        URLError (an OSError) on network faults -> retryable."""
+        h = hashlib.sha256()
+        with urllib.request.urlopen(spec["url"], timeout=timeout) as r, \
+                open(tmp, "wb") as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                h.update(chunk)
+                f.write(chunk)
+        return h.hexdigest()
+
+    digest = retry_call(
+        fetch, policy=policy_from_env(), what=f"download({name})",
+        counter="resilience/download_retries",
+    )
     if digest != spec["sha256"]:
         tmp.unlink(missing_ok=True)
         raise ValueError(

@@ -1,4 +1,6 @@
-"""Observability: TensorBoard event files, steps/sec logging, profiling."""
+"""Observability: TensorBoard event files, steps/sec logging, profiling,
+and process-wide counters (the resilience subsystem's export surface)."""
 
 from tfde_tpu.observability.tensorboard import SummaryWriter  # noqa: F401
 from tfde_tpu.observability.profiler import profile_trace  # noqa: F401
+from tfde_tpu.observability import counters  # noqa: F401

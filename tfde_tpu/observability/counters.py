@@ -1,0 +1,64 @@
+"""Process-wide named counters — the resilience subsystem's export surface.
+
+The reference's runtime surfaced fault-tolerance activity only as log lines;
+at pod scale operators need the numbers (how many restarts, how many retried
+saves, how many steps were replayed after a preemption) as *metrics* they
+can alarm on. This module is the minimal substrate: monotonic named counters
+any subsystem can increment, a snapshot for tests/exporters, and a bridge
+that writes the snapshot as TensorBoard scalars through the existing
+SummaryWriter so the counters land next to the training curves.
+
+Thread-safe by design: the health watchdog and retry wrappers increment from
+background threads while the train loop reads.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+
+
+def incr(name: str, amount: float = 1.0) -> float:
+    """Add `amount` to counter `name` (creating it at 0); returns the new
+    value. Negative amounts are rejected — counters are monotonic; gauges
+    belong in the summary writer directly."""
+    if amount < 0:
+        raise ValueError(f"counter {name!r}: negative increment {amount}")
+    with _lock:
+        _counters[name] = _counters.get(name, 0.0) + amount
+        return _counters[name]
+
+
+def value(name: str) -> float:
+    with _lock:
+        return _counters.get(name, 0.0)
+
+
+def snapshot() -> Dict[str, float]:
+    """Point-in-time copy of every counter."""
+    with _lock:
+        return dict(_counters)
+
+
+def reset(prefix: str = "") -> None:
+    """Zero counters (those under `prefix`, or all) — test isolation hook."""
+    with _lock:
+        if not prefix:
+            _counters.clear()
+            return
+        for k in [k for k in _counters if k.startswith(prefix)]:
+            del _counters[k]
+
+
+def export_scalars(writer, step: int, prefix: str = "") -> Dict[str, float]:
+    """Write the current snapshot (optionally filtered by `prefix`) to a
+    SummaryWriter-compatible object at `step`; returns what was written.
+    `writer` may be None (non-chief / no model_dir) — then this is only the
+    snapshot read."""
+    snap = {k: v for k, v in snapshot().items() if k.startswith(prefix)}
+    if writer is not None and snap:
+        writer.scalars(step, snap)
+    return snap

@@ -35,6 +35,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from tfde_tpu.utils.compat import shard_map as _compat_shard_map
+
 from tfde_tpu.parallel import axes as axes_lib
 
 
@@ -351,7 +353,7 @@ def _flash_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
         return fa.flash_attention(q, k, v, causal=causal, window=window,
                                   interpret=interpret)
     spec = P(batch_axes if batch_axes else None, None, heads, None)
-    fn = jax.shard_map(
+    fn = _compat_shard_map(
         lambda q, k, v: fa.flash_attention(
             q, k, v, causal=causal, window=window, interpret=interpret
         ),

@@ -1,0 +1,42 @@
+"""Resilience: retry policies, fault injection, preemption handling, and
+the training-run supervisor.
+
+At TPU-pod scale, preemptions and transient ICI/DCN/storage failures are
+routine operating conditions, not exceptions. This package makes the fault
+story a first-class, independently testable layer:
+
+- policy.py     — composable retry policies (backoff+jitter, deadlines)
+- faults.py     — deterministic fault injection (the test substrate)
+- preemption.py — the shared SIGTERM/SIGINT guard (hoisted from lifecycle)
+- health.py     — heartbeat/stall watchdog, escalates to checkpoint-and-exit
+- supervisor.py — bounded restart-from-checkpoint around Estimator.train
+"""
+
+from tfde_tpu.resilience.policy import (  # noqa: F401
+    DEFAULT_POLICY,
+    NO_RETRY,
+    RetryBudgetExceeded,
+    RetryPolicy,
+    TransientError,
+    policy_from_env,
+    retry,
+    retry_call,
+)
+from tfde_tpu.resilience.faults import (  # noqa: F401
+    DelayFault,
+    FaultInjector,
+    FaultSchedule,
+    RaiseFault,
+    SignalFault,
+    StepFaults,
+)
+from tfde_tpu.resilience.preemption import Preempted, PreemptionGuard  # noqa: F401
+from tfde_tpu.resilience.health import Heartbeat, StallError  # noqa: F401
+from tfde_tpu.resilience.supervisor import (  # noqa: F401
+    FailureKind,
+    Supervisor,
+    SupervisorAborted,
+    SupervisorConfig,
+    classify_failure,
+    train_supervised,
+)

@@ -1,0 +1,202 @@
+"""Deterministic fault injection — the test substrate for the resilience
+subsystem.
+
+A fault story that is only exercised by real outages is untested code. This
+module lets tests (and chaos drills) wrap any callable with a *seeded,
+deterministic* failure schedule: raise IOError on the Nth call, deliver
+SIGTERM when the training step counter reaches k, inject latency to trip the
+stall watchdog. Schedules are plain data, so a test reads as "calls 2 and 3
+fail, everything else passes" — no monkeypatching races, no flaky
+probability.
+
+Three layers:
+- actions: `RaiseFault`, `DelayFault`, `SignalFault` — what happens when a
+  schedule entry fires;
+- `FaultSchedule`: call-index -> action map, plus `seeded(...)` for
+  pseudo-random-but-reproducible schedules;
+- `FaultInjector`: wraps a callable (or patches an attribute, as a context
+  manager) and consults the schedule on every call.
+
+`StepFaults` is the training-loop face: an input-iterator wrapper that
+fires actions keyed by *step number* — e.g. SIGTERM at step k, simulating a
+TPU-pool preemption exactly where the scheduler would deliver it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import signal as _signal
+import time
+from typing import Callable, Dict, Iterable, Iterator, Optional, Union
+
+from tfde_tpu.observability import counters
+
+log = logging.getLogger(__name__)
+
+
+# -- actions -----------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class RaiseFault:
+    """Raise `exc_type(message)` instead of running the callable."""
+
+    exc_type: type = IOError
+    message: str = "injected fault"
+
+    def fire(self, where: str) -> None:
+        counters.incr("resilience/faults_injected")
+        log.info("fault injection: raising %s at %s", self.exc_type.__name__, where)
+        raise self.exc_type(f"{self.message} [{where}]")
+
+
+@dataclasses.dataclass(frozen=True)
+class DelayFault:
+    """Sleep `seconds` before running the callable — models a stalled
+    storage endpoint or a wedged collective; the substrate for watchdog
+    tests."""
+
+    seconds: float = 1.0
+    sleep: Callable[[float], None] = time.sleep
+
+    def fire(self, where: str) -> None:
+        counters.incr("resilience/faults_injected")
+        log.info("fault injection: %.2fs delay at %s", self.seconds, where)
+        self.sleep(self.seconds)
+
+
+@dataclasses.dataclass(frozen=True)
+class SignalFault:
+    """Deliver `signum` to this process — the preemption simulator (TPU
+    pools SIGTERM their workers)."""
+
+    signum: int = _signal.SIGTERM
+
+    def fire(self, where: str) -> None:
+        counters.incr("resilience/faults_injected")
+        log.info("fault injection: signal %d at %s", self.signum, where)
+        os.kill(os.getpid(), self.signum)
+
+
+Action = Union[RaiseFault, DelayFault, SignalFault]
+
+
+# -- schedules ---------------------------------------------------------------
+class FaultSchedule:
+    """1-based call-index -> action. Immutable once built; the injector
+    keeps the mutable call counter so one schedule can arm many injectors."""
+
+    def __init__(self, plan: Optional[Dict[int, Action]] = None):
+        bad = [k for k in (plan or {}) if k < 1]
+        if bad:
+            raise ValueError(f"call indices are 1-based; got {sorted(bad)}")
+        self._plan: Dict[int, Action] = dict(plan or {})
+
+    @classmethod
+    def fail_on(cls, *call_indices: int, exc_type: type = IOError,
+                message: str = "injected fault") -> "FaultSchedule":
+        """Raise-on-Nth-call, the workhorse: `fail_on(1, 2)` makes the
+        first two calls fail and the rest succeed."""
+        a = RaiseFault(exc_type=exc_type, message=message)
+        return cls({i: a for i in call_indices})
+
+    @classmethod
+    def slow_on(cls, *call_indices: int, seconds: float = 1.0,
+                sleep: Callable[[float], None] = time.sleep) -> "FaultSchedule":
+        return cls({i: DelayFault(seconds=seconds, sleep=sleep) for i in call_indices})
+
+    @classmethod
+    def seeded(cls, seed: int, n_calls: int, p_fail: float,
+               action: Optional[Action] = None) -> "FaultSchedule":
+        """Reproducible pseudo-random schedule: each of the first `n_calls`
+        calls independently fails with probability `p_fail` under `seed`.
+        Same seed -> same schedule, across processes and runs."""
+        rng = random.Random(seed)
+        action = action or RaiseFault()
+        return cls({i: action for i in range(1, n_calls + 1) if rng.random() < p_fail})
+
+    def action_for(self, call_index: int) -> Optional[Action]:
+        return self._plan.get(call_index)
+
+    @property
+    def plan(self) -> Dict[int, Action]:
+        return dict(self._plan)
+
+    def __repr__(self) -> str:
+        return f"FaultSchedule({self._plan!r})"
+
+
+# -- injectors ---------------------------------------------------------------
+class FaultInjector:
+    """Wrap a callable so each call first consults the schedule.
+
+    Also a context manager that patches `obj.attr` in place (and restores on
+    exit) so production call sites need zero test hooks:
+
+        with FaultInjector(schedule).patch(manager, "save"):
+            ...  # the 2nd manager.save(...) raises IOError
+    """
+
+    def __init__(self, schedule: FaultSchedule, name: str = ""):
+        self.schedule = schedule
+        self.name = name
+        self.calls = 0
+        self._patches = []
+
+    def wrap(self, fn: Callable) -> Callable:
+        def inner(*args, **kwargs):
+            self.calls += 1
+            action = self.schedule.action_for(self.calls)
+            if action is not None:
+                action.fire(f"{self.name or getattr(fn, '__qualname__', 'call')}#{self.calls}")
+            return fn(*args, **kwargs)
+
+        return inner
+
+    def patch(self, obj, attr: str) -> "FaultInjector":
+        self._patches.append((obj, attr, getattr(obj, attr)))
+        setattr(obj, attr, self.wrap(getattr(obj, attr)))
+        return self
+
+    def __enter__(self) -> "FaultInjector":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        while self._patches:
+            obj, attr, orig = self._patches.pop()
+            setattr(obj, attr, orig)
+
+
+class StepFaults:
+    """Training-loop fault injection: wrap an input iterable so that the
+    batch draw for step k (1-based, counted from this process's first draw)
+    first fires the scheduled action — `{k: SignalFault()}` is "preempt at
+    step k", `{k: DelayFault(s)}` is "stall step k".
+
+    Counted per *process attempt* on purpose: a restarted run re-arms from
+    1, so `fires_once=True` (default) disarms an action after it fires —
+    otherwise a SIGTERM at step 5 would re-preempt every restart that
+    passes step 5 and the run could never finish.
+    """
+
+    def __init__(self, plan: Dict[int, Action], fires_once: bool = True):
+        self._plan = dict(plan)
+        self._fires_once = fires_once
+
+    def wrap(self, batches: Iterable) -> Iterator:
+        def gen():
+            step = 0
+            for b in batches:
+                step += 1
+                action = self._plan.get(step)
+                if action is not None:
+                    if self._fires_once:
+                        del self._plan[step]
+                    action.fire(f"step#{step}")
+                yield b
+
+        return gen()
+
+    def wrap_input_fn(self, input_fn: Callable[[], Iterable]) -> Callable[[], Iterator]:
+        return lambda: self.wrap(input_fn())

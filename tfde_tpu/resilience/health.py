@@ -1,0 +1,150 @@
+"""Per-process heartbeat and stall detection.
+
+A wedged collective (one slice dropped out of a DCN rendezvous), a hung
+storage read, or a poisoned input pipeline all present the same way: the
+step counter stops moving while the process stays alive — invisible to a
+scheduler that only watches liveness. The reference stack leaned on TF's
+session timeouts; here the watchdog is explicit: the train loop (or the
+supervisor's input wrapper) calls `Heartbeat.beat(step)` as progress
+happens, and a daemon thread checks the age of the last beat. When it
+exceeds `stall_timeout_secs` the watchdog *escalates*: by default it
+delivers SIGTERM to its own process, which lands in the preemption guard —
+so escalation IS checkpoint-and-exit, riding the exact force-save/commit
+path a pool preemption takes. Under a supervisor that same path becomes
+checkpoint-and-restart.
+
+Counters exported through observability/counters.py:
+- ``resilience/stalls_detected`` — watchdog firings
+- ``resilience/heartbeats``     — total beats (rate ~ steps/sec)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal as _signal
+import threading
+import time
+from typing import Callable, Optional
+
+from tfde_tpu.observability import counters
+
+log = logging.getLogger(__name__)
+
+
+class StallError(Exception):
+    """Raised by `Heartbeat.check()` (the poll-style API) when the last
+    beat is older than the stall timeout. Classified as restartable by the
+    supervisor: a stall is environmental until proven otherwise."""
+
+    def __init__(self, age: float, last_step: Optional[int]):
+        super().__init__(
+            f"no step progress for {age:.1f}s (last step: {last_step})"
+        )
+        self.age = age
+        self.last_step = last_step
+
+
+def _default_escalation() -> None:
+    """Checkpoint-and-exit: SIGTERM self, landing in the preemption guard's
+    force-save path (resilience/preemption.py)."""
+    os.kill(os.getpid(), _signal.SIGTERM)
+
+
+@dataclasses.dataclass
+class Heartbeat:
+    """Progress tracker + optional background watchdog.
+
+    Use poll-style (`beat` + `check`) from a loop that owns its cadence, or
+    `start_watchdog()` for a daemon thread that escalates on its own. The
+    clock is injectable so tests run in virtual time.
+    """
+
+    stall_timeout_secs: float = 300.0
+    clock: Callable[[], float] = time.monotonic
+    on_stall: Callable[[], None] = _default_escalation
+
+    def __post_init__(self):
+        if self.stall_timeout_secs <= 0:
+            raise ValueError("stall_timeout_secs must be positive")
+        self._lock = threading.Lock()
+        self._last_beat: Optional[float] = None
+        self._last_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stalled = False
+
+    # -- progress ------------------------------------------------------------
+    def beat(self, step: Optional[int] = None) -> None:
+        counters.incr("resilience/heartbeats")
+        with self._lock:
+            self._last_beat = self.clock()
+            if step is not None:
+                self._last_step = int(step)
+
+    @property
+    def last_step(self) -> Optional[int]:
+        with self._lock:
+            return self._last_step
+
+    def age(self) -> float:
+        """Seconds since the last beat (or since construction-time arm via
+        the first check/watchdog tick when no beat has happened yet)."""
+        with self._lock:
+            if self._last_beat is None:
+                self._last_beat = self.clock()  # arm on first observation
+            return self.clock() - self._last_beat
+
+    # -- poll-style ----------------------------------------------------------
+    def check(self) -> None:
+        """Raise StallError when the last beat is too old. For loops that
+        interleave their own watchdog polling (e.g. the supervisor between
+        restart attempts)."""
+        a = self.age()
+        if a > self.stall_timeout_secs:
+            counters.incr("resilience/stalls_detected")
+            raise StallError(a, self.last_step)
+
+    # -- watchdog thread -----------------------------------------------------
+    def start_watchdog(self, poll_secs: Optional[float] = None) -> "Heartbeat":
+        """Start the daemon watchdog; fires `on_stall` ONCE per stall (the
+        flag re-arms on the next beat, so a recovered-then-wedged-again
+        process escalates again)."""
+        if self._thread is not None:
+            return self
+        poll = poll_secs if poll_secs is not None else max(0.1, self.stall_timeout_secs / 10.0)
+
+        def run():
+            while not self._stop.wait(poll):
+                a = self.age()
+                if a > self.stall_timeout_secs:
+                    if not self._stalled:
+                        self._stalled = True
+                        counters.incr("resilience/stalls_detected")
+                        log.error(
+                            "stall detected: no progress for %.1fs (last "
+                            "step %s); escalating", a, self.last_step,
+                        )
+                        try:
+                            self.on_stall()
+                        except Exception:
+                            log.exception("stall escalation callback failed")
+                else:
+                    self._stalled = False
+
+        self._thread = threading.Thread(target=run, daemon=True, name="stall-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start_watchdog()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,214 @@
+"""Composable retry policies: exponential backoff + jitter, deadline
+budgets, max-attempt caps.
+
+The reference runtime's fault tolerance was monolithic — TF's gRPC layer
+retried internally and the Estimator restarted from checkpoints — with no
+operator control in between. Here the retry behavior is a first-class value:
+a `RetryPolicy` describes *how* to retry, `retry_call`/`retry` apply it to
+any fallible callable, and the I/O layers (checkpoint/manager.py,
+utils/fs.py, data source opens, runtime bootstrap) take a policy instead of
+hand-rolling loops. Everything is injectable (sleep, clock, rng) so tests
+run in virtual time, and jitter is seeded so schedules are reproducible.
+
+Classification: only exceptions in `policy.retryable` are retried —
+everything else (a structure-mismatch ValueError, a poison-step assertion)
+propagates on the first throw. `TransientError` is the marker callers can
+raise/wrap to force classification as retryable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import os
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from tfde_tpu.observability import counters
+
+log = logging.getLogger(__name__)
+
+# The transient I/O surface: blips on DCN/storage (gs:// timeouts, reset
+# connections) present as OSError subclasses or timeouts. IOError is an
+# alias of OSError; ConnectionError is an OSError subclass — listed for
+# readers, harmless as duplicates.
+DEFAULT_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    OSError,
+    TimeoutError,
+    ConnectionError,
+)
+
+
+class TransientError(Exception):
+    """Marker for failures the raiser *knows* are transient (worth a retry
+    under any policy) even when the underlying type isn't in the policy's
+    retryable set."""
+
+
+class RetryBudgetExceeded(OSError):
+    """All attempts (or the deadline budget) were consumed. `__cause__` is
+    the last underlying failure; `attempts` is how many were made.
+
+    Subclasses OSError so call sites that guard I/O with `except OSError`
+    keep working when the budget (not a single call) is what failed — and
+    the supervisor classifies it transient the same way.
+    """
+
+    def __init__(self, msg: str, attempts: int):
+        super().__init__(msg)
+        self.attempts = attempts
+
+
+#: Deterministic outcomes that happen to be OSErrors — retrying them burns
+#: the backoff budget to reach the same answer. Checked before `retryable`.
+DEFAULT_NON_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    FileNotFoundError,
+    FileExistsError,
+    IsADirectoryError,
+    NotADirectoryError,
+    PermissionError,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How to retry: attempts, backoff shape, and a wall-clock budget.
+
+    backoff for attempt k (1-based failures) is
+    `min(max_backoff, initial_backoff * multiplier**(k-1))`, scaled by a
+    uniform jitter in [1-jitter, 1+jitter] so a fleet of workers retrying
+    the same dead storage endpoint doesn't thundering-herd it.
+
+    deadline is the total seconds budget across ALL attempts including
+    sleeps; None means attempts alone bound the loop. max_attempts counts
+    the first call: max_attempts=1 means no retries.
+    """
+
+    max_attempts: int = 4
+    initial_backoff: float = 0.2
+    max_backoff: float = 30.0
+    multiplier: float = 2.0
+    jitter: float = 0.25
+    deadline: Optional[float] = None
+    retryable: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE
+    non_retryable: Tuple[Type[BaseException], ...] = DEFAULT_NON_RETRYABLE
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        if isinstance(exc, TransientError):  # explicit marker wins
+            return True
+        if isinstance(exc, self.non_retryable):
+            return False
+        return isinstance(exc, self.retryable)
+
+    def backoff(self, failure_index: int, rng: Optional[random.Random] = None) -> float:
+        """Sleep before the (failure_index+1)-th retry; failure_index is
+        1-based (first failure -> initial_backoff)."""
+        base = self.initial_backoff * (self.multiplier ** (failure_index - 1))
+        base = min(self.max_backoff, base)
+        if self.jitter and rng is not None:
+            base *= rng.uniform(1.0 - self.jitter, 1.0 + self.jitter)
+        return max(0.0, base)
+
+
+#: Conservative default for library I/O paths. NO_RETRY opts a path out
+#: without branching at every call site.
+DEFAULT_POLICY = RetryPolicy()
+NO_RETRY = RetryPolicy(max_attempts=1)
+
+
+def policy_from_env(prefix: str = "TFDE_RETRY_", base: Optional[RetryPolicy] = None) -> RetryPolicy:
+    """Operator knobs (documented in README "Fault tolerance"):
+
+    - ``TFDE_RETRY_MAX_ATTEMPTS`` (int, default 4; 1 disables retries)
+    - ``TFDE_RETRY_INITIAL_BACKOFF`` / ``TFDE_RETRY_MAX_BACKOFF`` (seconds)
+    - ``TFDE_RETRY_DEADLINE`` (seconds total budget; unset = attempts only)
+    """
+    base = base or DEFAULT_POLICY
+    kw = {}
+    for env, field, cast in (
+        ("MAX_ATTEMPTS", "max_attempts", int),
+        ("INITIAL_BACKOFF", "initial_backoff", float),
+        ("MAX_BACKOFF", "max_backoff", float),
+        ("DEADLINE", "deadline", float),
+    ):
+        raw = os.environ.get(prefix + env)
+        if raw is None:
+            continue
+        try:
+            kw[field] = cast(raw)
+        except ValueError as e:
+            raise ValueError(f"{prefix}{env}={raw!r} is not a valid {cast.__name__}") from e
+    return dataclasses.replace(base, **kw) if kw else base
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: RetryPolicy = DEFAULT_POLICY,
+    what: str = "",
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    rng: Optional[random.Random] = None,
+    counter: str = "resilience/retries",
+    **kwargs,
+):
+    """Call `fn(*args, **kwargs)` under `policy`.
+
+    Non-retryable exceptions propagate immediately and untouched. When the
+    budget runs out, raises RetryBudgetExceeded from the last failure so
+    callers/operators see both the exhaustion and the root cause. Every
+    retry increments the `counter` observability counter.
+    """
+    what = what or getattr(fn, "__qualname__", repr(fn))
+    rng = rng if rng is not None else random.Random(0xC0FFEE)
+    t0 = clock()
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            if not policy.is_retryable(e):
+                raise
+            last = e
+            if attempt >= policy.max_attempts:
+                break
+            delay = policy.backoff(attempt, rng)
+            if policy.deadline is not None and (clock() - t0) + delay > policy.deadline:
+                break
+            counters.incr(counter)
+            log.warning(
+                "%s failed (attempt %d/%d, %s: %s); retrying in %.2fs",
+                what, attempt, policy.max_attempts, type(e).__name__, e, delay,
+            )
+            sleep(delay)
+    assert last is not None
+    raise RetryBudgetExceeded(
+        f"{what}: retry budget exhausted after {policy.max_attempts} "
+        f"attempt(s) ({type(last).__name__}: {last})",
+        attempts=policy.max_attempts,
+    ) from last
+
+
+def retry(policy: RetryPolicy = DEFAULT_POLICY, **retry_kwargs) -> Callable:
+    """Decorator form of `retry_call` for defs owned by this codebase:
+
+        @retry(RetryPolicy(max_attempts=3))
+        def open_shard(path): ...
+    """
+
+    def wrap(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            return retry_call(fn, *args, policy=policy, **retry_kwargs, **kwargs)
+
+        return inner
+
+    return wrap

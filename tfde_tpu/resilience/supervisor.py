@@ -1,0 +1,304 @@
+"""Preemption-aware training supervisor: the run loop around the run loop.
+
+The reference's Estimator runtime gave workers transparent fault tolerance —
+a restarted process resumes from the latest checkpoint with no operator
+action (SURVEY §5). In the TPU-native stack that contract was implicit: the
+lifecycle resumes-by-default, but nothing *owned* the restart. This module
+is that owner. `Supervisor.run()` drives `Estimator.train()` in a bounded
+restart loop:
+
+- **classify**: a failure is a PREEMPTION (signal, checkpoint already
+  committed by the guard), TRANSIENT (I/O blip that outlived the retry
+  policy's budget), a STALL (watchdog escalation), or POISON (deterministic
+  error — an assertion, a shape mismatch — that would recur on every
+  restart and must abort);
+- **restart**: restartable kinds rebuild a fresh Estimator from the
+  factory; resume-by-default restores the latest *committed* step, so the
+  restart replays at most save_checkpoints_steps-1 steps;
+- **bound**: `max_restarts` caps the loop, restart backoff rides a
+  RetryPolicy, and a restart that makes no checkpoint progress twice in a
+  row is escalated to abort (a restart loop that never advances is poison
+  with extra steps);
+- **observe**: restarts/lost-step estimates/stalls are exported through
+  observability counters, and written as TensorBoard scalars under
+  `<model_dir>/resilience` on the chief.
+
+Preemption handling composes with the hoisted `PreemptionGuard`
+(resilience/preemption.py): in `resume_on_preemption` mode the supervisor
+installs an outer SIGTERM handler that raises `Preempted`; the guard saves
+it as "previous", so the guard's post-commit re-raise lands there and the
+supervisor restarts from the just-committed checkpoint instead of dying.
+A second SIGTERM still kills (the outer handler restores the default before
+raising). Without a supervisor — or with `resume_on_preemption=False`, the
+production default where the pool scheduler owns restarts — the process
+exits by signal exactly as before.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import random
+import signal as _signal
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from tfde_tpu.observability import counters
+from tfde_tpu.resilience.health import Heartbeat, StallError
+from tfde_tpu.resilience.policy import (
+    RetryBudgetExceeded,
+    RetryPolicy,
+    TransientError,
+)
+from tfde_tpu.resilience.preemption import Preempted
+
+log = logging.getLogger(__name__)
+
+
+class FailureKind(enum.Enum):
+    PREEMPTION = "preemption"
+    TRANSIENT = "transient"
+    STALL = "stall"
+    POISON = "poison"
+
+
+def classify_failure(exc: BaseException) -> FailureKind:
+    """Map a failure to its restart semantics. KeyboardInterrupt is NOT
+    classified here — operator intent aborts before classification."""
+    if isinstance(exc, Preempted):
+        return FailureKind.PREEMPTION
+    if isinstance(exc, StallError):
+        return FailureKind.STALL
+    if isinstance(exc, RetryBudgetExceeded):
+        # the I/O layer already retried in place; a restart gets fresh
+        # connections/processes, which is the next rung on the ladder
+        return FailureKind.TRANSIENT
+    if isinstance(exc, (OSError, TimeoutError, ConnectionError, TransientError)):
+        return FailureKind.TRANSIENT
+    return FailureKind.POISON
+
+
+class SupervisorAborted(RuntimeError):
+    """The supervisor gave up: restart budget exhausted, no forward
+    progress, or a poison failure. `__cause__` is the last failure;
+    `restarts` is how many restarts were attempted."""
+
+    def __init__(self, msg: str, restarts: int):
+        super().__init__(msg)
+        self.restarts = restarts
+
+
+@dataclasses.dataclass
+class SupervisorConfig:
+    #: total restarts allowed across the run (attempts = max_restarts + 1)
+    max_restarts: int = 5
+    #: backoff shape between restarts (max_attempts is ignored here —
+    #: max_restarts bounds the loop)
+    restart_policy: RetryPolicy = dataclasses.field(
+        default_factory=lambda: RetryPolicy(initial_backoff=1.0, max_backoff=60.0)
+    )
+    #: in-process restart on SIGTERM (single-process pools, tests, chaos
+    #: drills). False = production default: the guard checkpoint-commits and
+    #: the process exits by signal; the cluster scheduler owns the restart.
+    resume_on_preemption: bool = False
+    #: arm the stall watchdog (None = off). Escalation is SIGTERM-to-self,
+    #: i.e. checkpoint-and-exit (or checkpoint-and-restart under
+    #: resume_on_preemption).
+    stall_timeout_secs: Optional[float] = None
+    #: abort after this many consecutive restarts with no checkpoint
+    #: progress — an advancing run may be preempted forever and keep
+    #: making progress; one that cannot advance is effectively poison
+    no_progress_limit: int = 2
+    #: deterministic restart-backoff jitter
+    seed: int = 0
+
+
+class Supervisor:
+    """Owns a training run: builds Estimators from `estimator_factory`,
+    drives `train()`, classifies failures, restarts from the latest
+    committed checkpoint.
+
+    The factory is called once per attempt — a fresh Estimator per restart
+    is the whole point (fresh Orbax manager, fresh compiled steps, fresh
+    state restored from disk), mirroring what a real process restart gets.
+    """
+
+    def __init__(
+        self,
+        estimator_factory: Callable[[], "Estimator"],
+        config: Optional[SupervisorConfig] = None,
+    ):
+        self.factory = estimator_factory
+        self.config = config or SupervisorConfig()
+        self.restarts = 0
+        self.last_failure: Optional[BaseException] = None
+        self._rng = random.Random(self.config.seed)
+
+    # -- internals -----------------------------------------------------------
+    @staticmethod
+    def _committed_step(est) -> Optional[int]:
+        """Latest step on disk for `est`'s model_dir; None when
+        checkpointing is off or the directory is empty/unreadable."""
+        try:
+            mngr = est._ckpt_mngr()
+            if mngr is None:
+                return None
+            mngr.reload()
+            return mngr.latest_step
+        except Exception:
+            return None
+
+    def _outer_sigterm(self):
+        """Install the resume-on-preemption outer handler (main thread
+        only); returns a restore callable. The handler restores the
+        *default* handler first, so a second SIGTERM during restart/save is
+        the operator's hard kill, then raises Preempted."""
+        if (not self.config.resume_on_preemption
+                or threading.current_thread() is not threading.main_thread()):
+            return lambda: None
+
+        def handler(signum, frame):
+            _signal.signal(signum, _signal.SIG_DFL)
+            raise Preempted(signum)
+
+        prev = _signal.signal(_signal.SIGTERM, handler)
+        return lambda: _signal.signal(_signal.SIGTERM, prev)
+
+    def _beat_input_fn(self, input_fn, heartbeat: Heartbeat, start_step: int):
+        """Wrap the input so every batch draw beats the heartbeat with the
+        (approximate) step about to run — batch draws are the loop's pulse,
+        and a wedged compile/collective/storage read stops them too."""
+
+        def wrapped() -> Iterable:
+            def gen():
+                step = start_step
+                for b in input_fn():
+                    step += 1
+                    heartbeat.beat(step)
+                    yield b
+
+            return gen()
+
+        return wrapped
+
+    def _export(self, est, step: int) -> None:
+        """Chief-side counter export as TensorBoard scalars next to the
+        run's curves."""
+        try:
+            model_dir = est.config.model_dir
+            if model_dir is None or not est._is_chief:
+                return
+            from tfde_tpu.observability.tensorboard import SummaryWriter
+            from tfde_tpu.utils import fs
+
+            w = SummaryWriter(fs.join(model_dir, "resilience"))
+            try:
+                counters.export_scalars(w, step, prefix="resilience/")
+            finally:
+                w.close()
+        except Exception:
+            log.exception("resilience counter export failed (non-fatal)")
+
+    # -- the run loop --------------------------------------------------------
+    def run(self, input_fn, max_steps: int, **train_kwargs):
+        """Supervised `Estimator.train(input_fn, max_steps)`. Returns the
+        final TrainState; raises SupervisorAborted when the run cannot be
+        completed."""
+        cfg = self.config
+        no_progress = 0
+        committed_before: Optional[int] = None
+
+        while True:
+            est = self.factory()
+            restore_handler = self._outer_sigterm()
+            heartbeat = None
+            if cfg.stall_timeout_secs is not None:
+                heartbeat = Heartbeat(stall_timeout_secs=cfg.stall_timeout_secs)
+            start_committed = self._committed_step(est) or 0
+            try:
+                fn = input_fn
+                if heartbeat is not None:
+                    fn = self._beat_input_fn(input_fn, heartbeat, start_committed)
+                    heartbeat.start_watchdog()
+                state = est.train(fn, max_steps, **train_kwargs)
+                self._export(est, max_steps)
+                return state
+            except KeyboardInterrupt:
+                # operator intent (or a guard-committed SIGINT): stop, never
+                # restart — the checkpoint, if any, is already on disk
+                raise
+            except BaseException as e:
+                kind = classify_failure(e)
+                committed = self._committed_step(est)
+                reached = heartbeat.last_step if heartbeat is not None else None
+                lost = max(0, (reached or 0) - (committed or 0))
+                if lost:
+                    counters.incr("resilience/lost_steps", lost)
+                counters.incr(f"resilience/failures_{kind.value}")
+                self.last_failure = e
+
+                if kind is FailureKind.POISON:
+                    log.error("poison failure (%s: %s); aborting run",
+                              type(e).__name__, e)
+                    raise SupervisorAborted(
+                        f"non-restartable failure after {self.restarts} "
+                        f"restart(s): {type(e).__name__}: {e}",
+                        restarts=self.restarts,
+                    ) from e
+                if self.restarts >= cfg.max_restarts:
+                    raise SupervisorAborted(
+                        f"restart budget ({cfg.max_restarts}) exhausted; "
+                        f"last failure: {type(e).__name__}: {e}",
+                        restarts=self.restarts,
+                    ) from e
+
+                # forward-progress bound: a restart loop whose committed
+                # step never moves is poison wearing a transient's clothes
+                # (no checkpoint at all counts as step 0)
+                if (committed or 0) <= (committed_before or 0):
+                    no_progress += 1
+                else:
+                    no_progress = 0
+                committed_before = committed
+                if no_progress >= cfg.no_progress_limit:
+                    raise SupervisorAborted(
+                        f"no checkpoint progress across {no_progress} "
+                        f"consecutive restarts (stuck at step {committed}); "
+                        f"last failure: {type(e).__name__}: {e}",
+                        restarts=self.restarts,
+                    ) from e
+
+                self.restarts += 1
+                counters.incr("resilience/restarts")
+                delay = cfg.restart_policy.backoff(self.restarts, self._rng)
+                log.warning(
+                    "%s failure (%s: %s); restart %d/%d from committed step "
+                    "%s in %.2fs",
+                    kind.value, type(e).__name__, e, self.restarts,
+                    cfg.max_restarts, committed, delay,
+                )
+                time.sleep(delay)
+            finally:
+                if heartbeat is not None:
+                    heartbeat.stop()
+                restore_handler()
+                try:
+                    est.close()
+                except Exception:
+                    log.debug("estimator close after failure raised", exc_info=True)
+
+
+def train_supervised(
+    estimator_factory: Callable[[], "Estimator"],
+    input_fn,
+    max_steps: int,
+    config: Optional[SupervisorConfig] = None,
+    **train_kwargs,
+):
+    """One-call form: `train_supervised(lambda: Estimator(...), input_fn,
+    max_steps)` — the supervised analog of `estimator.train(...)`."""
+    return Supervisor(estimator_factory, config).run(
+        input_fn, max_steps, **train_kwargs
+    )

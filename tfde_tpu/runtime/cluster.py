@@ -204,14 +204,39 @@ def bootstrap(coordinator_port: int = 8476) -> ClusterInfo:
         coord = info.coordinator_address
         if coord:
             coord = coordinator_endpoint(coord, coordinator_port)
+        # Multi-process over the CPU backend (tests, local rehearsal of a
+        # pod topology) needs a cross-process collectives impl; older jax
+        # ships gloo behind a config knob that newer jax dropped. Harmless
+        # for TPU — the option only touches the CPU client.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass
         log.info(
             "jax.distributed.initialize(coordinator=%s, num_processes=%d, process_id=%d)",
             coord, info.num_processes, info.process_id,
         )
-        jax.distributed.initialize(
+        # Pod bring-up is racy by nature: workers start before the
+        # coordinator listens, DNS lags the scheduler. initialize() surfaces
+        # that as RuntimeError (grpc deadline) — retried under the
+        # operator's TFDE_RETRY_* policy with RuntimeError added, since a
+        # worker that gives up on first connect strands the whole slice.
+        import dataclasses as _dc
+
+        from tfde_tpu.resilience.policy import policy_from_env, retry_call
+
+        base = policy_from_env()
+        policy = _dc.replace(
+            base, retryable=tuple(base.retryable) + (RuntimeError,)
+        )
+        retry_call(
+            jax.distributed.initialize,
             coordinator_address=coord,
             num_processes=info.num_processes,
             process_id=info.process_id,
+            policy=policy,
+            what="jax.distributed.initialize",
+            counter="resilience/bootstrap_retries",
         )
         _INITIALIZED = True
     return info

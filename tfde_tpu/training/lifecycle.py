@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import signal
 import threading
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
@@ -34,6 +33,7 @@ import numpy as np
 
 from tfde_tpu.checkpoint.manager import CheckpointManager
 from tfde_tpu.data.device import device_prefetch
+from tfde_tpu.resilience.preemption import PreemptionGuard as _PreemptionGuard
 from tfde_tpu.data.pipeline import AutoShardPolicy
 from tfde_tpu.observability.profiler import StepWindowProfiler
 from tfde_tpu.observability.tensorboard import SummaryWriter
@@ -51,83 +51,10 @@ from tfde_tpu.training.train_state import TrainState
 log = logging.getLogger(__name__)
 
 
-class _PreemptionGuard:
-    """SIGTERM/SIGINT-safe training (the restart-tolerance contract,
-    mnist_keras:245-248, extended to preemption: TPU pools SIGTERM their
-    workers, and losing up to save_checkpoints_steps-1 steps on every
-    preemption is real lost work — VERDICT r4 weak #6).
-
-    The handler only sets a flag (async-signal-safe); the train loop polls
-    it each step, breaks, and its normal tail force-saves and waits for
-    the async commit. The first signal also RESTORES the previous handler,
-    so a second signal kills immediately — the operator's escape hatch if
-    the save itself wedges. After the commit, the loop re-raises the
-    signal under the restored handler so the process exits with the
-    signal's semantics (SIGTERM -> killed-by-15, SIGINT ->
-    KeyboardInterrupt) instead of pretending the run finished.
-
-    Signal handlers can only be installed from the main thread; anywhere
-    else (the concurrent evaluator, tests driving train() from a worker
-    thread) the guard is inert and behavior is unchanged.
-
-    Known limit, on purpose: a signal landing while the loop is blocked in
-    next(feed) is acted on when the next batch arrives — a flag-setting
-    handler is the only one that cannot corrupt the step in flight (a
-    raising handler would surface at an arbitrary bytecode, e.g. after
-    the step donated the previous state's buffers but before the new
-    state bound, leaving nothing valid to save). A feed stalled past the
-    pool's SIGKILL grace therefore still loses the window since the last
-    periodic save; the second signal (default handler) is the immediate
-    kill.
-    """
-
-    _SIGNUMS = (signal.SIGTERM, signal.SIGINT)
-
-    def __init__(self):
-        self.fired: Optional[int] = None
-        self._prev: dict = {}
-
-    def __enter__(self) -> "_PreemptionGuard":
-        if threading.current_thread() is threading.main_thread():
-            for s in self._SIGNUMS:
-                try:
-                    self._prev[s] = signal.signal(s, self._handle)
-                except (ValueError, OSError):  # exotic embedding; stay inert
-                    pass
-        return self
-
-    def _handle(self, signum, frame):
-        self.fired = signum
-        signal.signal(signum, self._prev.get(signum, signal.SIG_DFL))
-        self._prev.pop(signum, None)
-
-    def __exit__(self, *exc) -> bool:
-        # list(): a signal landing mid-restore pops from _prev via the
-        # still-installed handler; iterating the live dict would raise and
-        # swallow the re-raise below
-        for s, h in list(self._prev.items()):
-            try:
-                signal.signal(s, h)
-            except (ValueError, OSError):
-                pass
-        self._prev.clear()
-        return False
-
-    def reraise_if_fired(self, saved_step: Optional[int]) -> None:
-        if self.fired is None:
-            return
-        if saved_step is not None:
-            log.warning(
-                "preemption signal %d: checkpoint at step %d committed; "
-                "re-raising", self.fired, saved_step,
-            )
-        else:
-            log.warning(
-                "preemption signal %d: NO checkpoint manager configured "
-                "(model_dir/save_checkpoints_steps unset) — progress since "
-                "start is lost; re-raising", self.fired,
-            )
-        signal.raise_signal(self.fired)
+# _PreemptionGuard moved to tfde_tpu/resilience/preemption.py (PR 1): the
+# supervisor and the stall watchdog share the same signal machinery, so it
+# lives in the resilience layer; the alias import above keeps this module's
+# train() and existing callers/tests unchanged.
 
 
 @dataclasses.dataclass

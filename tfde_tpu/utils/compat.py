@@ -1,0 +1,98 @@
+"""JAX version compatibility shims.
+
+The codebase targets current JAX spellings; older releases in the support
+window spell a few of them differently. Each shim takes the NEW surface and
+translates down when needed, so call sites stay modern.
+
+- `shard_map`: new JAX exposes `jax.shard_map(..., check_vma=, axis_names=)`;
+  older releases have `jax.experimental.shard_map.shard_map(..., check_rep=,
+  auto=)`. `axis_names` (the axes the body is manual over) is the complement
+  of old `auto` (the axes left automatic).
+- `pcast` / `vma_of`: new JAX types device-variance into avals
+  (`jax.typeof(x).vma`) and converts with `jax.lax.pcast`; old JAX has no
+  vma typing and `check_rep`'s rewrite rules insert `pbroadcast`s
+  automatically, so the shims degrade to frozenset() / identity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def shard_map(
+    f,
+    mesh,
+    in_specs,
+    out_specs,
+    check_vma: Optional[bool] = None,
+    axis_names=None,
+):
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # Old check_rep's rewrite rules predate vma typing and reject valid
+    # programs (cond branches, scan carries) that the explicit pcast calls
+    # handle on new jax — and those calls shim to the identity here, so
+    # replication checking defaults OFF on the old path.
+    kwargs = {"check_rep": bool(check_vma)}
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def pcast(x, axis_names, to: str = "varying"):
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_names, to=to)
+    # Old jax has no vma typing: check_rep's rewrite rules insert
+    # pbroadcasts automatically, and an explicit one on an already-varying
+    # value is an error — the correct translation is the identity.
+    return x
+
+
+def get_abstract_mesh():
+    """New `jax.sharding.get_abstract_mesh`, old internal equivalent."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        return jax.sharding.get_abstract_mesh()
+    from jax._src import mesh as _mesh
+
+    return _mesh.get_abstract_mesh()
+
+
+def supports_partial_manual() -> bool:
+    """Whether shard_map's partial-manual ("auto") mode works on this jax.
+    Pre-vma releases lower axis_index inside a partial-manual region to a
+    PartitionId op the SPMD partitioner rejects; the capability tracks the
+    jax.shard_map surface."""
+    return hasattr(jax, "shard_map")
+
+
+def abstract_mesh(axis_sizes, axis_names):
+    """`jax.sharding.AbstractMesh` across the signature change: new jax
+    takes `(sizes, names)`, old jax a single `((name, size), ...)` tuple."""
+    try:
+        return jax.sharding.AbstractMesh(tuple(axis_sizes), tuple(axis_names))
+    except TypeError:
+        return jax.sharding.AbstractMesh(
+            tuple(zip(axis_names, (int(s) for s in axis_sizes)))
+        )
+
+
+def vma_of(x) -> frozenset:
+    """The device-variance axes of `x` (frozenset() on jax without vma
+    typing, where variance is not part of the aval)."""
+    if hasattr(jax, "typeof"):
+        return frozenset(getattr(jax.typeof(x), "vma", frozenset()))
+    return frozenset()

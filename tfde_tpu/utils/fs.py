@@ -31,6 +31,26 @@ from typing import IO, List
 
 _SCHEME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.-]*://")
 
+# Remote operations retry under the operator's TFDE_RETRY_* policy
+# (resilience/policy.py): object-store blips are transient by nature, local
+# filesystem errors are not — so only the remote branches go through
+# _remote_call. Imported lazily to keep this module import-light (the
+# observability event writer imports fs; fs must not import it back at
+# module scope).
+_RETRY = None
+
+
+def _remote_call(fn, *args, what: str = "", **kwargs):
+    global _RETRY
+    from tfde_tpu.resilience.policy import policy_from_env, retry_call
+
+    if _RETRY is None:
+        _RETRY = policy_from_env()
+    return retry_call(
+        fn, *args, policy=_RETRY, what=what,
+        counter="resilience/fs_retries", **kwargs,
+    )
+
 
 def is_remote(path: str) -> bool:
     """True for scheme-prefixed URLs (gs://...), False for local paths."""
@@ -61,21 +81,24 @@ def join(path: str, *parts: str) -> str:
 
 def makedirs(path: str, exist_ok: bool = True) -> None:
     if is_remote(path):
-        _fs(path).makedirs(_strip(path), exist_ok=exist_ok)
+        _remote_call(_fs(path).makedirs, _strip(path), exist_ok=exist_ok,
+                     what=f"makedirs({path})")
         return
     os.makedirs(path, exist_ok=exist_ok)
 
 
 def fs_open(path: str, mode: str = "rb") -> IO:
     if is_remote(path):
-        return _fs(path).open(_strip(path), mode)
+        return _remote_call(_fs(path).open, _strip(path), mode,
+                            what=f"open({path})")
     return open(path, mode)
 
 
 def write_bytes(path: str, data: bytes) -> None:
     """Atomically-ish replace the object/file at `path` with `data`."""
     if is_remote(path):
-        _fs(path).pipe_file(_strip(path), data)
+        _remote_call(_fs(path).pipe_file, _strip(path), data,
+                     what=f"write_bytes({path})")
         return
     with open(path, "wb") as f:
         f.write(data)
@@ -83,13 +106,15 @@ def write_bytes(path: str, data: bytes) -> None:
 
 def exists(path: str) -> bool:
     if is_remote(path):
-        return _fs(path).exists(_strip(path))
+        return _remote_call(_fs(path).exists, _strip(path),
+                            what=f"exists({path})")
     return os.path.exists(path)
 
 
 def isdir(path: str) -> bool:
     if is_remote(path):
-        return _fs(path).isdir(_strip(path))
+        return _remote_call(_fs(path).isdir, _strip(path),
+                            what=f"isdir({path})")
     return os.path.isdir(path)
 
 
@@ -98,7 +123,8 @@ def listdir(path: str) -> List[str]:
     if is_remote(path):
         fs = _fs(path)
         out = []
-        for entry in fs.ls(_strip(path), detail=False):
+        for entry in _remote_call(fs.ls, _strip(path), detail=False,
+                                  what=f"listdir({path})"):
             name = entry.rstrip("/").rsplit("/", 1)[-1]
             if name:
                 out.append(name)

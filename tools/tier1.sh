@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Tier-1 verify: the exact gate from ROADMAP.md. CPU-only, excludes
+# @pytest.mark.slow, survives collection errors, and prints DOTS_PASSED
+# (count of '.' in pytest progress lines) so a harness can diff pass
+# counts across revisions even when the exit code is nonzero.
+#
+# Usage: tools/tier1.sh            (from the repo root)
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
