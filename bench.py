@@ -392,6 +392,66 @@ def _bench_mnist_dev(clock: _Clock, strategy, n_chips: int,
     }
 
 
+def _bench_obs(strategy, smoke: bool) -> dict:
+    """Observability self-measurement: a short Estimator-driven run with
+    the goodput ledger attached — reports where the wall-clock of a real
+    instrumented train loop goes (compile, data-wait, goodput) and how much
+    the span accounting leaves unexplained (obs_other_fraction; the
+    acceptance bar is <= 0.05 on a summary-synced run)."""
+    import tempfile
+    import time
+
+    import numpy as np
+    import optax
+
+    from tfde_tpu.models.cnn import PlainCNN
+    from tfde_tpu.observability.goodput import GoodputLedger
+    from tfde_tpu.training.lifecycle import Estimator, RunConfig
+
+    steps = 10 if smoke else 40
+    n = GLOBAL_BATCH * 4
+    rng = np.random.default_rng(0)
+    images = rng.random((n, 784), np.float32)
+    labels = rng.integers(0, 10, (n, 1)).astype(np.int32)
+
+    def input_fn():
+        def gen():
+            i = 0
+            while True:
+                s = (i * GLOBAL_BATCH) % n
+                yield (images[s:s + GLOBAL_BATCH],
+                       labels[s:s + GLOBAL_BATCH])
+                i += 1
+
+        return gen()
+
+    est = Estimator(
+        model=PlainCNN(),
+        optimizer=optax.sgd(0.1),
+        strategy=strategy,
+        config=RunConfig(
+            model_dir=tempfile.mkdtemp(prefix="tfde-bench-obs-"),
+            save_summary_steps=5,
+            log_step_count_steps=steps,
+            save_checkpoints_steps=None,  # no checkpoint I/O in the number
+        ),
+    )
+    ledger = GoodputLedger()
+    t0 = time.perf_counter()
+    est.train(input_fn, steps)
+    wall = time.perf_counter() - t0
+    est.close()
+    rep = ledger.report(wall)
+    return {
+        "obs_steps": rep["steps"],
+        "obs_compile_seconds": round(rep["seconds"]["compile"], 3),
+        "obs_data_wait_fraction": round(rep["fractions"]["data_wait"], 4),
+        "obs_goodput": round(rep["goodput"], 4),
+        "obs_other_fraction": round(rep["fractions"]["other"], 4),
+        "obs_mean_step_ms": round(rep["mean_step_seconds"] * 1e3, 3),
+    }
+
+
 def _bench_link(clock: _Clock, smoke: bool) -> dict:
     """Host->device transfer microbenchmark — the attribution control for
     the e2e gap (VERDICT r3 #3). Measures the per-transfer latency floor
@@ -1158,6 +1218,7 @@ def run_mode() -> None:
         ("link", lambda: _bench_link(clock, smoke)),
         ("mnist_dev", lambda: _bench_mnist_dev(clock, strategy, n_chips,
                                                smoke)),
+        ("obs", lambda: _bench_obs(strategy, smoke)),
         ("bert", lambda: _bench_bert_mfu(clock, strategy, n_chips, peak, smoke)),
         ("flash", lambda: _bench_flash(clock, smoke)),
         # stretch configs: ordered last so an attempt-timeout salvages the

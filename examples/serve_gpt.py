@@ -200,7 +200,7 @@ def main(argv=None):
              "batch %d)", len(done), total, dt, total / max(dt, 1e-9),
              args.batch_size)
     if hasattr(srv, "stats"):
-        log.info("speculative stats: %s", srv.stats)
+        log.info("speculative stats: %s", srv.stats())
     return done
 
 

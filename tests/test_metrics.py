@@ -1,0 +1,223 @@
+"""Metrics-registry acceptance tests (ISSUE 2, satellite 3): concurrency
+safety, histogram percentile fidelity vs numpy, the Prometheus round-trip,
+the legacy counters shim, spans, and the /metrics HTTP surface.
+
+Everything here runs on a private Registry (or carefully-namespaced default
+registry entries) so tests stay independent of the train-loop metrics other
+tests emit into the process-wide default."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tfde_tpu.observability import counters, metrics, spans
+from tfde_tpu.observability.exposition import (
+    JsonlMetricsLog,
+    MetricsServer,
+    PROM_CONTENT_TYPE,
+    parse_prometheus_text,
+    prom_name,
+    to_prometheus_text,
+)
+
+
+# -- registry primitives ------------------------------------------------------
+def test_counter_gauge_basics():
+    reg = metrics.Registry()
+    c = reg.counter("a/b")
+    assert c.incr() == 1.0
+    assert c.incr(2.5) == 3.5
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.incr(-1.0)  # counters are monotonic
+    g = reg.gauge("a/g")
+    g.set(7.0)
+    g.add(-2.0)
+    assert g.value == 5.0
+    assert reg.scalars() == {"a/b": 3.5, "a/g": 5.0}
+
+
+def test_get_or_create_returns_same_object_and_kind_mismatch_raises():
+    reg = metrics.Registry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(ValueError):
+        reg.gauge("x")  # name already registered as a counter
+    with pytest.raises(ValueError):
+        reg.histogram("x")
+
+
+def test_concurrent_increments_preserve_totals():
+    """8 threads x 2000 increments each race on one counter, one gauge and
+    one histogram; no update may be lost."""
+    reg = metrics.Registry()
+    n_threads, n_iter = 8, 2000
+
+    def work():
+        c = reg.counter("hot/counter")
+        g = reg.gauge("hot/gauge")
+        h = reg.histogram("hot/hist")
+        for i in range(n_iter):
+            c.incr()
+            g.add(1.0)
+            h.observe(0.001 * (i % 50))
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * n_iter
+    assert reg.counter("hot/counter").value == total
+    assert reg.gauge("hot/gauge").value == total
+    assert reg.histogram("hot/hist").count == total
+
+
+def test_histogram_percentiles_match_numpy():
+    rng = np.random.default_rng(42)
+    samples = rng.uniform(0.0, 2.0, 20_000)
+    # fine uniform buckets over the support: interpolation error is bounded
+    # by the bucket width
+    buckets = tuple(np.linspace(0.0, 2.0, 41)[1:])  # width 0.05
+    reg = metrics.Registry()
+    h = reg.histogram("lat", buckets=buckets)
+    for s in samples:
+        h.observe(float(s))
+    for q in (50, 95, 99):
+        est = h.percentile(q)
+        ref = float(np.percentile(samples, q))
+        assert abs(est - ref) <= 0.05, (q, est, ref)
+    # interpolated values stay inside the observed range
+    snap = reg.snapshot()["lat"]
+    assert snap["min"] <= h.percentile(50) <= snap["max"]
+    assert snap["count"] == 20_000
+    assert snap["sum"] == pytest.approx(float(samples.sum()), rel=1e-6)
+
+
+def test_histogram_percentile_clamps_to_observed_extremes():
+    reg = metrics.Registry()
+    h = reg.histogram("one", buckets=(1.0, 10.0))
+    h.observe(3.0)
+    assert h.percentile(50) == 3.0  # single sample: every quantile is it
+    assert h.percentile(99) == 3.0
+
+
+def test_snapshot_reset_and_flatten():
+    reg = metrics.Registry()
+    reg.counter("train/steps").incr(5)
+    reg.histogram("train/step").observe(0.2)
+    reg.gauge("serving/depth").set(3)
+    snap = reg.snapshot()
+    assert snap["train/steps"] == {"type": "counter", "value": 5.0}
+    assert snap["train/step"]["type"] == "histogram"
+    flat = metrics.flatten_snapshot(snap)
+    assert flat["train/steps"] == 5.0
+    assert flat["train/step/count"] == 1.0
+    assert flat["train/step/p95"] > 0.0
+    reg.reset("train/")
+    assert set(reg.snapshot()) == {"serving/depth"}
+
+
+# -- the legacy counters shim -------------------------------------------------
+def test_counters_shim_round_trip():
+    counters.reset("shimtest/")
+    counters.incr("shimtest/a")
+    counters.incr("shimtest/a", 2.0)
+    assert counters.value("shimtest/a") == 3.0
+    assert counters.value("shimtest/never") == 0.0
+    snap = counters.snapshot()
+    assert snap["shimtest/a"] == 3.0
+    # shim writes land in the shared default registry
+    assert metrics.default_registry().counter("shimtest/a").value == 3.0
+    counters.reset("shimtest/")
+    assert counters.value("shimtest/a") == 0.0
+
+
+def test_counters_reset_leaves_other_kinds_alone():
+    reg = metrics.default_registry()
+    reg.gauge("shimkeep/gauge").set(1.0)
+    counters.incr("shimkeep/c")
+    counters.reset("shimkeep/")
+    assert counters.value("shimkeep/c") == 0.0
+    assert reg.gauge("shimkeep/gauge").value == 1.0
+    reg.reset("shimkeep/")
+
+
+# -- spans --------------------------------------------------------------------
+def test_span_records_into_histogram_even_on_raise():
+    reg = metrics.Registry()
+    with spans.span("unit/ok", registry=reg):
+        pass
+    with pytest.raises(RuntimeError):
+        with spans.span("unit/ok", registry=reg):
+            raise RuntimeError("boom")
+    h = reg.get("unit/ok")
+    assert h.count == 2
+    spans.record("unit/ext", 1.5, registry=reg)
+    assert reg.get("unit/ext").sum == 1.5
+
+
+# -- Prometheus exposition ----------------------------------------------------
+def test_prom_name_sanitizes():
+    assert prom_name("train/step") == "tfde_train_step"
+    assert prom_name("a-b.c d") == "tfde_a_b_c_d"
+
+
+def test_prometheus_round_trip():
+    reg = metrics.Registry()
+    reg.counter("train/steps").incr(17)
+    reg.gauge("train/steps_per_sec").set(3.25)
+    h = reg.histogram("train/step", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    text = to_prometheus_text(registry=reg)
+    # counters carry the _total convention, histograms the classic triplet
+    assert "tfde_train_steps_total 17.0" in text
+    assert 'tfde_train_step_bucket{le="+Inf"} 4' in text
+    back = parse_prometheus_text(text)
+    assert back["tfde_train_steps_total"]["type"] == "counter"
+    assert back["tfde_train_steps_total"]["value"] == 17.0
+    assert back["tfde_train_steps_per_sec"]["value"] == 3.25
+    hist = back["tfde_train_step"]
+    assert hist["count"] == 4
+    assert hist["sum"] == pytest.approx(6.05)
+    assert dict(hist["buckets"]) == {0.1: 1, 1.0: 3, 10.0: 4}  # cumulative
+
+
+def test_metrics_server_serves_prometheus_and_json():
+    reg = metrics.Registry()
+    reg.counter("srv/hits").incr(3)
+    srv = MetricsServer(port=0, host="127.0.0.1", registry=reg)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            assert r.headers["Content-Type"] == PROM_CONTENT_TYPE
+            body = r.read().decode()
+        assert "tfde_srv_hits_total 3" in body
+        with urllib.request.urlopen(base + "/metrics.json", timeout=5) as r:
+            flat = json.loads(r.read().decode())
+        assert flat["srv/hits"] == 3.0
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=5)
+    finally:
+        srv.close()
+
+
+def test_jsonl_metrics_log(tmp_path):
+    reg = metrics.Registry()
+    reg.counter("j/steps").incr(2)
+    log = JsonlMetricsLog(str(tmp_path), registry=reg)
+    log.write(1)
+    reg.counter("j/steps").incr()
+    log.write(2, extra={"note": 1.0})
+    log.close()
+    lines = [json.loads(l) for l in open(log.path)]
+    assert [l["step"] for l in lines] == [1, 2]
+    assert lines[0]["metrics"]["j/steps"] == 2.0
+    assert lines[1]["metrics"]["j/steps"] == 3.0
+    assert lines[1]["metrics"]["note"] == 1.0

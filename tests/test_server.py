@@ -145,8 +145,8 @@ def test_speculative_batcher_matches_solo(lm, draft, rng):
         np.testing.assert_array_equal(
             done[rid], _solo(model, params, prompt, n), err_msg=f"req {rid}"
         )
-    assert srv.stats["generated"] == sum(n for _, n in reqs.values())
-    assert srv.stats["rounds"] > 0
+    assert srv.stats()["generated"] == sum(n for _, n in reqs.values())
+    assert srv.stats()["rounds"] > 0
 
 
 def test_speculative_batcher_perfect_draft_accelerates(lm, rng):
@@ -163,7 +163,18 @@ def test_speculative_batcher_perfect_draft_accelerates(lm, rng):
     done = dict(srv.run())
     for rid, p in zip(rids, prompts):
         np.testing.assert_array_equal(done[rid], _solo(model, params, p, 12))
-    assert srv.stats["tokens_per_round"] > 2.0, srv.stats
+    assert srv.stats()["tokens_per_round"] > 2.0, srv.stats()
+    # a perfect draft is accepted except where max_new truncation discards
+    # the round's tail, and the stats ride the registry (the /metrics
+    # export path) as serving/speculative/* gauges
+    assert srv.stats()["acceptance_rate"] > 0.8
+    from tfde_tpu.observability import metrics
+
+    reg = metrics.default_registry()
+    assert (reg.get("serving/speculative/acceptance_rate").value
+            == pytest.approx(srv.stats()["acceptance_rate"]))
+    assert (reg.get("serving/speculative/generated").value
+            == srv.stats()["generated"])
 
 
 def test_speculative_batcher_eos_and_staggering(lm, draft, rng):

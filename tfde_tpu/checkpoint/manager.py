@@ -18,6 +18,8 @@ from typing import TYPE_CHECKING, Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from tfde_tpu.observability import metrics
+from tfde_tpu.observability.spans import span
 from tfde_tpu.resilience.policy import RetryPolicy, policy_from_env, retry_call
 
 if TYPE_CHECKING:  # avoid the training<->checkpoint import cycle at runtime
@@ -61,22 +63,26 @@ class CheckpointManager:
         step = int(jax.device_get(state.step))
         if step in (self._mngr.all_steps() or ()):  # already on disk
             return False
-        saved = retry_call(
-            self._mngr.save,
-            step,
-            args=ocp.args.StandardSave(self._tree(state)),
-            force=force,
-            policy=self._retry,
-            what=f"checkpoint save(step={step})",
-            counter="resilience/checkpoint_retries",
-        )
+        with span("checkpoint/save"):
+            saved = retry_call(
+                self._mngr.save,
+                step,
+                args=ocp.args.StandardSave(self._tree(state)),
+                force=force,
+                policy=self._retry,
+                what=f"checkpoint save(step={step})",
+                counter="resilience/checkpoint_retries",
+            )
         if saved:
+            metrics.counter("checkpoint/saves").incr()
+            metrics.gauge("checkpoint/latest_saved_step").set(step)
             log.info("checkpoint saved at step %d -> %s", step, self._dir)
         return saved
 
     def wait(self) -> None:
         """Block until pending async saves commit (call before process exit)."""
-        self._mngr.wait_until_finished()
+        with span("checkpoint/wait"):
+            self._mngr.wait_until_finished()
 
     # -- restore ------------------------------------------------------------
     @property
@@ -102,14 +108,18 @@ class CheckpointManager:
             self._tree(state),
         )
         try:
-            restored = retry_call(
-                self._mngr.restore,
-                step,
-                args=ocp.args.StandardRestore(abstract),
-                policy=self._retry,
-                what=f"checkpoint restore(step={step})",
-                counter="resilience/checkpoint_retries",
-            )
+            # NOTE goodput accounting: restores run inside the train loop's
+            # init span, so "checkpoint/restore" is observability-only and
+            # the ledger's checkpoint category counts save+wait alone
+            with span("checkpoint/restore"):
+                restored = retry_call(
+                    self._mngr.restore,
+                    step,
+                    args=ocp.args.StandardRestore(abstract),
+                    policy=self._retry,
+                    what=f"checkpoint restore(step={step})",
+                    counter="resilience/checkpoint_retries",
+                )
         except ValueError as e:
             # Reword ONLY genuine structure mismatches: compare the saved
             # checkpoint's tree structure (orbax metadata) against the

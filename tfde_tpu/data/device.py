@@ -11,6 +11,7 @@ device step — the overlap that the ≥90 % scaling-efficiency target depends o
 from __future__ import annotations
 
 import collections
+import time
 from typing import Iterable, Iterator, Optional, Tuple
 
 import jax
@@ -56,6 +57,7 @@ def device_prefetch(
     buffer_size: int = 2,
     policy: AutoShardPolicy = AutoShardPolicy.DATA,
     background: bool = False,
+    wait_metric: Optional[str] = None,
 ) -> Iterator:
     """Yield global device arrays, keeping `buffer_size` transfers in flight.
 
@@ -72,7 +74,21 @@ def device_prefetch(
     overlaps the device step even though the consumer never returns to
     Python between steps. Same stream, same order; worker exceptions
     re-raise in the consumer.
+
+    `wait_metric` names an observability histogram (e.g. "train/data_wait")
+    that records the seconds the CONSUMER blocks per `next()` — the host
+    pull + device_put inline, the queue wait in background mode. This is
+    the input-boundness signal goodput accounting classifies as data_wait;
+    None (the default) records nothing.
     """
+    if wait_metric is None:
+        def _rec(dt: float) -> None:
+            pass
+    else:
+        from tfde_tpu.observability import spans
+
+        def _rec(dt: float, _name=wait_metric) -> None:
+            spans.record(_name, dt)
     if spec is None:
         from tfde_tpu.parallel.sharding import batch_spec
 
@@ -123,7 +139,9 @@ def device_prefetch(
         def gen():
             try:
                 while True:
+                    t0 = time.perf_counter()
                     item = q.get()
+                    _rec(time.perf_counter() - t0)
                     if item is _END:
                         return
                     if isinstance(item, _Raise):
@@ -142,8 +160,12 @@ def device_prefetch(
         return gen()
 
     def gen_inline():
+        # time between yields IS the consumer's blocking wait in next():
+        # the priming fill is charged to the first draw, each refill to
+        # the draw it delays
         buf: collections.deque = collections.deque()
         it = iter(batches)
+        t0 = time.perf_counter()
         try:
             while len(buf) < max(1, buffer_size):
                 buf.append(_to_global(next(it), sharding, policy))
@@ -155,7 +177,9 @@ def device_prefetch(
                 buf.append(_to_global(next(it), sharding, policy))
             except StopIteration:
                 pass
+            _rec(time.perf_counter() - t0)
             yield out
+            t0 = time.perf_counter()
 
     return gen_inline()
 

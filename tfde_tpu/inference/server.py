@@ -53,6 +53,8 @@ from tfde_tpu.inference.decode import (
     validate_budget,
 )
 from tfde_tpu.inference.speculative import _set_index_counters
+from tfde_tpu.observability import metrics
+from tfde_tpu.observability.spans import span
 
 
 @functools.partial(jax.jit, static_argnames=("model",), donate_argnums=(1,))
@@ -211,6 +213,8 @@ class ContinuousBatcher:
         self._tok = np.full(batch_size, pad_id, np.int64)
         self._queue: collections.deque = collections.deque()
         self._next_id = 0
+        self._rounds = 0         # decode ticks run
+        self._generated = 0      # every delivered token (incl. prefill 1st)
         # device indices match self._committed only after a rewind; any
         # admission or completion desyncs them until the next tick rewinds
         self._indices_dirty = True
@@ -223,6 +227,33 @@ class ContinuousBatcher:
     @property
     def free_rows(self) -> int:
         return sum(r is None for r in self._req)
+
+    def stats(self) -> dict:
+        """Serving throughput: decode rounds run, tokens delivered, and
+        tokens/round = generated / rounds — effectively the mean occupied
+        rows per tick (each occupied row yields one token; prefill first
+        tokens ride the admitting round's count)."""
+        return {
+            "rounds": self._rounds,
+            "generated": self._generated,
+            "tokens_per_round": self._generated / max(self._rounds, 1),
+        }
+
+    def _publish_stats(self, prefix: str = "serving/batcher") -> None:
+        """Mirror stats() into the metric registry so serving throughput
+        rides the /metrics and JSONL exposition paths."""
+        reg = metrics.default_registry()
+        for k, v in self.stats().items():
+            reg.gauge(f"{prefix}/{k}").set(v)
+        reg.gauge(f"{prefix}/queue_depth").set(len(self._queue))
+        reg.gauge(f"{prefix}/free_rows").set(self.free_rows)
+
+    def serve_metrics(self, port: int = 0):
+        """Start a /metrics endpoint next to this batcher (exposition.py);
+        returns the MetricsServer (read `.port` back when port=0)."""
+        from tfde_tpu.observability.exposition import serve_metrics
+
+        return serve_metrics(port=port)
 
     def submit(self, prompt, max_new_tokens: int) -> int:
         """Queue a request; returns its id. prompt: 1-D int token ids."""
@@ -260,26 +291,30 @@ class ContinuousBatcher:
     def step(self) -> list:
         """Admit into free rows, run one decode tick; returns
         [(request_id, tokens 1-D np.int32), ...] that finished now."""
-        finished = self._admit()
+        with span("serving/admit"):
+            finished = self._admit()
         active = [r for r in range(self._b) if self._req[r] is not None]
         if not active:
+            self._publish_stats()
             return finished
 
-        if self._indices_dirty:
-            # host values, not a shared jnp array: every index leaf gets
-            # its own buffer (the donated-cache aliasing rule). Steady
-            # state (no admissions/completions) skips this: the device
-            # indices advance by exactly 1 per tick, matching _committed.
-            self._cache = _set_index_counters(
-                self._cache, self._committed.astype(np.int32)
+        with span("serving/decode"):
+            if self._indices_dirty:
+                # host values, not a shared jnp array: every index leaf gets
+                # its own buffer (the donated-cache aliasing rule). Steady
+                # state (no admissions/completions) skips this: the device
+                # indices advance by exactly 1 per tick, matching _committed.
+                self._cache = _set_index_counters(
+                    self._cache, self._committed.astype(np.int32)
+                )
+                self._indices_dirty = False
+            self._cache, logits = _decode_tick(
+                self._decode_model, self._cache, self._params,
+                jnp.asarray(self._tok, jnp.int32),
             )
-            self._indices_dirty = False
-        self._cache, logits = _decode_tick(
-            self._decode_model, self._cache, self._params,
-            jnp.asarray(self._tok, jnp.int32),
-        )
-        self._rng, sub = jax.random.split(self._rng)
-        toks = np.asarray(self._sample(logits, sub, seen=self._seen))
+            self._rng, sub = jax.random.split(self._rng)
+            toks = np.asarray(self._sample(logits, sub, seen=self._seen))
+        self._rounds += 1
         if self._seen is not None:
             act = np.asarray(active)
             self._seen = self._seen.at[act, toks[act]].set(True)
@@ -287,6 +322,7 @@ class ContinuousBatcher:
             # feeding tok[r] committed it; the new sample is now pending
             self._committed[r] += 1
             finished.extend(self._take_token(r, int(toks[r])))
+        self._publish_stats()
         return finished
 
     def run(self) -> list:
@@ -302,6 +338,7 @@ class ContinuousBatcher:
         self._out[r].append(t)
         self._budget[r] -= 1
         self._tok[r] = t
+        self._generated += 1
         if self._budget[r] <= 0 or (self._eos is not None and t == self._eos):
             done = (self._req[r], np.asarray(self._out[r], np.int32))
             self._req[r] = None
@@ -329,10 +366,11 @@ class ContinuousBatcher:
                     continue
                 rid, prompt, budget = self._queue.popleft()
                 ids, last = _bucketed(prompt, self._buckets, self._pad)
-                row_cache, logits = _prefill_row(
-                    self._decode_model, self._row_template, self._params,
-                    ids, last,
-                )
+                with span("serving/prefill"):
+                    row_cache, logits = _prefill_row(
+                        self._decode_model, self._row_template, self._params,
+                        ids, last,
+                    )
                 self._cache = _scatter_row(
                     self._cache, row_cache, jnp.int32(r)
                 )
@@ -376,7 +414,8 @@ class SpeculativeContinuousBatcher:
     distributed exactly as target-model sampling at that temperature per
     request, with draw values batch-dependent (rows share the key
     stream). Per-round commits vary between 1 and num_draft+1 tokens per
-    row with draft quality; `stats` reports the realized tokens/round.
+    row with draft quality; `stats()` reports the realized tokens/round
+    and draft acceptance rate.
     """
 
     def __init__(
@@ -438,20 +477,41 @@ class SpeculativeContinuousBatcher:
         self._rounds = 0
         self._generated = 0      # every delivered token (incl. prefill 1st)
         self._round_tokens = 0   # tokens produced by speculative rounds
+        self._draft_proposed = 0  # num_draft per active row per round
+        self._draft_accepted = 0  # committed beyond the guaranteed token
 
     @property
     def idle(self) -> bool:
         return not self._queue and all(r is None for r in self._req)
 
-    @property
     def stats(self) -> dict:
+        """Speculation effectiveness: tokens/round is per ROW per round
+        (1.0 = no draft ever accepted, num_draft+1 = perfect draft);
+        acceptance_rate is the fraction of proposed draft tokens the
+        target committed."""
         return {
             "rounds": self._rounds,
             "generated": self._generated,
             "tokens_per_round": (
                 self._round_tokens / max(self._rounds * self._b, 1)
             ),
+            "acceptance_rate": (
+                self._draft_accepted / max(self._draft_proposed, 1)
+            ),
         }
+
+    def _publish_stats(self, prefix: str = "serving/speculative") -> None:
+        reg = metrics.default_registry()
+        for k, v in self.stats().items():
+            reg.gauge(f"{prefix}/{k}").set(v)
+        reg.gauge(f"{prefix}/queue_depth").set(len(self._queue))
+
+    def serve_metrics(self, port: int = 0):
+        """Start a /metrics endpoint next to this batcher (exposition.py);
+        returns the MetricsServer (read `.port` back when port=0)."""
+        from tfde_tpu.observability.exposition import serve_metrics
+
+        return serve_metrics(port=port)
 
     def submit(self, prompt, max_new_tokens: int) -> int:
         prompt = np.asarray(prompt, np.int64).reshape(-1)
@@ -498,12 +558,13 @@ class SpeculativeContinuousBatcher:
                     continue
                 rid, prompt, budget = self._queue.popleft()
                 ids, last = _bucketed(prompt, self._buckets, self._pad)
-                tgt_row, logits = _prefill_row(
-                    self._tgt, self._tgt_row, self._params, ids, last
-                )
-                drf_row, _ = _prefill_row(
-                    self._drf, self._drf_row, self._dparams, ids, last
-                )
+                with span("serving/prefill"):
+                    tgt_row, logits = _prefill_row(
+                        self._tgt, self._tgt_row, self._params, ids, last
+                    )
+                    drf_row, _ = _prefill_row(
+                        self._drf, self._drf_row, self._dparams, ids, last
+                    )
                 self._tgt_cache = _scatter_row(
                     self._tgt_cache, tgt_row, jnp.int32(r)
                 )
@@ -528,35 +589,38 @@ class SpeculativeContinuousBatcher:
     def step(self) -> list:
         """Admit, then run ONE speculative round for the whole batch;
         returns the requests that finished on it."""
-        finished = self._admit()
+        with span("serving/admit"):
+            finished = self._admit()
         active = [r for r in range(self._b) if self._req[r] is not None]
         if not active:
+            self._publish_stats()
             return finished
         self._rounds += 1
-        # per-round rewind is unconditional: acceptance lengths diverge
-        # every round (host ints/np arrays — own buffer per index leaf,
-        # across BOTH donated caches)
-        committed = self._committed.astype(np.int32)
-        self._tgt_cache = _set_index_counters(self._tgt_cache, committed)
-        self._drf_cache = _set_index_counters(self._drf_cache, committed)
-        if self._temperature > 0.0:
-            self._rng, sub = jax.random.split(self._rng)
-            (self._tgt_cache, self._drf_cache, round_toks, n_new, _pending,
-             _rng_out) = self._round_sampled(
-                self._tgt, self._drf, self._tgt_cache, self._drf_cache,
-                self._params, self._dparams,
-                jnp.asarray(self._tok, jnp.int32), sub, self._nd, self._pad,
-                self._temperature,
-            )
-        else:
-            (self._tgt_cache, self._drf_cache, round_toks, n_new,
-             _pending) = self._round(
-                self._tgt, self._drf, self._tgt_cache, self._drf_cache,
-                self._params, self._dparams,
-                jnp.asarray(self._tok, jnp.int32), self._nd, self._pad,
-            )
-        round_np = np.asarray(round_toks)
-        n_np = np.asarray(n_new)
+        with span("serving/decode"):
+            # per-round rewind is unconditional: acceptance lengths diverge
+            # every round (host ints/np arrays — own buffer per index leaf,
+            # across BOTH donated caches)
+            committed = self._committed.astype(np.int32)
+            self._tgt_cache = _set_index_counters(self._tgt_cache, committed)
+            self._drf_cache = _set_index_counters(self._drf_cache, committed)
+            if self._temperature > 0.0:
+                self._rng, sub = jax.random.split(self._rng)
+                (self._tgt_cache, self._drf_cache, round_toks, n_new,
+                 _pending, _rng_out) = self._round_sampled(
+                    self._tgt, self._drf, self._tgt_cache, self._drf_cache,
+                    self._params, self._dparams,
+                    jnp.asarray(self._tok, jnp.int32), sub, self._nd,
+                    self._pad, self._temperature,
+                )
+            else:
+                (self._tgt_cache, self._drf_cache, round_toks, n_new,
+                 _pending) = self._round(
+                    self._tgt, self._drf, self._tgt_cache, self._drf_cache,
+                    self._params, self._dparams,
+                    jnp.asarray(self._tok, jnp.int32), self._nd, self._pad,
+                )
+            round_np = np.asarray(round_toks)
+            n_np = np.asarray(n_new)
         for r in active:
             toks = round_np[r, : int(n_np[r])].tolist()
             taken = 0
@@ -566,11 +630,18 @@ class SpeculativeContinuousBatcher:
                 self._round_tokens += 1
                 finished.extend(self._take_token(r, int(t)))
                 taken += 1
+            # acceptance bookkeeping: each round proposes num_draft per
+            # active row; a row's commits beyond the guaranteed target
+            # token are accepted draft proposals (capped by num_draft —
+            # the +1'th commit is the bonus token, not a draft)
+            self._draft_proposed += self._nd
+            self._draft_accepted += min(max(taken - 1, 0), self._nd)
             if self._req[r] is not None:
                 # row still active: tok_last + accepted tokens are now in
                 # both caches (the pending one stays unfed) — the
                 # generate_speculative commit bookkeeping
                 self._committed[r] += taken
+        self._publish_stats()
         return finished
 
     def run(self) -> list:

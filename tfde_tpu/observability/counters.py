@@ -1,56 +1,53 @@
-"""Process-wide named counters — the resilience subsystem's export surface.
+"""Process-wide named counters — kept as a thin shim over the metric
+registry (observability/metrics.py).
 
-The reference's runtime surfaced fault-tolerance activity only as log lines;
-at pod scale operators need the numbers (how many restarts, how many retried
-saves, how many steps were replayed after a preemption) as *metrics* they
-can alarm on. This module is the minimal substrate: monotonic named counters
-any subsystem can increment, a snapshot for tests/exporters, and a bridge
-that writes the snapshot as TensorBoard scalars through the existing
-SummaryWriter so the counters land next to the training curves.
-
-Thread-safe by design: the health watchdog and retry wrappers increment from
-background threads while the train loop reads.
+This was the resilience subsystem's original export surface: monotonic
+named counters any subsystem can increment, a snapshot for tests/exporters,
+and a TensorBoard bridge. The registry generalized it (gauges, histograms,
+Prometheus/JSONL exposition), but this module's API is load-bearing across
+resilience/, checkpoint/, utils/fs and their tests, so it stays — every
+call now lands in `metrics.default_registry()`, which means counters
+incremented here show up in `/metrics` and every other exposition path
+for free.
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Dict
 
-_lock = threading.Lock()
-_counters: Dict[str, float] = {}
+from tfde_tpu.observability import metrics
 
 
 def incr(name: str, amount: float = 1.0) -> float:
     """Add `amount` to counter `name` (creating it at 0); returns the new
-    value. Negative amounts are rejected — counters are monotonic; gauges
-    belong in the summary writer directly."""
-    if amount < 0:
-        raise ValueError(f"counter {name!r}: negative increment {amount}")
-    with _lock:
-        _counters[name] = _counters.get(name, 0.0) + amount
-        return _counters[name]
+    value. Negative amounts are rejected — counters are monotonic; use a
+    registry gauge for values that can fall."""
+    return metrics.default_registry().counter(name).incr(amount)
 
 
 def value(name: str) -> float:
-    with _lock:
-        return _counters.get(name, 0.0)
+    m = metrics.default_registry().get(name)
+    return m.value if m is not None and m.kind == "counter" else 0.0
 
 
 def snapshot() -> Dict[str, float]:
-    """Point-in-time copy of every counter."""
-    with _lock:
-        return dict(_counters)
+    """Point-in-time copy of every counter (counters only — gauges and
+    histograms live in the registry's own snapshot())."""
+    reg = metrics.default_registry()
+    return {
+        name: v for name, v in reg.scalars().items()
+        if reg.get(name) is not None and reg.get(name).kind == "counter"
+    }
 
 
 def reset(prefix: str = "") -> None:
-    """Zero counters (those under `prefix`, or all) — test isolation hook."""
-    with _lock:
-        if not prefix:
-            _counters.clear()
-            return
-        for k in [k for k in _counters if k.startswith(prefix)]:
-            del _counters[k]
+    """Drop counters (those under `prefix`, or all) — test isolation hook.
+    Only counters: a prefix-less reset here must not clear the registry's
+    gauges/histograms out from under their owners."""
+    reg = metrics.default_registry()
+    for name in list(snapshot()):
+        if name.startswith(prefix):
+            reg.remove(name)
 
 
 def export_scalars(writer, step: int, prefix: str = "") -> Dict[str, float]:

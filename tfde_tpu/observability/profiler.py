@@ -36,10 +36,14 @@ def profile_trace(
     # start_trace itself appends plugins/profile/<timestamp> — pass the raw
     # logdir so TensorBoard's profile plugin finds the run.
     log.info("profiler trace -> %s/plugins/profile", logdir)
+    from tfde_tpu.observability import spans
+
     jax.profiler.start_trace(logdir)
+    spans.set_trace_active(True)
     try:
         yield
     finally:
+        spans.set_trace_active(False)
         jax.profiler.stop_trace()
 
 
@@ -145,15 +149,26 @@ class StepWindowProfiler:
                 step, self._logdir,
             )
             jax.profiler.start_trace(self._logdir)
+            self._set_spans(True)
             self._active = True
         elif self._active and not in_window:
+            self._set_spans(False)
             jax.profiler.stop_trace()
             self._active = False
             self.windows_traced += 1
             log.info("profiler: trace complete at step %d", step)
 
+    @staticmethod
+    def _set_spans(active: bool) -> None:
+        # spans emit TraceAnnotations only inside a window, so the same
+        # phase names land on the XProf timeline at zero steady-state cost
+        from tfde_tpu.observability import spans
+
+        spans.set_trace_active(active)
+
     def close(self) -> None:
         if self._active:
+            self._set_spans(False)
             jax.profiler.stop_trace()
             self._active = False
             self.windows_traced += 1

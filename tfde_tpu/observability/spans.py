@@ -1,0 +1,72 @@
+"""Lightweight span timers feeding the metric registry.
+
+    with span("train/data_wait"):
+        batch = next(host_iter)
+
+Each span observes its wall duration into `registry.histogram(name)` —
+that is the step-time-breakdown substrate: the train loop wraps its
+phases (data wait, step dispatch, device sync, summary write, checkpoint
+save, eval) and `goodput.py` reads the histogram sums back out to
+classify the run's wall-clock.
+
+When a profiler trace is active (profiler.py's `profile_trace` or
+`StepWindowProfiler` window), every span additionally opens a
+`jax.profiler.TraceAnnotation` region, so the SAME names appear on the
+XProf timeline — one vocabulary across metrics and traces. The
+TraceAnnotation is only constructed while tracing (the
+`set_trace_active` flag, flipped by profiler.py at start/stop), keeping
+the steady-state span cost to a clock read and a locked histogram add.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from tfde_tpu.observability import metrics
+
+_trace_active = False
+
+
+def set_trace_active(active: bool) -> None:
+    """Flipped by profiler.py when a jax.profiler trace starts/stops; spans
+    emit TraceAnnotations only while True."""
+    global _trace_active
+    _trace_active = bool(active)
+
+
+def trace_active() -> bool:
+    return _trace_active
+
+
+@contextlib.contextmanager
+def span(name: str,
+         registry: Optional[metrics.Registry] = None) -> Iterator[None]:
+    """Time the enclosed block into `histogram(name)` (seconds); mirror it
+    as a TraceAnnotation when a profiler trace is running. Duration is
+    recorded even when the block raises — a failing phase still spent the
+    wall-clock."""
+    reg = registry or metrics.default_registry()
+    ann = None
+    if _trace_active:
+        import jax
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        reg.histogram(name).observe(dt)
+
+
+def record(name: str, seconds: float,
+           registry: Optional[metrics.Registry] = None) -> None:
+    """Observe an externally measured duration under a span name — for
+    call sites that already hold a timer (the prefetch generator times its
+    own blocking pulls) and can't wrap a `with` block around the wait."""
+    (registry or metrics.default_registry()).histogram(name).observe(seconds)

@@ -13,9 +13,11 @@ so escalation IS checkpoint-and-exit, riding the exact force-save/commit
 path a pool preemption takes. Under a supervisor that same path becomes
 checkpoint-and-restart.
 
-Counters exported through observability/counters.py:
-- ``resilience/stalls_detected`` — watchdog firings
-- ``resilience/heartbeats``     — total beats (rate ~ steps/sec)
+Metrics exported through the observability registry:
+- ``resilience/stalls_detected``       counter — watchdog firings
+- ``resilience/heartbeats``            counter — total beats (rate ~ steps/sec)
+- ``resilience/last_step``             gauge — step of the latest beat
+- ``resilience/heartbeat_age_seconds`` gauge — staleness at last watchdog poll
 """
 
 from __future__ import annotations
@@ -28,7 +30,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from tfde_tpu.observability import counters
+from tfde_tpu.observability import counters, metrics
 
 log = logging.getLogger(__name__)
 
@@ -78,6 +80,8 @@ class Heartbeat:
     # -- progress ------------------------------------------------------------
     def beat(self, step: Optional[int] = None) -> None:
         counters.incr("resilience/heartbeats")
+        if step is not None:
+            metrics.gauge("resilience/last_step").set(step)
         with self._lock:
             self._last_beat = self.clock()
             if step is not None:
@@ -118,6 +122,7 @@ class Heartbeat:
         def run():
             while not self._stop.wait(poll):
                 a = self.age()
+                metrics.gauge("resilience/heartbeat_age_seconds").set(a)
                 if a > self.stall_timeout_secs:
                     if not self._stalled:
                         self._stalled = True

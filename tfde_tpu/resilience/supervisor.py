@@ -184,18 +184,21 @@ class Supervisor:
         return wrapped
 
     def _export(self, est, step: int) -> None:
-        """Chief-side counter export as TensorBoard scalars next to the
-        run's curves."""
+        """Chief-side metric export as TensorBoard scalars next to the
+        run's curves — the resilience counters plus the run-level goodput
+        gauges the supervisor's ledger published."""
         try:
             model_dir = est.config.model_dir
             if model_dir is None or not est._is_chief:
                 return
+            from tfde_tpu.observability import exposition
             from tfde_tpu.observability.tensorboard import SummaryWriter
             from tfde_tpu.utils import fs
 
             w = SummaryWriter(fs.join(model_dir, "resilience"))
             try:
-                counters.export_scalars(w, step, prefix="resilience/")
+                exposition.export_to_tensorboard(w, step, prefix="resilience/")
+                exposition.export_to_tensorboard(w, step, prefix="goodput/")
             finally:
                 w.close()
         except Exception:
@@ -209,6 +212,11 @@ class Supervisor:
         cfg = self.config
         no_progress = 0
         committed_before: Optional[int] = None
+        # run-level ledger: spans EVERY attempt, so restart backoff and
+        # replayed steps show up as restart_loss in one goodput fraction
+        from tfde_tpu.observability.goodput import GoodputLedger
+
+        ledger = GoodputLedger()
 
         while True:
             est = self.factory()
@@ -223,6 +231,13 @@ class Supervisor:
                     fn = self._beat_input_fn(input_fn, heartbeat, start_committed)
                     heartbeat.start_watchdog()
                 state = est.train(fn, max_steps, **train_kwargs)
+                rep = ledger.export()
+                log.info(
+                    "supervised run complete: goodput %.3f over %.1fs "
+                    "(%d restarts, %.0f lost steps)",
+                    rep["goodput"], rep["wall_seconds"],
+                    self.restarts, rep["lost_steps"],
+                )
                 self._export(est, max_steps)
                 return state
             except KeyboardInterrupt:
@@ -273,6 +288,9 @@ class Supervisor:
                 self.restarts += 1
                 counters.incr("resilience/restarts")
                 delay = cfg.restart_policy.backoff(self.restarts, self._rng)
+                # backoff sleep is pure restart tax — the goodput ledger
+                # reads this back as part of restart_loss
+                counters.incr("resilience/restart_backoff_seconds", delay)
                 log.warning(
                     "%s failure (%s: %s); restart %d/%d from committed step "
                     "%s in %.2fs",

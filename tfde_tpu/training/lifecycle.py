@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
@@ -35,7 +36,10 @@ from tfde_tpu.checkpoint.manager import CheckpointManager
 from tfde_tpu.data.device import device_prefetch
 from tfde_tpu.resilience.preemption import PreemptionGuard as _PreemptionGuard
 from tfde_tpu.data.pipeline import AutoShardPolicy
+from tfde_tpu.observability import exposition, metrics
+from tfde_tpu.observability.goodput import GoodputLedger
 from tfde_tpu.observability.profiler import StepWindowProfiler
+from tfde_tpu.observability.spans import record, span
 from tfde_tpu.observability.tensorboard import SummaryWriter
 from tfde_tpu.parallel.strategies import Strategy, MultiWorkerMirroredStrategy
 from tfde_tpu.training.step import (
@@ -78,6 +82,10 @@ class RunConfig:
     # ProfilerHook(save_steps=100) did. None defers to $TFDE_PROFILE.
     profile_steps: Any = None
     seed: int = 0
+    # Chief-only HTTP /metrics endpoint (observability/exposition.py):
+    # 0 binds an ephemeral port (read estimator.metrics_server.port back),
+    # None defers to $TFDE_METRICS_PORT (unset = no server).
+    metrics_port: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -164,6 +172,8 @@ class Estimator:
         self._eval_step = None
         self._predict_fn = None
         self._writers: dict[str, SummaryWriter] = {}
+        self._metrics_srv: Optional[exposition.MetricsServer] = None
+        self._metrics_log: Optional[exposition.JsonlMetricsLog] = None
 
     # -- internals -----------------------------------------------------------
     @property
@@ -179,6 +189,30 @@ class Estimator:
                 logdir = f"{logdir}/{name}"
             self._writers[name] = SummaryWriter(logdir)
         return self._writers[name]
+
+    @property
+    def metrics_server(self) -> Optional[exposition.MetricsServer]:
+        """The live /metrics endpoint, if one was configured and started."""
+        return self._metrics_srv
+
+    def _ensure_metrics_server(self) -> Optional[exposition.MetricsServer]:
+        if self._metrics_srv is not None or not self._is_chief:
+            return self._metrics_srv
+        port = self.config.metrics_port
+        if port is None:
+            env = os.environ.get("TFDE_METRICS_PORT", "")
+            port = int(env) if env else None
+        if port is not None:
+            self._metrics_srv = exposition.MetricsServer(port=port)
+        return self._metrics_srv
+
+    def _ensure_metrics_log(self) -> Optional[exposition.JsonlMetricsLog]:
+        """Chief-only JSONL snapshot log under <model_dir>/metrics/."""
+        if self.config.model_dir is None or not self._is_chief:
+            return None
+        if self._metrics_log is None:
+            self._metrics_log = exposition.JsonlMetricsLog(self.config.model_dir)
+        return self._metrics_log
 
     def _ckpt_mngr(self) -> Optional[CheckpointManager]:
         if self.config.model_dir is None or not self.config.save_checkpoints_steps:
@@ -298,81 +332,156 @@ class Estimator:
         max_steps is absolute, so a resumed run does only the remainder —
         matching Estimator's behavior with mnist_keras:262)."""
         cfg = self.config
-        host_iter = iter(input_fn())
-        first = next(host_iter)
-        state = self._ensure_state(first)
-        start_step = int(jax.device_get(state.step))
-        if start_step >= max_steps:
-            log.info("global step %d >= max_steps %d; nothing to do", start_step, max_steps)
-            return state
-        if self._train_step is None:
-            if self.lora is not None:
-                from tfde_tpu.training.lora import make_lora_loss
-                from tfde_tpu.training.step import _classification_loss
+        ledger = GoodputLedger()  # baseline first: init counts toward wall
+        self._ensure_metrics_server()
+        with span("train/init"):
+            host_iter = iter(input_fn())
+            first = next(host_iter)
+            state = self._ensure_state(first)
+            start_step = int(jax.device_get(state.step))
+            if start_step >= max_steps:
+                log.info("global step %d >= max_steps %d; nothing to do",
+                         start_step, max_steps)
+                return state
+            if self._train_step is None:
+                if self.lora is not None:
+                    from tfde_tpu.training.lora import make_lora_loss
+                    from tfde_tpu.training.step import _classification_loss
 
-                self._train_step = make_custom_train_step(
-                    self.strategy, state,
-                    make_lora_loss(self._lora_base,
-                                   self.loss_fn or _classification_loss,
-                                   self.lora),
-                    grad_accum=self.grad_accum,
-                )
-            elif self.loss_fn is not None:
-                self._train_step = make_custom_train_step(
-                    self.strategy, state, self.loss_fn,
-                    grad_accum=self.grad_accum,
-                )
-            else:
-                self._train_step = make_train_step(
-                    self.strategy, state, grad_accum=self.grad_accum
-                )
+                    self._train_step = make_custom_train_step(
+                        self.strategy, state,
+                        make_lora_loss(self._lora_base,
+                                       self.loss_fn or _classification_loss,
+                                       self.lora),
+                        grad_accum=self.grad_accum,
+                    )
+                elif self.loss_fn is not None:
+                    self._train_step = make_custom_train_step(
+                        self.strategy, state, self.loss_fn,
+                        grad_accum=self.grad_accum,
+                    )
+                else:
+                    self._train_step = make_train_step(
+                        self.strategy, state, grad_accum=self.grad_accum
+                    )
 
         rng = jax.random.key(cfg.seed + 1)
-        writer = self._writer()
-        mngr = self._ckpt_mngr()
-        profiler = (
-            StepWindowProfiler(cfg.model_dir, cfg.profile_steps)
-            if self._is_chief
-            else StepWindowProfiler(None, None)
-        )
+        with span("train/init"):  # second init chunk: writers/manager/feed
+            writer = self._writer()
+            mngr = self._ckpt_mngr()
+            profiler = (
+                StepWindowProfiler(cfg.model_dir, cfg.profile_steps)
+                if self._is_chief
+                else StepWindowProfiler(None, None)
+            )
 
-        def batches():
-            yield first
-            yield from host_iter
+            def batches():
+                yield first
+                yield from host_iter
 
-        feed = device_prefetch(batches(), self.strategy.mesh, policy=shard_policy)
+            feed = device_prefetch(batches(), self.strategy.mesh,
+                                   policy=shard_policy,
+                                   wait_metric="train/data_wait")
+            mlog = self._ensure_metrics_log()
+            ops_writer = self._writer("ops") if writer is not None else None
         last_metrics = None
-        t_window = time.time()
+        compiled = False  # first step = trace+compile+execute, timed apart
+        t_window = time.perf_counter()
+        window_step = start_step  # steps/sec windows span actual steps run
+        excluded = 0.0  # summary-sync/eval seconds carved out of the window
         step = start_step
         guard = _PreemptionGuard()
         with guard:
             for batch in feed:
                 if step >= max_steps or guard.fired is not None:
                     break
-                state, last_metrics = self._train_step(state, batch, rng)
+                # step time is measured start-to-start: the whole iteration
+                # minus the separately-categorized chunks (compile, device
+                # sync, summary write, checkpoint, eval). Wrapping only the
+                # dispatch call undercounts badly — under async dispatch the
+                # device drains during host bookkeeping between statements,
+                # and on a CPU mesh the compute threads starve the host
+                # thread so the cost smears across the whole loop body.
+                # Iteration coverage keeps the goodput ledger's intervals
+                # disjoint (data waits happen between iterations, inside the
+                # feed) and makes the breakdown sum to loop wall-clock.
+                t_iter = time.perf_counter()
+                iter_overhead = 0.0  # categorized seconds inside this iter
+                if not compiled:
+                    # the first call traces+compiles synchronously and the
+                    # block drains its execution: the whole cost lands in
+                    # compile_seconds, NOT in the train/step histogram or
+                    # the first steps/sec window (both were poisoned by it
+                    # before)
+                    t0 = time.perf_counter()
+                    state, last_metrics = self._train_step(state, batch, rng)
+                    jax.block_until_ready(last_metrics)
+                    compile_s = time.perf_counter() - t0
+                    iter_overhead += compile_s
+                    compiled = True
+                    metrics.counter("train/compile_seconds").incr(compile_s)
+                    log.info("first step (compile): %.2fs", compile_s)
+                    if writer is not None:
+                        writer.scalars(step + 1,
+                                       {"compile_seconds": compile_s})
+                else:
+                    with span("train/dispatch"):
+                        state, last_metrics = self._train_step(
+                            state, batch, rng)
                 # keep the live reference fresh: the previous state's
                 # buffers were donated to the step, so a stale self._state
                 # would reference deleted arrays if train() is interrupted
                 # mid-run
                 self._state = state
                 step += 1
+                if step - start_step == 1:
+                    # first-step wall excluded from the steps/sec window
+                    t_window = time.perf_counter()
+                    window_step = step
                 profiler.step(step)
                 if writer is not None and step % cfg.save_summary_steps == 0:
-                    vals = {k: float(jax.device_get(v))
-                            for k, v in last_metrics.items()}
-                    writer.scalars(step, vals)
-                if step % cfg.log_step_count_steps == 0:
-                    dt = time.time() - t_window
-                    sps = (cfg.log_step_count_steps / dt if dt > 0
-                           else float("inf"))
+                    t_sync = time.perf_counter()
+                    with span("train/device_sync"):
+                        # blocks until the device queue drains — under
+                        # async dispatch this is where compute time is paid
+                        vals = {k: float(jax.device_get(v))
+                                for k, v in last_metrics.items()}
+                    with span("train/summary_write"):
+                        writer.scalars(step, vals)
+                        if mlog is not None:
+                            mlog.write(step)
+                        if ops_writer is not None:
+                            exposition.export_to_tensorboard(ops_writer, step)
+                    dt_sync = time.perf_counter() - t_sync
+                    excluded += dt_sync
+                    iter_overhead += dt_sync
+                if step % cfg.log_step_count_steps == 0 and step > window_step:
+                    # honest steady-state rate: the window covers exactly
+                    # (step - window_step) steps and the summary/eval wall
+                    # carved out above is attributed, not averaged in
+                    dt = time.perf_counter() - t_window - excluded
+                    n = step - window_step
+                    sps = n / dt if dt > 0 else float("inf")
+                    metrics.gauge("train/steps_per_sec").set(sps)
                     if writer is not None:
                         writer.scalars(step, {"global_step/sec": sps})
                     log.info("step %d: %.2f steps/sec", step, sps)
-                    t_window = time.time()
+                    t_window = time.perf_counter()
+                    window_step = step
+                    excluded = 0.0
                 if mngr is not None and step % cfg.save_checkpoints_steps == 0:
-                    mngr.save(state)
+                    t_ck = time.perf_counter()
+                    mngr.save(state)  # records its own checkpoint/save span
+                    iter_overhead += time.perf_counter() - t_ck
                 if _eval_hook is not None:
-                    _eval_hook(state, step)
+                    t_eval = time.perf_counter()
+                    with span("train/eval"):
+                        _eval_hook(state, step)
+                    dt_eval = time.perf_counter() - t_eval
+                    excluded += dt_eval
+                    iter_overhead += dt_eval
+                record("train/step",
+                       max(0.0, time.perf_counter() - t_iter - iter_overhead))
 
             self._state = state
             profiler.close()
@@ -382,6 +491,21 @@ class Estimator:
                 # current step before the signal is re-raised below
                 mngr.save(state, force=True)
                 mngr.wait()
+            # goodput/* gauges reflect this train() call's wall-clock;
+            # export before the final snapshot writes so they ride along
+            rep = ledger.export()
+            log.info(
+                "goodput %.3f over %.1fs (%d steps; compile %.2fs, "
+                "data-wait %.1f%%)",
+                rep["goodput"], rep["wall_seconds"], rep["steps"],
+                rep["seconds"]["compile"],
+                100.0 * rep["fractions"]["data_wait"],
+            )
+            if mlog is not None:
+                mlog.write(step)
+                mlog.flush()
+            if ops_writer is not None:
+                exposition.export_to_tensorboard(ops_writer, step)
             if writer is not None:
                 writer.flush()
         guard.reraise_if_fired(step if mngr is not None else None)
@@ -450,12 +574,14 @@ class Estimator:
                     yield b
 
             feed = device_prefetch(
-                _checked(iter(input_fn()), strat.batch_divisor), strat.mesh
+                _checked(iter(input_fn()), strat.batch_divisor), strat.mesh,
+                wait_metric="eval/data_wait",
             )
         else:
             divisor = strat.batch_divisor
             padded = (pad_batch_for_mesh(b, divisor) for b in input_fn())
-            feed = device_prefetch(padded, strat.mesh)
+            feed = device_prefetch(padded, strat.mesh,
+                                   wait_metric="eval/data_wait")
         for batch in feed:
             if steps is not None and n >= steps:
                 break
@@ -583,6 +709,12 @@ class Estimator:
             self._ckpt.close()
         for w in self._writers.values():
             w.close()
+        if self._metrics_log is not None:
+            self._metrics_log.close()
+            self._metrics_log = None
+        if self._metrics_srv is not None:
+            self._metrics_srv.close()
+            self._metrics_srv = None
 
 
 def continuous_eval(
