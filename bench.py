@@ -994,38 +994,52 @@ def _bench_serve(clock: _Clock, smoke: bool) -> dict:
     stream of mixed-length requests through a fixed decode batch, rows
     re-used mid-flight. Complements `decode_*` (steady one-shot batch):
     this measures the throughput of the loop a server actually runs —
-    admission prefills + per-row index rewinds included."""
+    admission prefills, the fused K-tick decode scan, and the per-step
+    host sync included. Alongside the raw rate it reports the HOST
+    OVERHEAD the device-resident loop exists to eliminate: an in-config
+    greedy `generate` run (same model, same batch, one XLA program, zero
+    scheduling) is the device ceiling, and `serve_host_overhead` = 1 −
+    serve/decode throughput is the fraction of that ceiling the serving
+    loop still spends on the host (the 97× gap of BENCH_r05 was this
+    number at ~0.99). Latency rides the serving histograms: TTFT
+    (submit → first token at admission) and per-output-token latency."""
     import time as _time
 
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from tfde_tpu.inference.decode import generate
     from tfde_tpu.inference.server import ContinuousBatcher
+    from tfde_tpu.observability import metrics as _metrics
     from tfde_tpu.models.gpt import GPT, GPT2Small
 
     if smoke:
-        batch, new, n_req, max_len = 2, 6, 4, 48
+        batch, new, n_req, max_len, depth = 2, 6, 4, 48, 4
         model = GPT(vocab_size=512, hidden_size=64, depth=2, num_heads=2,
                     mlp_dim=128, max_position=64, dtype=jnp.float32)
     else:
-        batch, new, n_req, max_len = 8, 96, 24, 256
+        batch, new, n_req, max_len, depth = 8, 96, 24, 256, 8
         model = GPT2Small(max_position=256, dropout_rate=0.0)
     params = model.init(
         jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
     rng = np.random.default_rng(0)
-    # warm the tick/prefill compiles outside the timed window (two prompt
-    # lengths cover the bucket set below)
+    # warm the scan/prefill compiles outside the timed window (two prompt
+    # lengths cover the bucket set below; the warm run drains through the
+    # same adaptive-depth ladder the timed run will use)
     warm = ContinuousBatcher(model, params, batch_size=batch,
-                             max_len=max_len)
-    for plen in (16, 32) if not smoke else (4, 8):
-        warm.submit(rng.integers(0, model.vocab_size, plen), 2)
+                             max_len=max_len, scan_depth=depth)
+    lens = (16, 32) if not smoke else (4, 8)
+    for i in range(2 * batch):
+        warm.submit(rng.integers(0, model.vocab_size, lens[i % len(lens)]),
+                    new)
     warm.run()
 
     srv = ContinuousBatcher(model, params, batch_size=batch,
-                            max_len=max_len)
-    lens = (16, 32) if not smoke else (4, 8)
+                            max_len=max_len, scan_depth=depth)
+    reg = _metrics.default_registry()
+    reg.reset("serving/")  # drop the warm run's TTFT/latency samples
     for i in range(n_req):
         srv.submit(
             rng.integers(0, model.vocab_size, lens[i % len(lens)]), new
@@ -1034,14 +1048,58 @@ def _bench_serve(clock: _Clock, smoke: bool) -> dict:
     done = srv.run()
     total = sum(len(t) for _, t in done)
     # the loop's own host round-trips are part of what's measured; the
-    # final host sync is implicit in run()'s per-step np.asarray fetches
+    # final host sync is implicit in run()'s per-step bundled fetch
     dt = _time.perf_counter() - t0
-    return {
-        "serve_tokens_per_sec": round(total / max(dt, 1e-9), 1),
+    stats = srv.stats()
+    serve_tps = total / max(dt, 1e-9)
+    out = {
+        "serve_tokens_per_sec": round(serve_tps, 1),
         "serve_requests": len(done),
         "serve_batch": batch,
         "serve_total_tokens": int(total),
+        "serve_scan_depth": depth,
+        "serve_ms_per_token": round(dt * 1e3 / max(total, 1), 3),
+        # host cost per generated token — the O(1/K) bound the fused scan
+        # buys (the old loop paid >= 3); admission waves included
+        "serve_dispatches_per_token": round(
+            stats["dispatches_per_token"], 3
+        ),
+        "serve_syncs_per_token": round(stats["syncs_per_token"], 3),
     }
+    ttft = reg.get("serving/ttft_ms")
+    if ttft is not None and ttft.count:
+        out["serve_ttft_ms"] = round(ttft.percentile(50), 2)
+        out["serve_ttft_p95_ms"] = round(ttft.percentile(95), 2)
+
+    # device ceiling: the same model generating the same per-request
+    # budget as ONE program (prompt = the stream's shorter bucket) — what
+    # the chip does with the host fully out of the loop
+    prompt = jnp.asarray(
+        rng.integers(0, model.vocab_size, (batch, lens[0])), jnp.int32
+    )
+
+    def run(reps):
+        toks = None
+        for _ in range(reps):
+            toks, _ = generate(model, params, prompt, max_new_tokens=new)
+        return toks
+
+    clock.fetch_scalar(run(1)[0, -1].astype(jnp.float32))  # compile+warm
+    reps, window, _, _ = clock.timed(
+        run, lambda t: t[0, -1].astype(jnp.float32),
+        0.05 if smoke else 1.0, start_reps=1, max_reps=100,
+    )
+    decode_tps = batch * new / (window / reps)
+    out["serve_decode_ceiling_tokens_per_sec"] = round(decode_tps, 1)
+    # fraction of the device ceiling still lost to the serving loop's
+    # host work (0 = fully device-resident; admission makes a small
+    # irreducible floor). Negative means serving BEAT the one-shot
+    # program (possible: continuous batching refills rows the one-shot
+    # batch leaves padding) — report 0, not a nonsense negative.
+    out["serve_host_overhead"] = round(
+        max(0.0, 1.0 - serve_tps / max(decode_tps, 1e-9)), 4
+    )
+    return out
 
 
 def _bench_decode(clock: _Clock, smoke: bool) -> dict:

@@ -247,9 +247,14 @@ def generate(
         if penalize else None
     )
 
+    greedy = temperature == 0.0
+
     # prefill: the prompt in one fixed-shape forward
     cache, last_logits = model_step(cache, prompt)
-    rng, sub = jax.random.split(rng)
+    if greedy:
+        sub = rng  # argmax path: sample_logits never reads the key
+    else:
+        rng, sub = jax.random.split(rng)
     tok = sample(last_logits, sub, seen=seen)
     if penalize:
         seen = seen.at[jnp.arange(b), tok].set(True)
@@ -260,7 +265,10 @@ def generate(
     def step(carry, _):
         cache, tok, rng, done, seen = carry
         cache, logits = model_step(cache, tok[:, None])
-        rng, sub = jax.random.split(rng)
+        if greedy:
+            sub = rng  # greedy: skip the per-token key split on device
+        else:
+            rng, sub = jax.random.split(rng)
         nxt = sample(logits, sub, seen=seen)
         if eos_id is not None:
             nxt = jnp.where(done, pad_id, nxt)
@@ -383,10 +391,15 @@ def _generate_ragged(model, params, prompt, prompt_lengths, max_new_tokens,
     )
     cache, logits = model_step(cache, prompt[:, :prefill_len])
 
+    greedy = temperature == 0.0
+
     def fill_slot(t, logits, rng, gen_count, done, seq):
         """Sample slot t's token (prompt token while inside the prompt,
         sampled continuation after) and write it into seq."""
-        rng, sub = jax.random.split(rng)
+        if greedy:
+            sub = rng  # greedy: skip the per-slot key split on device
+        else:
+            rng, sub = jax.random.split(rng)
         sampled = sample(logits, sub)
         in_prompt = t < prompt_lengths  # [B]
         can_gen = (~in_prompt) & (~done) & (gen_count < max_new_tokens)

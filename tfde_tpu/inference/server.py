@@ -14,32 +14,54 @@ vector branch + per-row `position_index`) already lets every batch row
 sit at a DIFFERENT sequence position with its own validity horizon.
 Admission is then per-row cache surgery:
 
-- one compiled DECODE tick serves the whole batch ([B, 1] tokens,
-  per-row [B] cache indices — stale K/V beyond a row's index is
-  unreachable, so re-using a slot needs no cache clearing);
-- one compiled PREFILL per distinct prompt length runs the new request
-  on a single-row cache, whose K/V leaves are scattered into the big
-  cache at the freed row (`.at[row].set`), and whose last-position
-  logits seed the row's first token immediately;
-- sampling, EOS, and budget bookkeeping are per-row host state.
+- one compiled DECODE SCAN serves the whole batch for K ticks: the model
+  forward, the sampler (temperature/top-k/top-p/min-p/repetition
+  penalty, `seen`-mask update included), per-row EOS/budget masking and
+  index bookkeeping all live inside ONE jitted `lax.scan`, so the host
+  pays one dispatch and one sync per K tokens per row instead of three
+  or more per token (the 97x serve-vs-decode gap BENCH_r05 measured was
+  exactly this host overhead);
+- finished rows freeze mid-scan: they feed `pad_id`, their index stops
+  advancing, and their sampled output is masked — on-device, no host
+  round-trip (a frozen row's final pad writes land beyond its committed
+  count and stay unreachable, the stale-K/V invariant);
+- one compiled PREFILL per distinct prompt BUCKET admits every freed row
+  of that bucket at once ([R, Pbucket] prompts, first tokens sampled
+  inside the same program), and one multi-row cache scatter lands all of
+  them (`.at[rows].set`) — admission cost amortizes over the wave
+  instead of paying a prefill + scatter round-trip per row;
+- EOS, budget, and queue bookkeeping are per-row host state, replayed
+  from the scan's [B, K] token/emitted output after the single fetch.
 
 Greedy determinism: each request's output equals a solo
 `generate(model, params, prompt)` run token for token regardless of what
-shares the batch (tests/test_server.py asserts it across staggered
-admissions). Temperature>0 draws ride a shared key stream —
-distributionally correct per request, draw values batch-dependent.
+shares the batch or the scan depth K (rows are independent through
+attention's per-row validity masks; tests/test_server.py asserts it
+across staggered admissions and scan depths). Temperature>0 draws ride a
+shared key stream — distributionally correct per request, draw values
+batch-dependent.
+
+Scan-depth adaptation: `scan_depth` is the K ceiling. When the queue is
+non-empty K drops toward the soonest row completion (host-known budget;
+EOS is not host-predictable) so a freed row admits without waiting out a
+long scan; when the queue is empty K is capped by the longest remaining
+budget so a draining batch never runs dead ticks. K is chosen from the
+power-of-two ladder {1, 2, 4, ..., scan_depth} to bound compile count at
+O(log scan_depth).
 
 Prompt-length compiles: prompts are right-padded to the smallest of
 `prompt_buckets` that fits (powers of two up to max_len by default), so
-the prefill compiles once per BUCKET, not per length — the first-token
-logits are read at the true prompt's last position, and the pre-tick
-index rewind makes the pad K/V unreachable.
+the prefill compiles once per BUCKET (x the power-of-two wave-size
+ladder), not per length — the first-token logits are read at each row's
+true last position, and the admission-time index rewind makes the pad
+K/V unreachable.
 """
 
 from __future__ import annotations
 
 import collections
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -57,33 +79,152 @@ from tfde_tpu.observability import metrics
 from tfde_tpu.observability.spans import span
 
 
-@functools.partial(jax.jit, static_argnames=("model",), donate_argnums=(1,))
-def _decode_tick(model, cache, params, toks):
-    """One decode step for the whole batch: [B] tokens in, fp32 [B, V]
-    last-position logits out. Per-row cache indices advance by 1."""
+def _fetch(tree):
+    """THE host sync: one blocking device->host fetch for everything the
+    host loop needs this round. Kept as a module-level seam so tests can
+    count syncs (tests/test_server.py's dispatch-budget regression guard)
+    and so no call site is tempted to sprinkle per-array np.asarray
+    fetches back onto the hot path."""
+    return jax.device_get(tree)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "depth", "temperature", "top_k", "top_p",
+                     "min_p", "repetition_penalty", "eos_id", "pad_id"),
+    donate_argnums=(1, 3, 4, 5, 6, 7),
+)
+def _decode_scan(model, cache, params, tok, idx, budget, done, seen, rng,
+                 depth, temperature, top_k, top_p, min_p,
+                 repetition_penalty, eos_id, pad_id):
+    """K = `depth` fused decode ticks for the whole batch, device-resident.
+
+    Carry per row r: `tok[r]` the pending (sampled, unfed) token, `idx[r]`
+    the committed token count (cache index), `budget[r]` remaining output
+    tokens, `done[r]` frozen flag, plus the optional [B, V] `seen`
+    presence mask and the sampling key. Each tick feeds the pending
+    token, samples the next one with the FULL sampling config in-program
+    (no separate sample_logits dispatch, no host `.at[]` seen update),
+    and applies EOS/budget masking on device: a finishing row emits its
+    last token, flips `done`, and thereafter feeds `pad_id` with a frozen
+    index (its pad K/V lands beyond the committed count — unreachable).
+
+    Returns (cache, tok, idx, budget, done, seen, rng, toks [B, K],
+    emitted [B, K]): `toks[r]` masked to `pad_id` where not emitted;
+    `emitted[r]` is a True-prefix per row (rows freeze monotonically), so
+    the host replays exactly `emitted[r].sum()` tokens into its
+    bookkeeping after the ONE fetch.
+
+    The greedy path (temperature == 0.0) carries `rng=None` and performs
+    no `jax.random.split` at all — dead device work the per-tick loop
+    used to pay on every step.
+    """
+
+    def body(carry, _):
+        cache, tok, idx, budget, done, seen, rng = carry
+        # index surgery each tick instead of trusting the model's own
+        # advance: frozen rows must NOT advance, and writing the [B]
+        # vector here keeps the carry shape stable from tick one
+        cache = _set_index_counters(cache, idx)
+        feed = jnp.where(done, jnp.int32(pad_id), tok)
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, feed[:, None], train=False,
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        logits = logits[:, -1].astype(jnp.float32)
+        if temperature != 0.0:
+            rng, sub = jax.random.split(rng)
+        else:
+            sub = rng  # greedy: sample_logits is argmax, rng untouched
+        nxt = sample_logits(
+            logits, sub, temperature=temperature, top_k=top_k, top_p=top_p,
+            min_p=min_p, repetition_penalty=repetition_penalty, seen=seen,
+        )
+        live = ~done
+        nxt = jnp.where(done, jnp.int32(pad_id), nxt)
+        if seen is not None:
+            ar = jnp.arange(nxt.shape[0])
+            seen = jnp.where(done[:, None], seen,
+                             seen.at[ar, nxt].set(True))
+        # feeding tok committed it; the new sample is now pending
+        idx = idx + live.astype(jnp.int32)
+        budget = budget - live.astype(jnp.int32)
+        fin = budget <= 0
+        if eos_id is not None:
+            fin = fin | (nxt == eos_id)
+        done = done | (live & fin)
+        tok = jnp.where(live, nxt, tok)
+        return (cache, tok, idx, budget, done, seen, rng), (nxt, live)
+
+    carry = (cache, tok, idx, budget, done, seen, rng)
+    carry, (toks, emitted) = jax.lax.scan(body, carry, length=depth)
+    cache, tok, idx, budget, done, seen, rng = carry
+    return (cache, tok, idx, budget, done, seen, rng,
+            jnp.moveaxis(toks, 0, 1), jnp.moveaxis(emitted, 0, 1))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "temperature", "top_k", "top_p", "min_p",
+                     "repetition_penalty"),
+)
+def _prefill_rows(model, row_cache, params, prompts, last, valid, rng,
+                  temperature, top_k, top_p, min_p, repetition_penalty):
+    """Prefill R rows of one bucket in ONE call and sample each row's
+    first token inside the same program.
+
+    prompts: [R, Pbucket] right-padded prompt batch; `last` [R] the true
+    last position per row (so bucketing never changes the first sampled
+    token); `valid` [R, Pbucket] marks real (non-pad) prompt positions —
+    only consulted when the repetition penalty is on, where it keeps pad
+    slots out of the presence mask. Compiled per (bucket length, wave
+    size); the admission ladder pads the wave to a power of two by
+    REPEATING a real row (identical content, so the duplicate scatter
+    writes are idempotent) to bound compile count.
+
+    Returns (filled row cache, first tokens [R], seen rows [R, V] or
+    None). Pad correctness rides the per-row index machinery: pad K/V
+    lands beyond each row's committed count once the admission rewind
+    sets it to the TRUE prompt length."""
     logits, mutated = model.apply(
-        {"params": params, "cache": cache}, toks[:, None], train=False,
+        {"params": params, "cache": row_cache}, prompts, train=False,
         mutable=["cache"],
     )
-    return mutated["cache"], logits[:, -1].astype(jnp.float32)
-
-
-@functools.partial(jax.jit, static_argnames=("model",))
-def _prefill_row(model, row_cache, params, prompt, last):
-    """Prefill a single-row cache with a [1, Pbucket] (possibly right-
-    padded) prompt; returns the filled cache and fp32 [1, V] logits at
-    position `last` — the true prompt's final position, so bucketing
-    never changes the first sampled token. Compiled per BUCKET length.
-
-    Pad correctness rides the per-row index machinery: the pad tokens'
-    K/V land beyond the row's committed count, which the pre-tick rewind
-    sets to the TRUE prompt length — stale entries are unreachable, the
-    same invariant speculative rewinds rely on."""
-    logits, mutated = model.apply(
-        {"params": params, "cache": row_cache}, prompt, train=False,
-        mutable=["cache"],
+    r = prompts.shape[0]
+    ar = jnp.arange(r)
+    logits = logits[ar, last].astype(jnp.float32)
+    row_seen = None
+    if repetition_penalty != 1.0:
+        hits = jnp.zeros((r, model.vocab_size), jnp.int32)
+        hits = hits.at[ar[:, None], prompts].add(valid.astype(jnp.int32))
+        row_seen = hits > 0
+    tok = sample_logits(
+        logits, rng, temperature=temperature, top_k=top_k, top_p=top_p,
+        min_p=min_p, repetition_penalty=repetition_penalty, seen=row_seen,
     )
-    return mutated["cache"], logits[:, last].astype(jnp.float32)
+    if row_seen is not None:
+        row_seen = row_seen.at[ar, tok].set(True)
+    return mutated["cache"], tok, row_seen
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(cache, rows_cache, rows):
+    """Write an R-row prefill cache's K/V leaves into batch rows `rows`
+    ([R] int32) in ONE donated update — the multi-row generalization of
+    the old per-row `.at[row].set` round-trip. Index counters pass
+    through (the decode scan rewrites them from the host's committed
+    counts every tick). Wave padding duplicates a real row verbatim, so
+    duplicate indices in `rows` write identical values and the scatter
+    stays deterministic."""
+
+    def merge(path, big, small):
+        name = str(getattr(path[-1], "key", path[-1]))
+        if name in ("cache_index", "position_index"):
+            return big
+        return big.at[rows].set(small.astype(big.dtype))
+
+    return jax.tree_util.tree_map_with_path(merge, cache, rows_cache)
 
 
 def _normalize_buckets(buckets, max_len: int) -> tuple:
@@ -114,28 +255,229 @@ def _bucketed(prompt: np.ndarray, buckets: tuple, pad_id: int):
     return jnp.asarray(padded), p - 1
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _scatter_row(cache, row_cache, row):
-    """Write a single-row cache's K/V leaves into batch row `row` — the
-    batch cache is donated, so the update lowers in place instead of
-    copying every [B, max_len, ...] leaf per admission. Index counters
-    pass through (they are rewound wholesale before the next tick)."""
-
-    def merge(path, big, small):
-        name = str(getattr(path[-1], "key", path[-1]))
-        if name in ("cache_index", "position_index"):
-            return big
-        return big.at[row].set(small[0])
-
-    return jax.tree_util.tree_map_with_path(merge, cache, row_cache)
+def _ladder_depth(cap: int, bound: int) -> int:
+    """Scan depth for this round: the largest value from the ladder
+    {1, 2, 4, ..., cap} (cap always included) that is <= bound. Host
+    bookkeeping picks `bound` from remaining budgets, so compiles stay
+    O(log cap) while K still shrinks to 1 near a row completion."""
+    bound = min(cap, max(1, bound))
+    if bound >= cap:
+        return cap
+    k = 1
+    while k * 2 <= bound:
+        k *= 2
+    return k
 
 
-class ContinuousBatcher:
+def _pad_wave(r: int, cap: int) -> int:
+    """Admission wave sizes ride their own power-of-two ladder (capped at
+    the batch size) so `_prefill_rows` compiles O(log B) per bucket, not
+    O(B)."""
+    k = 1
+    while k < r:
+        k *= 2
+    return min(k, cap)
+
+
+class _BatcherBase:
+    """Machinery shared by `ContinuousBatcher` and
+    `SpeculativeContinuousBatcher`: the request queue, per-row host
+    bookkeeping (`_take_token`), batched bucket admission (`_admit`
+    drives the subclass `_prefill_wave` hook), stats publication, and
+    the dispatch/sync accounting the bench and the regression-guard test
+    read back.
+
+    Invariant per active row r (the speculative-decoding contract): the
+    cache holds K/V for exactly `committed[r]` tokens and `tok[r]` is the
+    last generated-but-unfed token.
+    """
+
+    _metrics_prefix = "serving/batcher"
+
+    def __init__(self, model, params, batch_size: int, max_len: int,
+                 eos_id, pad_id: int, rng, prompt_buckets):
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        self._buckets = _normalize_buckets(prompt_buckets, max_len)
+        self._model = model
+        self._params = params
+        self._b = batch_size
+        self._max_len = int(max_len)
+        self._eos = eos_id
+        self._pad = pad_id
+        self._rng = rng if rng is not None else jax.random.key(0)
+
+        self._req = [None] * batch_size          # request id or None
+        self._out = [[] for _ in range(batch_size)]
+        self._budget = np.zeros(batch_size, np.int64)
+        self._committed = np.zeros(batch_size, np.int64)
+        self._tok = np.full(batch_size, pad_id, np.int64)
+        self._queue: collections.deque = collections.deque()
+        self._submitted_at: dict = {}   # rid -> submit wall time (TTFT)
+        self._next_id = 0
+        self._rounds = 0         # decode ticks run
+        self._generated = 0      # every delivered token (incl. prefill 1st)
+        self._dispatches = 0     # jitted-program / eager-op invocations
+        self._syncs = 0          # blocking device->host fetches
+
+    # -- public -------------------------------------------------------------
+    @property
+    def idle(self) -> bool:
+        return not self._queue and all(r is None for r in self._req)
+
+    @property
+    def free_rows(self) -> int:
+        return sum(r is None for r in self._req)
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Queue a request; returns its id. prompt: 1-D int token ids."""
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if prompt.size + max_new_tokens > self._max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the batcher's max_len "
+                f"{self._max_len}"
+            )
+        self._validate_submit(prompt, max_new_tokens)
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append((rid, prompt, int(max_new_tokens)))
+        self._submitted_at[rid] = time.perf_counter()
+        return rid
+
+    def run(self) -> list:
+        """Step until idle; returns every completion in finish order."""
+        done = []
+        while not self.idle:
+            done.extend(self.step())
+        return done
+
+    def serve_metrics(self, port: int = 0):
+        """Start a /metrics endpoint next to this batcher (exposition.py);
+        returns the MetricsServer (read `.port` back when port=0)."""
+        from tfde_tpu.observability.exposition import serve_metrics
+
+        return serve_metrics(port=port)
+
+    def _publish_stats(self) -> None:
+        """Mirror stats() into the metric registry so serving throughput
+        rides the /metrics and JSONL exposition paths."""
+        reg = metrics.default_registry()
+        for k, v in self.stats().items():
+            reg.gauge(f"{self._metrics_prefix}/{k}").set(v)
+        reg.gauge(f"{self._metrics_prefix}/queue_depth").set(len(self._queue))
+        reg.gauge(f"{self._metrics_prefix}/free_rows").set(self.free_rows)
+
+    # -- hooks --------------------------------------------------------------
+    def _validate_submit(self, prompt: np.ndarray,
+                         max_new_tokens: int) -> None:
+        validate_budget(self._model, int(prompt.size), max_new_tokens)
+
+    def _prefill_wave(self, prompts: np.ndarray, last: np.ndarray,
+                      rows: np.ndarray, plens: np.ndarray,
+                      n: int) -> np.ndarray:
+        """Prefill + scatter one padded admission wave; returns the [R]
+        first sampled tokens (host ints). Rows past `n` are ladder
+        padding (duplicates of row 0). Subclass-specific: which model(s),
+        which caches, which sampling config."""
+        raise NotImplementedError
+
+    # -- internals ----------------------------------------------------------
+    def _take_token(self, r: int, t: int) -> list:
+        """Record a sampled token for row r; frees the row on completion."""
+        self._out[r].append(t)
+        self._budget[r] -= 1
+        self._tok[r] = t
+        self._generated += 1
+        if self._budget[r] <= 0 or (self._eos is not None and t == self._eos):
+            done = (self._req[r], np.asarray(self._out[r], np.int32))
+            self._req[r] = None
+            self._out[r] = []
+            self._committed[r] = 0
+            self._tok[r] = self._pad
+            return [done]
+        return []
+
+    def _admit(self) -> list:
+        """Fill free rows from the queue, a BUCKET WAVE at a time: every
+        freed row whose next request shares a prompt bucket prefills in
+        one [R, Pbucket] call and lands with one multi-row scatter. The
+        prefill samples each row's first token in-program (generate's
+        prefill contract), so every active row uniformly holds one
+        pending token afterwards. A request finishing on its first token
+        (budget 1 / instant EOS) frees its row for the next queued
+        request within the same call."""
+        finished = []
+        reg = metrics.default_registry()
+        while self._queue and self.free_rows:
+            free = [r for r in range(self._b) if self._req[r] is None]
+            wave = []
+            while self._queue and len(wave) < len(free):
+                wave.append(self._queue.popleft())
+            by_bucket: dict = collections.OrderedDict()
+            for item in wave:
+                _rid, prompt, _budget = item
+                bucket = next(b for b in self._buckets if b >= prompt.size)
+                by_bucket.setdefault(bucket, []).append(item)
+            taken = 0
+            for bucket, group in by_bucket.items():
+                n = len(group)
+                rows = free[taken:taken + n]
+                taken += n
+                rp = _pad_wave(n, self._b)
+                prompts = np.full((rp, bucket), self._pad, np.int32)
+                last = np.zeros(rp, np.int32)
+                plens = np.zeros(rp, np.int32)
+                rows_pad = np.asarray(
+                    rows + [rows[0]] * (rp - n), np.int32
+                )
+                for i in range(rp):
+                    # wave padding repeats row 0's request verbatim: the
+                    # duplicate prefill K/V is bit-identical (prefill is
+                    # row-independent and deterministic), so the duplicate
+                    # cache-scatter writes never race on ordering
+                    _rid, prompt, _budget = group[i if i < n else 0]
+                    prompts[i, :prompt.size] = prompt
+                    last[i] = prompt.size - 1
+                    plens[i] = prompt.size
+                with span("serving/prefill"):
+                    toks = self._prefill_wave(prompts, last, rows_pad,
+                                              plens, n)
+                now = time.perf_counter()
+                for i, (rid, prompt, budget) in enumerate(group):
+                    r = rows[i]
+                    self._req[r] = rid
+                    self._out[r] = []
+                    self._budget[r] = budget
+                    self._committed[r] = prompt.size
+                    t0 = self._submitted_at.pop(rid, None)
+                    if t0 is not None:
+                        reg.histogram("serving/ttft_ms").observe(
+                            (now - t0) * 1e3
+                        )
+                    finished.extend(self._take_token(r, int(toks[i])))
+            self._mark_dirty()
+        return finished
+
+    def _mark_dirty(self) -> None:
+        """Admission invalidated the device-resident loop state (if the
+        subclass keeps any)."""
+
+
+class ContinuousBatcher(_BatcherBase):
     """Fixed-batch continuous serving loop over a causal LM.
 
     model/params: a decode-capable model (GPT family) and its params.
     batch_size: resident decode rows. max_len: per-row cache budget
-    (prompt + generated must fit). The sampling config is fixed per
+    (prompt + generated must fit). scan_depth: ceiling K on fused decode
+    ticks per host round-trip (see the module docstring; 1 restores the
+    one-tick-per-step behavior). The sampling config is fixed per
     batcher, as for `generate`.
 
     Usage::
@@ -146,14 +488,13 @@ class ContinuousBatcher:
             for req_id, tokens in srv.step():
                 ...   # finished requests, completion order
 
-    `step()` admits queued requests into free rows (per-row prefill) and
-    runs ONE decode tick for the batch; it returns the requests finishing
-    on that call. `run()` drains everything.
-
-    Invariant per active row r (the speculative-decoding contract): the
-    cache holds K/V for exactly `committed[r]` tokens and `tok[r]` is the
-    last generated-but-unfed token.
+    `step()` admits queued requests into free rows (bucketed wave
+    prefill) and runs ONE fused decode scan of up to `scan_depth` ticks;
+    it returns the requests finishing on that call. `run()` drains
+    everything.
     """
+
+    _metrics_prefix = "serving/batcher"
 
     def __init__(
         self,
@@ -170,96 +511,118 @@ class ContinuousBatcher:
         pad_id: int = 0,
         rng: Optional[jax.Array] = None,
         prompt_buckets: Optional[tuple] = None,
+        scan_depth: int = 4,
     ):
-        if batch_size < 1:
-            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         if repetition_penalty <= 0.0:
             raise ValueError(
                 f"repetition_penalty must be > 0 (1.0 = off), got "
                 f"{repetition_penalty}"
             )
-        self._buckets = _normalize_buckets(prompt_buckets, max_len)
+        if scan_depth < 1:
+            raise ValueError(f"scan_depth must be >= 1, got {scan_depth}")
+        super().__init__(model, params, batch_size, max_len, eos_id,
+                         pad_id, rng, prompt_buckets)
         self._decode_model = _decode_clone(model)
-        self._model = model
-        self._params = params
-        self._b = batch_size
-        self._max_len = int(max_len)
-        self._sample = functools.partial(
-            sample_logits, temperature=temperature, top_k=top_k,
-            top_p=top_p, min_p=min_p,
-            repetition_penalty=repetition_penalty,
+        self._sampling = dict(
+            temperature=float(temperature),
+            top_k=top_k, top_p=top_p, min_p=min_p,
+            repetition_penalty=float(repetition_penalty),
         )
+        self._scan_depth = int(scan_depth)
         # presence mask for the repetition penalty (per row, prompt ids
         # included — the generate() convention); lives ON DEVICE and is
-        # updated with .at scatters, so steady-state ticks ship no
-        # [B, vocab] host copies
+        # threaded through the fused scan, so steady-state ticks ship no
+        # [B, vocab] host copies and no host-driven scatters
         self._seen = (
             jnp.zeros((batch_size, model.vocab_size), bool)
             if repetition_penalty != 1.0 else None
         )
         self._vocab = model.vocab_size
-        self._eos = eos_id
-        self._pad = pad_id
-        self._rng = rng if rng is not None else jax.random.key(0)
 
-        self._cache = init_cache(model, batch_size, self._max_len)
-        # zero single-row cache template, built once: _prefill_row does
-        # not donate its cache argument, so the template survives reuse
-        self._row_template = init_cache(model, 1, self._max_len)
-        self._req = [None] * batch_size          # request id or None
-        self._out = [[] for _ in range(batch_size)]
-        self._budget = np.zeros(batch_size, np.int64)
-        self._committed = np.zeros(batch_size, np.int64)
-        self._tok = np.full(batch_size, pad_id, np.int64)
-        self._queue: collections.deque = collections.deque()
-        self._next_id = 0
-        self._rounds = 0         # decode ticks run
-        self._generated = 0      # every delivered token (incl. prefill 1st)
-        # device indices match self._committed only after a rewind; any
-        # admission or completion desyncs them until the next tick rewinds
-        self._indices_dirty = True
+        # index leaves become [B] vectors ONCE, so the scan carry shape is
+        # stable from the first tick (the per-row decode-attention branch)
+        self._cache = _set_index_counters(
+            init_cache(model, batch_size, self._max_len),
+            np.zeros(batch_size, np.int32),
+        )
+        # zero row-cache templates per admission wave size, built lazily:
+        # _prefill_rows does not donate its cache argument, so each
+        # template survives reuse
+        self._row_templates: dict = {}
+        # device-resident loop state (tok/idx/budget/done); rebuilt from
+        # host bookkeeping whenever admission desyncs it
+        self._dev = None
 
     # -- public -------------------------------------------------------------
-    @property
-    def idle(self) -> bool:
-        return not self._queue and all(r is None for r in self._req)
-
-    @property
-    def free_rows(self) -> int:
-        return sum(r is None for r in self._req)
-
     def stats(self) -> dict:
-        """Serving throughput: decode rounds run, tokens delivered, and
-        tokens/round = generated / rounds — effectively the mean occupied
-        rows per tick (each occupied row yields one token; prefill first
-        tokens ride the admitting round's count)."""
+        """Serving throughput and host-overhead accounting: decode ticks
+        run, tokens delivered, tokens/round (mean occupied rows per
+        tick), and the per-token host cost — jitted dispatches and
+        blocking syncs per generated token (the O(1/K) bound the fused
+        scan exists for; tests/test_server.py guards it)."""
+        g = max(self._generated, 1)
         return {
             "rounds": self._rounds,
             "generated": self._generated,
             "tokens_per_round": self._generated / max(self._rounds, 1),
+            "dispatches": self._dispatches,
+            "syncs": self._syncs,
+            "dispatches_per_token": self._dispatches / g,
+            "syncs_per_token": self._syncs / g,
         }
 
-    def _publish_stats(self, prefix: str = "serving/batcher") -> None:
-        """Mirror stats() into the metric registry so serving throughput
-        rides the /metrics and JSONL exposition paths."""
-        reg = metrics.default_registry()
-        for k, v in self.stats().items():
-            reg.gauge(f"{prefix}/{k}").set(v)
-        reg.gauge(f"{prefix}/queue_depth").set(len(self._queue))
-        reg.gauge(f"{prefix}/free_rows").set(self.free_rows)
+    def step(self) -> list:
+        """Admit into free rows, run one fused decode scan (up to
+        `scan_depth` ticks); returns [(request_id, tokens 1-D np.int32),
+        ...] that finished now."""
+        with span("serving/admit"):
+            finished = self._admit()
+        active = [r for r in range(self._b) if self._req[r] is not None]
+        if not active:
+            self._publish_stats()
+            return finished
 
-    def serve_metrics(self, port: int = 0):
-        """Start a /metrics endpoint next to this batcher (exposition.py);
-        returns the MetricsServer (read `.port` back when port=0)."""
-        from tfde_tpu.observability.exposition import serve_metrics
+        depth = self._pick_depth(active)
+        t0 = time.perf_counter()
+        with span("serving/decode"):
+            if self._dev is None:
+                self._upload_state()
+            tok, idx, budget, done = self._dev
+            rng = self._rng if self._sampling["temperature"] != 0.0 else None
+            out = _decode_scan(
+                self._decode_model, self._cache, self._params, tok, idx,
+                budget, done, self._seen, rng, depth=depth,
+                eos_id=self._eos, pad_id=self._pad, **self._sampling,
+            )
+            self._dispatches += 1
+            (self._cache, tok, idx, budget, done, self._seen, rng,
+             toks, emitted) = out
+            self._dev = (tok, idx, budget, done)
+            if rng is not None:
+                self._rng = rng
+            toks_np, emitted_np = _fetch((toks, emitted))
+            self._syncs += 1
+        self._rounds += depth
+        n_emitted = 0
+        for r in active:
+            row = toks_np[r][emitted_np[r]]
+            if row.size == 0:
+                continue
+            n_emitted += int(row.size)
+            # feeding each pending token committed it; the row's last
+            # sample stays pending
+            self._committed[r] += int(row.size)
+            for t in row:
+                finished.extend(self._take_token(r, int(t)))
+        if n_emitted:
+            metrics.default_registry().histogram(
+                "serving/ms_per_token"
+            ).observe((time.perf_counter() - t0) * 1e3 / n_emitted)
+        self._publish_stats()
+        return finished
 
-        return serve_metrics(port=port)
-
-    def submit(self, prompt, max_new_tokens: int) -> int:
-        """Queue a request; returns its id. prompt: 1-D int token ids."""
-        prompt = np.asarray(prompt, np.int64).reshape(-1)
-        if prompt.size < 1:
-            raise ValueError("prompt must have at least one token")
+    # -- internals ----------------------------------------------------------
+    def _validate_submit(self, prompt, max_new_tokens) -> None:
         if self._seen is not None and (
                 prompt.min() < 0 or prompt.max() >= self._vocab):
             # queue-time, not admission-time (the _normalize_buckets rule):
@@ -272,140 +635,86 @@ class ContinuousBatcher:
                 f"repetition_penalty is on; got "
                 f"[{int(prompt.min())}, {int(prompt.max())}]"
             )
-        if max_new_tokens < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {max_new_tokens}"
-            )
-        validate_budget(self._model, int(prompt.size), max_new_tokens)
-        if prompt.size + max_new_tokens > self._max_len:
-            raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds the batcher's max_len "
-                f"{self._max_len}"
-            )
-        rid = self._next_id
-        self._next_id += 1
-        self._queue.append((rid, prompt, int(max_new_tokens)))
-        return rid
+        super()._validate_submit(prompt, max_new_tokens)
 
-    def step(self) -> list:
-        """Admit into free rows, run one decode tick; returns
-        [(request_id, tokens 1-D np.int32), ...] that finished now."""
-        with span("serving/admit"):
-            finished = self._admit()
-        active = [r for r in range(self._b) if self._req[r] is not None]
-        if not active:
-            self._publish_stats()
-            return finished
+    def _pick_depth(self, active) -> int:
+        """K for this scan. Queue waiting: bound by the SOONEST possible
+        row completion so admission latency never exceeds one short scan.
+        Queue empty: bound by the LONGEST remaining budget so the
+        draining tail runs no dead ticks. (EOS completions are not
+        host-predictable; a mid-scan EOS freezes the row on device and
+        wastes at most K-1 of its ticks.)"""
+        if self._scan_depth == 1:
+            return 1
+        remaining = [int(self._budget[r]) for r in active]
+        bound = min(remaining) if self._queue else max(remaining)
+        return _ladder_depth(self._scan_depth, bound)
 
-        with span("serving/decode"):
-            if self._indices_dirty:
-                # host values, not a shared jnp array: every index leaf gets
-                # its own buffer (the donated-cache aliasing rule). Steady
-                # state (no admissions/completions) skips this: the device
-                # indices advance by exactly 1 per tick, matching _committed.
-                self._cache = _set_index_counters(
-                    self._cache, self._committed.astype(np.int32)
-                )
-                self._indices_dirty = False
-            self._cache, logits = _decode_tick(
-                self._decode_model, self._cache, self._params,
-                jnp.asarray(self._tok, jnp.int32),
-            )
-            self._rng, sub = jax.random.split(self._rng)
-            toks = np.asarray(self._sample(logits, sub, seen=self._seen))
-        self._rounds += 1
+    def _mark_dirty(self) -> None:
+        self._dev = None
+
+    def _upload_state(self) -> None:
+        """Rebuild the device loop state from host bookkeeping (after
+        admission; steady state reuses the scan's own carry outputs)."""
+        self._dev = (
+            jnp.asarray(self._tok, jnp.int32),
+            jnp.asarray(self._committed, jnp.int32),
+            jnp.asarray(self._budget, jnp.int32),
+            jnp.asarray(np.asarray([r is None for r in self._req])),
+        )
+        self._dispatches += 1  # the four small host->device transfers
+
+    def _row_template(self, rp: int):
+        if rp not in self._row_templates:
+            self._row_templates[rp] = init_cache(self._model, rp,
+                                                 self._max_len)
+        return self._row_templates[rp]
+
+    def _prefill_wave(self, prompts, last, rows, plens, n) -> np.ndarray:
+        rp, bucket = prompts.shape
+        valid = None
         if self._seen is not None:
-            act = np.asarray(active)
-            self._seen = self._seen.at[act, toks[act]].set(True)
-        for r in active:
-            # feeding tok[r] committed it; the new sample is now pending
-            self._committed[r] += 1
-            finished.extend(self._take_token(r, int(toks[r])))
-        self._publish_stats()
-        return finished
-
-    def run(self) -> list:
-        """Step until idle; returns every completion in finish order."""
-        done = []
-        while not self.idle:
-            done.extend(self.step())
-        return done
-
-    # -- internals ----------------------------------------------------------
-    def _take_token(self, r: int, t: int) -> list:
-        """Record a sampled token for row r; frees the row on completion."""
-        self._out[r].append(t)
-        self._budget[r] -= 1
-        self._tok[r] = t
-        self._generated += 1
-        if self._budget[r] <= 0 or (self._eos is not None and t == self._eos):
-            done = (self._req[r], np.asarray(self._out[r], np.int32))
-            self._req[r] = None
-            self._out[r] = []
-            self._committed[r] = 0
-            self._tok[r] = self._pad
-            if self._seen is not None:
-                self._seen = self._seen.at[r].set(False)
-            self._indices_dirty = True
-            return [done]
-        return []
-
-    def _admit(self) -> list:
-        """Fill free rows from the queue. The prefill samples the row's
-        first token immediately (generate's prefill contract), so every
-        active row uniformly holds one pending token afterwards. A
-        request finishing on its first token (budget 1 / instant EOS)
-        frees the row for the next queued request in the same call."""
-        finished = []
-        progress = True
-        while progress and self._queue:
-            progress = False
-            for r in range(self._b):
-                if not self._queue or self._req[r] is not None:
-                    continue
-                rid, prompt, budget = self._queue.popleft()
-                ids, last = _bucketed(prompt, self._buckets, self._pad)
-                with span("serving/prefill"):
-                    row_cache, logits = _prefill_row(
-                        self._decode_model, self._row_template, self._params,
-                        ids, last,
-                    )
-                self._cache = _scatter_row(
-                    self._cache, row_cache, jnp.int32(r)
-                )
-                self._indices_dirty = True
-                if self._seen is not None:
-                    # row r is all-False by invariant (_take_token clears
-                    # on completion; init starts zeroed) — only the prompt
-                    # scatter is needed
-                    self._seen = self._seen.at[
-                        r, jnp.asarray(prompt)
-                    ].set(True)
-                self._rng, sub = jax.random.split(self._rng)
-                t = int(np.asarray(self._sample(
-                    logits, sub,
-                    seen=(None if self._seen is None
-                          else self._seen[r:r + 1]),
-                ))[0])
-                if self._seen is not None:
-                    self._seen = self._seen.at[r, t].set(True)
-                self._req[r] = rid
-                self._out[r] = []
-                self._budget[r] = budget
-                self._committed[r] = prompt.size
-                finished.extend(self._take_token(r, t))
-                progress = True
-        return finished
+            valid = jnp.asarray(
+                np.arange(bucket)[None, :] < plens[:, None]
+            )
+        rng = None
+        if self._sampling["temperature"] != 0.0:
+            self._rng, rng = jax.random.split(self._rng)
+        row_cache, tok, row_seen = _prefill_rows(
+            self._decode_model, self._row_template(rp), self._params,
+            jnp.asarray(prompts), jnp.asarray(last), valid, rng,
+            **self._sampling,
+        )
+        self._dispatches += 1
+        rows_dev = jnp.asarray(rows)
+        self._cache = _scatter_rows(self._cache, row_cache, rows_dev)
+        self._dispatches += 1
+        if row_seen is not None:
+            if rp > n:
+                # a ladder-padding row's K/V duplicates row 0 bit-exactly,
+                # but its sampled-first-token seen bit can differ under
+                # temperature>0 (independent categorical draw per row) —
+                # gather duplicates back to row 0's seen so the duplicate
+                # scatter indices below write identical values
+                sel = np.arange(rp)
+                sel[n:] = 0
+                row_seen = row_seen[jnp.asarray(sel)]
+            self._seen = self._seen.at[rows_dev].set(row_seen)
+            self._dispatches += 1
+        tok_np = _fetch(tok)
+        self._syncs += 1
+        return tok_np
 
 
-class SpeculativeContinuousBatcher:
+class SpeculativeContinuousBatcher(_BatcherBase):
     """Continuous batching accelerated by a draft model — the two serving
     levers composed: every round, the draft proposes `num_draft` tokens
     per row and ONE target forward verifies all of them
     (inference/speculative.py's batch-generic round, per-row acceptance),
     while finished rows admit queued requests mid-flight exactly like
-    `ContinuousBatcher`.
+    `ContinuousBatcher` — including the bucketed wave admission: both
+    caches prefill every freed row of a bucket in one call each and land
+    with one multi-row scatter per cache.
 
     temperature == 0 (default): deterministic rounds — each request's
     output equals its solo greedy `generate(model, params, prompt)` run.
@@ -417,6 +726,8 @@ class SpeculativeContinuousBatcher:
     row with draft quality; `stats()` reports the realized tokens/round
     and draft acceptance rate.
     """
+
+    _metrics_prefix = "serving/speculative"
 
     def __init__(
         self,
@@ -433,62 +744,42 @@ class SpeculativeContinuousBatcher:
         rng: Optional[jax.Array] = None,
         prompt_buckets: Optional[tuple] = None,
     ):
-        self._buckets = _normalize_buckets(prompt_buckets, max_len)
+        if num_draft < 1:
+            raise ValueError(f"num_draft must be >= 1, got {num_draft}")
+        super().__init__(model, params, batch_size, max_len, eos_id,
+                         pad_id, rng, prompt_buckets)
         from tfde_tpu.inference.speculative import (
             _spec_round,
             _spec_round_sampled,
         )
 
-        if batch_size < 1:
-            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
-        if num_draft < 1:
-            raise ValueError(f"num_draft must be >= 1, got {num_draft}")
         self._round = _spec_round
         self._round_sampled = _spec_round_sampled
         self._temperature = float(temperature)
-        self._rng = rng if rng is not None else jax.random.key(0)
-        self._model = model
         self._draft = draft_model
         self._tgt = _decode_clone(model)
         self._drf = _decode_clone(draft_model)
-        self._params = params
         self._dparams = draft_params
-        self._b = batch_size
-        self._max_len = int(max_len)
         self._nd = int(num_draft)
-        self._eos = eos_id
-        self._pad = pad_id
         # the speculative cache invariant: each round feeds at most
         # num_draft+1 tokens past a row's committed count before the
         # rewind (inference/speculative.py cache sizing)
-        cache_len = self._max_len + self._nd + 1
-        self._tgt_cache = init_cache(model, batch_size, cache_len)
-        self._drf_cache = init_cache(draft_model, batch_size, cache_len)
-        self._tgt_row = init_cache(model, 1, cache_len)
-        self._drf_row = init_cache(draft_model, 1, cache_len)
-
-        self._req = [None] * batch_size
-        self._out = [[] for _ in range(batch_size)]
-        self._budget = np.zeros(batch_size, np.int64)
-        self._committed = np.zeros(batch_size, np.int64)
-        self._tok = np.full(batch_size, pad_id, np.int64)
-        self._queue: collections.deque = collections.deque()
-        self._next_id = 0
-        self._rounds = 0
-        self._generated = 0      # every delivered token (incl. prefill 1st)
+        self._cache_len = self._max_len + self._nd + 1
+        self._tgt_cache = init_cache(model, batch_size, self._cache_len)
+        self._drf_cache = init_cache(draft_model, batch_size,
+                                     self._cache_len)
+        self._tgt_templates: dict = {}
+        self._drf_templates: dict = {}
         self._round_tokens = 0   # tokens produced by speculative rounds
         self._draft_proposed = 0  # num_draft per active row per round
         self._draft_accepted = 0  # committed beyond the guaranteed token
-
-    @property
-    def idle(self) -> bool:
-        return not self._queue and all(r is None for r in self._req)
 
     def stats(self) -> dict:
         """Speculation effectiveness: tokens/round is per ROW per round
         (1.0 = no draft ever accepted, num_draft+1 = perfect draft);
         acceptance_rate is the fraction of proposed draft tokens the
-        target committed."""
+        target committed. dispatches/syncs mirror ContinuousBatcher's
+        host-overhead accounting."""
         return {
             "rounds": self._rounds,
             "generated": self._generated,
@@ -498,93 +789,48 @@ class SpeculativeContinuousBatcher:
             "acceptance_rate": (
                 self._draft_accepted / max(self._draft_proposed, 1)
             ),
+            "dispatches": self._dispatches,
+            "syncs": self._syncs,
         }
 
-    def _publish_stats(self, prefix: str = "serving/speculative") -> None:
-        reg = metrics.default_registry()
-        for k, v in self.stats().items():
-            reg.gauge(f"{prefix}/{k}").set(v)
-        reg.gauge(f"{prefix}/queue_depth").set(len(self._queue))
-
-    def serve_metrics(self, port: int = 0):
-        """Start a /metrics endpoint next to this batcher (exposition.py);
-        returns the MetricsServer (read `.port` back when port=0)."""
-        from tfde_tpu.observability.exposition import serve_metrics
-
-        return serve_metrics(port=port)
-
-    def submit(self, prompt, max_new_tokens: int) -> int:
-        prompt = np.asarray(prompt, np.int64).reshape(-1)
-        if prompt.size < 1:
-            raise ValueError("prompt must have at least one token")
-        if max_new_tokens < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {max_new_tokens}"
-            )
-        validate_budget(self._model, int(prompt.size), max_new_tokens)
+    def _validate_submit(self, prompt, max_new_tokens) -> None:
+        super()._validate_submit(prompt, max_new_tokens)
         validate_budget(self._draft, int(prompt.size), max_new_tokens)
-        if prompt.size + max_new_tokens > self._max_len:
-            raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds the batcher's max_len "
-                f"{self._max_len}"
-            )
-        rid = self._next_id
-        self._next_id += 1
-        self._queue.append((rid, prompt, int(max_new_tokens)))
-        return rid
 
-    def _take_token(self, r: int, t: int) -> list:
-        self._out[r].append(t)
-        self._budget[r] -= 1
-        self._tok[r] = t
-        self._generated += 1
-        if self._budget[r] <= 0 or (self._eos is not None and t == self._eos):
-            done = (self._req[r], np.asarray(self._out[r], np.int32))
-            self._req[r] = None
-            self._out[r] = []
-            self._committed[r] = 0
-            self._tok[r] = self._pad
-            return [done]
-        return []
+    def _template(self, cache_dict, model, rp: int):
+        if rp not in cache_dict:
+            cache_dict[rp] = init_cache(model, rp, self._cache_len)
+        return cache_dict[rp]
 
-    def _admit(self) -> list:
-        finished = []
-        progress = True
-        while progress and self._queue:
-            progress = False
-            for r in range(self._b):
-                if not self._queue or self._req[r] is not None:
-                    continue
-                rid, prompt, budget = self._queue.popleft()
-                ids, last = _bucketed(prompt, self._buckets, self._pad)
-                with span("serving/prefill"):
-                    tgt_row, logits = _prefill_row(
-                        self._tgt, self._tgt_row, self._params, ids, last
-                    )
-                    drf_row, _ = _prefill_row(
-                        self._drf, self._drf_row, self._dparams, ids, last
-                    )
-                self._tgt_cache = _scatter_row(
-                    self._tgt_cache, tgt_row, jnp.int32(r)
-                )
-                self._drf_cache = _scatter_row(
-                    self._drf_cache, drf_row, jnp.int32(r)
-                )
-                if self._temperature > 0.0:
-                    self._rng, sub = jax.random.split(self._rng)
-                    t = int(np.asarray(sample_logits(
-                        logits, sub, temperature=self._temperature
-                    ))[0])
-                else:
-                    t = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
-                self._req[r] = rid
-                self._out[r] = []
-                self._budget[r] = budget
-                self._committed[r] = prompt.size
-                finished.extend(self._take_token(r, t))
-                progress = True
-        return finished
+    def _prefill_wave(self, prompts, last, rows, plens, n) -> np.ndarray:
+        rp = prompts.shape[0]
+        prompts_dev = jnp.asarray(prompts)
+        last_dev = jnp.asarray(last)
+        rng = None
+        if self._temperature > 0.0:
+            self._rng, rng = jax.random.split(self._rng)
+        tgt_rows, tok, _ = _prefill_rows(
+            self._tgt, self._template(self._tgt_templates, self._model, rp),
+            self._params, prompts_dev, last_dev, None, rng,
+            temperature=self._temperature, top_k=None, top_p=None,
+            min_p=None, repetition_penalty=1.0,
+        )
+        # the draft prefill only needs its cache filled; its sampled token
+        # is discarded (greedy argmax — no rng consumed)
+        drf_rows, _, _ = _prefill_rows(
+            self._drf, self._template(self._drf_templates, self._draft, rp),
+            self._dparams, prompts_dev, last_dev, None, None,
+            temperature=0.0, top_k=None, top_p=None, min_p=None,
+            repetition_penalty=1.0,
+        )
+        self._dispatches += 2
+        rows_dev = jnp.asarray(rows)
+        self._tgt_cache = _scatter_rows(self._tgt_cache, tgt_rows, rows_dev)
+        self._drf_cache = _scatter_rows(self._drf_cache, drf_rows, rows_dev)
+        self._dispatches += 2
+        tok_np = _fetch(tok)
+        self._syncs += 1
+        return tok_np
 
     def step(self) -> list:
         """Admit, then run ONE speculative round for the whole batch;
@@ -596,6 +842,7 @@ class SpeculativeContinuousBatcher:
             self._publish_stats()
             return finished
         self._rounds += 1
+        t0 = time.perf_counter()
         with span("serving/decode"):
             # per-round rewind is unconditional: acceptance lengths diverge
             # every round (host ints/np arrays — own buffer per index leaf,
@@ -603,6 +850,7 @@ class SpeculativeContinuousBatcher:
             committed = self._committed.astype(np.int32)
             self._tgt_cache = _set_index_counters(self._tgt_cache, committed)
             self._drf_cache = _set_index_counters(self._drf_cache, committed)
+            self._dispatches += 2
             if self._temperature > 0.0:
                 self._rng, sub = jax.random.split(self._rng)
                 (self._tgt_cache, self._drf_cache, round_toks, n_new,
@@ -619,8 +867,10 @@ class SpeculativeContinuousBatcher:
                     self._params, self._dparams,
                     jnp.asarray(self._tok, jnp.int32), self._nd, self._pad,
                 )
-            round_np = np.asarray(round_toks)
-            n_np = np.asarray(n_new)
+            self._dispatches += 1
+            round_np, n_np = _fetch((round_toks, n_new))
+            self._syncs += 1
+        n_emitted = 0
         for r in active:
             toks = round_np[r, : int(n_np[r])].tolist()
             taken = 0
@@ -630,6 +880,7 @@ class SpeculativeContinuousBatcher:
                 self._round_tokens += 1
                 finished.extend(self._take_token(r, int(t)))
                 taken += 1
+            n_emitted += taken
             # acceptance bookkeeping: each round proposes num_draft per
             # active row; a row's commits beyond the guaranteed target
             # token are accepted draft proposals (capped by num_draft —
@@ -641,11 +892,9 @@ class SpeculativeContinuousBatcher:
                 # both caches (the pending one stays unfed) — the
                 # generate_speculative commit bookkeeping
                 self._committed[r] += taken
+        if n_emitted:
+            metrics.default_registry().histogram(
+                "serving/ms_per_token"
+            ).observe((time.perf_counter() - t0) * 1e3 / n_emitted)
         self._publish_stats()
         return finished
-
-    def run(self) -> list:
-        done = []
-        while not self.idle:
-            done.extend(self.step())
-        return done

@@ -68,8 +68,17 @@ from tfde_tpu.inference.decode import (
 
 
 def _set_index_counters(cache, value):
-    """Rewind every layer's cache_index (and the model's position_index)
-    to `value` — fed-token-count surgery after a partial acceptance."""
+    """Set every layer's cache_index (and the model's position_index) to
+    `value` — fed-token-count surgery. Two call modes:
+
+    - HOST-SIDE (speculative rewind, between jitted rounds): `value` must
+      be a host int / np array, NOT a jnp array — each index leaf needs
+      its OWN device buffer, or the shared array would alias across the
+      donated cache pytrees and trip XLA's donated-twice check.
+    - TRACED (inside a jitted program, e.g. the server's fused decode
+      scan): `value` may be a tracer; the leaves then share the traced
+      value, which is fine — donation applies to program arguments, not
+      to values inside one program."""
 
     def fix(path, leaf):
         name = str(getattr(path[-1], "key", path[-1]))

@@ -5,6 +5,10 @@
 # counts across revisions even when the exit code is nonzero.
 #
 # Usage: tools/tier1.sh            (from the repo root)
+#
+# Also prints DOTS_DELTA (this run's DOTS_PASSED minus the previous
+# run's, from /tmp/_t1.passed) so a regression is visible at a glance
+# without diffing logs by hand.
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
 
@@ -15,5 +19,11 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
-echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+echo DOTS_PASSED=$passed
+if [ -f /tmp/_t1.passed ]; then
+    prev=$(cat /tmp/_t1.passed)
+    echo DOTS_DELTA=$((passed - prev))
+fi
+echo "$passed" > /tmp/_t1.passed
 exit $rc
