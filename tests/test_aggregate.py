@@ -1,0 +1,288 @@
+"""Cross-host aggregation acceptance (observability/aggregate.py): the
+push/rollup round-trip over a real HTTP server, straggler flagging from
+rolling step-time medians, dead-host staleness expiry in virtual time, and
+the MetricsServer port-in-use fallback.
+
+Everything runs on private Registry instances and injected clocks/callbacks
+so the tests are hermetic against the process-wide default registry."""
+
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from tfde_tpu.observability import aggregate, metrics
+from tfde_tpu.observability.aggregate import (
+    ClusterAggregator,
+    MetricsPusher,
+    push_once,
+    snapshot_payload,
+)
+from tfde_tpu.observability.exposition import MetricsServer, PROM_CONTENT_TYPE
+
+
+def _payload(host, step_sum, step_count, ts=0.0, extra=None):
+    m = {"train/step/sum": step_sum, "train/step/count": step_count}
+    m.update(extra or {})
+    return {"host": host, "pid": 1, "ts": ts, "metrics": m}
+
+
+def _sinks():
+    """Recorded on_straggler/on_stale callbacks."""
+    calls = {"straggler": [], "stale": []}
+    return (calls,
+            lambda h, r: calls["straggler"].append((h, r)),
+            lambda h, a: calls["stale"].append((h, a)))
+
+
+# -- push/rollup round-trip over real HTTP -----------------------------------
+def test_push_rollup_roundtrip_over_http():
+    chief_reg = metrics.Registry()
+    chief_reg.gauge("train/steps_per_sec").set(10.0)
+    calls, on_strag, on_stale = _sinks()
+    agg = ClusterAggregator(registry=chief_reg, include_local=0,
+                            on_straggler=on_strag, on_stale=on_stale)
+    srv = MetricsServer(port=0, host="127.0.0.1", registry=chief_reg,
+                        aggregator=agg)
+    try:
+        worker_reg = metrics.Registry()
+        worker_reg.gauge("train/steps_per_sec").set(33.0)
+        worker_reg.histogram("train/step").observe(0.1)
+        url = f"http://127.0.0.1:{srv.port}"
+        assert push_once(f"{url}/push", registry=worker_reg, host=1)
+
+        resp = urllib.request.urlopen(f"{url}/metrics")
+        ctype = resp.headers.get("Content-Type")
+        # proper Prometheus exposition Content-Type, version included
+        assert ctype.startswith("text/plain; version=0.0.4")
+        assert ctype == PROM_CONTENT_TYPE
+        body = resp.read().decode()
+        # chief's own series still there...
+        assert "tfde_train_steps_per_sec 10.0" in body
+        # ...plus the worker's, host-labelled, plus liveness + rollups
+        assert 'tfde_train_steps_per_sec{host="1"} 33.0' in body
+        assert 'tfde_cluster_host_up{host="1"} 1' in body
+        assert 'tfde_cluster_host_up{host="0"} 1' in body  # include_local
+        assert "tfde_cluster_hosts_reporting 2" in body
+    finally:
+        srv.close()
+
+
+def test_push_rejects_garbage_and_missing_aggregator():
+    reg = metrics.Registry()
+    calls, on_strag, on_stale = _sinks()
+    agg = ClusterAggregator(registry=reg, on_straggler=on_strag,
+                            on_stale=on_stale)
+    srv = MetricsServer(port=0, host="127.0.0.1", registry=reg,
+                        aggregator=agg)
+    bare = MetricsServer(port=0, host="127.0.0.1", registry=reg)
+    try:
+        def post(port, data):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/push", data=data,
+                headers={"Content-Type": "application/json"}, method="POST")
+            try:
+                return urllib.request.urlopen(req).status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        import urllib.error
+        assert post(srv.port, b"not json") == 400
+        assert post(srv.port, json.dumps({"metrics": {}}).encode()) == 400
+        assert post(bare.port, json.dumps(_payload(1, 1, 1)).encode()) == 404
+        # a bad push must not poison the aggregator for good pushes
+        assert post(srv.port, json.dumps(_payload(1, 1.0, 10.0)).encode()) == 200
+    finally:
+        srv.close()
+        bare.close()
+
+
+def test_push_once_unreachable_returns_false_never_raises():
+    reg = metrics.Registry()
+    assert push_once("http://127.0.0.1:1/push", registry=reg, host=9,
+                     timeout=0.2) is False
+
+
+def test_snapshot_payload_shape():
+    reg = metrics.Registry()
+    reg.counter("c").incr(2)
+    p = snapshot_payload(reg, host=3)
+    assert p["host"] == 3 and p["pid"] > 0 and p["ts"] > 0
+    assert p["metrics"]["c"] == 2.0
+
+
+def test_metrics_pusher_thread_pushes_and_final_push():
+    reg = metrics.Registry()
+    calls, on_strag, on_stale = _sinks()
+    agg = ClusterAggregator(registry=reg, on_straggler=on_strag,
+                            on_stale=on_stale)
+    srv = MetricsServer(port=0, host="127.0.0.1", registry=reg,
+                        aggregator=agg)
+    try:
+        wreg = metrics.Registry()
+        wreg.gauge("g").set(1.0)
+        pusher = MetricsPusher(f"http://127.0.0.1:{srv.port}/push",
+                               interval=0.05, registry=wreg, host=2)
+        deadline = time.time() + 10.0
+        while not agg.hosts().get(2) and time.time() < deadline:
+            time.sleep(0.02)
+        assert agg.hosts()[2]["pushes"] >= 1
+        before = agg.hosts()[2]["pushes"]
+        pusher.close()  # close() does one final push
+        assert agg.hosts()[2]["pushes"] >= before + 1
+    finally:
+        srv.close()
+
+
+# -- straggler detection ------------------------------------------------------
+def test_straggler_flagged_and_transition_deduped():
+    reg = metrics.Registry()
+    calls, on_strag, on_stale = _sinks()
+    agg = ClusterAggregator(registry=reg, straggler_factor=2.0,
+                            on_straggler=on_strag, on_stale=on_stale)
+    # three hosts, one 10x slower (first push seeds s/c as the sample)
+    agg.ingest(_payload(0, 1.0, 10.0))   # 100 ms/step
+    agg.ingest(_payload(1, 1.0, 10.0))   # 100 ms/step
+    agg.ingest(_payload(2, 10.0, 10.0))  # 1000 ms/step
+    out = agg.rollup()
+    assert out["straggler_host"] == 2
+    assert out["straggler_ratio"] == pytest.approx(10.0)
+    assert out["host_medians_ms"][2] == pytest.approx(1000.0)
+    assert reg.gauge("cluster/straggler_host").value == 2
+    assert calls["straggler"] == [(2, pytest.approx(10.0))]
+    agg.rollup()  # same straggler again: callback fires on TRANSITION only
+    assert len(calls["straggler"]) == 1
+    # rollup gauges present
+    assert reg.gauge("cluster/step_time_median_ms").value == pytest.approx(100.0)
+    assert reg.gauge("cluster/step_time_max_ms").value == pytest.approx(1000.0)
+
+
+def test_straggler_needs_two_live_hosts():
+    reg = metrics.Registry()
+    calls, on_strag, on_stale = _sinks()
+    agg = ClusterAggregator(registry=reg, on_straggler=on_strag,
+                            on_stale=on_stale)
+    agg.ingest(_payload(0, 50.0, 10.0))  # slow, but alone
+    out = agg.rollup()
+    assert out["straggler_host"] == -1
+    assert calls["straggler"] == []
+
+
+def test_medians_are_rolling_not_cumulative():
+    """A host that WAS slow but recovered must stop being the straggler:
+    medians come from per-push deltas over a bounded window."""
+    reg = metrics.Registry()
+    calls, on_strag, on_stale = _sinks()
+    agg = ClusterAggregator(registry=reg, window=4,
+                            on_straggler=on_strag, on_stale=on_stale)
+    agg.ingest(_payload(0, 1.0, 10.0))  # host 0 steady at 100 ms
+    # host 1: one slow push interval, then fast ones push it out the window
+    s, c = 10.0, 10.0
+    agg.ingest(_payload(1, s, c))  # 1000 ms/step seed
+    for _ in range(5):
+        s, c = s + 1.0, c + 10.0  # +100 ms/step intervals
+        agg.ingest(_payload(1, s, c))
+    out = agg.rollup()
+    assert out["host_medians_ms"][1] == pytest.approx(100.0)
+    assert out["straggler_host"] == -1
+
+
+def test_straggler_factor_validated():
+    with pytest.raises(ValueError):
+        ClusterAggregator(registry=metrics.Registry(), straggler_factor=1.0)
+
+
+# -- staleness ---------------------------------------------------------------
+def test_dead_host_goes_stale_in_virtual_time():
+    now = [0.0]
+    reg = metrics.Registry()
+    calls, on_strag, on_stale = _sinks()
+    agg = ClusterAggregator(registry=reg, stale_after=5.0,
+                            on_straggler=on_strag, on_stale=on_stale,
+                            clock=lambda: now[0])
+    agg.ingest(_payload(0, 1.0, 10.0))
+    agg.ingest(_payload(1, 1.0, 10.0))
+    assert agg.rollup()["hosts_stale"] == 0
+
+    now[0] = 3.0
+    agg.ingest(_payload(0, 2.0, 20.0))  # host 0 keeps pushing; host 1 dies
+    now[0] = 6.0  # host 1's last push is 6s old > stale_after=5
+    out = agg.rollup()
+    assert out["hosts_reporting"] == 1
+    assert out["hosts_stale"] == 1 and out["stale_hosts"] == [1]
+    assert 1 not in out["host_medians_ms"]  # excluded from rollups
+    assert calls["stale"] == [(1, pytest.approx(6.0))]
+    agg.rollup()  # still stale: reported once, not per rollup
+    assert len(calls["stale"]) == 1
+
+    # prometheus liveness flips too
+    text = agg.prometheus_text()
+    assert 'tfde_cluster_host_up{host="1"} 0' in text
+    assert 'tfde_cluster_host_up{host="0"} 1' in text
+
+    # the host comes back: live again AND the stale latch re-arms
+    now[0] = 7.0
+    agg.ingest(_payload(1, 3.0, 25.0))
+    out = agg.rollup()
+    assert out["hosts_stale"] == 0 and out["hosts_reporting"] == 2
+    now[0] = 11.0
+    agg.ingest(_payload(0, 3.0, 30.0))  # host 0 stays fresh...
+    now[0] = 13.0  # ...host 1's comeback push is now 6s old again
+    agg.rollup()
+    assert calls["stale"] == [(1, pytest.approx(6.0)),
+                              (1, pytest.approx(6.0))]  # reported again
+
+
+def test_scrape_flips_staleness_without_new_pushes():
+    """The acceptance path: a worker dies, the chief's /metrics must show
+    it stale on the next scrape even though nothing pushes anymore."""
+    now = [0.0]
+    reg = metrics.Registry()
+    calls, on_strag, on_stale = _sinks()
+    agg = ClusterAggregator(registry=reg, stale_after=1.0,
+                            on_straggler=on_strag, on_stale=on_stale,
+                            clock=lambda: now[0])
+    srv = MetricsServer(port=0, host="127.0.0.1", registry=reg,
+                        aggregator=agg)
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/push",
+            data=json.dumps(_payload(1, 1.0, 10.0)).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        urllib.request.urlopen(req)
+        body = urllib.request.urlopen(url).read().decode()
+        assert "tfde_cluster_hosts_stale 0" in body
+        now[0] = 2.0  # ...worker dies; only the scrape-side clock moves
+        body = urllib.request.urlopen(url).read().decode()
+        assert "tfde_cluster_hosts_stale 1" in body
+        assert 'tfde_cluster_host_up{host="1"} 0' in body
+    finally:
+        srv.close()
+
+
+# -- port-in-use fallback -----------------------------------------------------
+def test_metrics_server_port_in_use_falls_back(caplog):
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    taken = blocker.getsockname()[1]
+    try:
+        import logging
+
+        with caplog.at_level(logging.WARNING,
+                             logger="tfde_tpu.observability.exposition"):
+            srv = MetricsServer(port=taken, host="127.0.0.1",
+                                registry=metrics.Registry())
+        try:
+            assert srv.port != taken and srv.port > 0
+            assert any("falling back" in r.message for r in caplog.records)
+            ok = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz").read()
+            assert ok == b"ok\n"
+        finally:
+            srv.close()
+    finally:
+        blocker.close()

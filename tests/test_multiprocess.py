@@ -301,3 +301,153 @@ def test_two_process_fsdp_shards_and_agrees(tmp_path):
     assert results[0]["loss"] == pytest.approx(results[1]["loss"])
     # each host holds a different shard of the same kernel
     assert results[0]["shard_sha"] != results[1]["shard_sha"]
+
+
+_OBS_CHILD = textwrap.dedent(
+    """
+    import json, os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from tfde_tpu.utils.devices import request_cpu_devices
+    request_cpu_devices(1)
+    from tfde_tpu import bootstrap
+    from tfde_tpu.observability import aggregate, flightrec, metrics
+    from tfde_tpu.observability.exposition import MetricsServer
+
+    model_dir, port_file, stop_file = sys.argv[1:4]
+    info = bootstrap()
+    assert jax.process_count() == 2
+
+    if info.process_id == 0:
+        # chief: /metrics + aggregator; stays up after the worker is killed
+        reg = metrics.Registry()
+        agg = aggregate.ClusterAggregator(registry=reg, include_local=0,
+                                          stale_after=1.5)
+        srv = MetricsServer(port=0, host="127.0.0.1", registry=reg,
+                            aggregator=agg)
+        tmp = port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(srv.port))
+        os.replace(tmp, port_file)
+        deadline = time.time() + 180
+        while not os.path.exists(stop_file) and time.time() < deadline:
+            time.sleep(0.05)
+        out = agg.rollup()
+        print(json.dumps({"process_id": 0,
+                          "hosts_stale": out["hosts_stale"],
+                          "stale_hosts": out["stale_hosts"]}))
+        sys.stdout.flush()
+        os._exit(0)  # peer was SIGKILLed: skip jax.distributed teardown
+    else:
+        # worker: flight recorder armed + metrics pusher, then wait to die
+        flightrec.arm(model_dir)
+        flightrec.record("worker_alive", pid=os.getpid())
+        wreg = metrics.Registry()
+        wreg.gauge("train/steps_per_sec").set(21.0)
+        wreg.histogram("train/step").observe(0.1)
+        deadline = time.time() + 180
+        while not os.path.exists(port_file) and time.time() < deadline:
+            time.sleep(0.05)
+        with open(port_file) as f:
+            port = int(f.read())
+        pusher = aggregate.MetricsPusher(
+            f"http://127.0.0.1:{port}/push", interval=0.25,
+            registry=wreg, host=info.process_id)
+        time.sleep(300)  # the parent SIGTERMs us here
+    """
+)
+
+
+def test_killed_worker_leaves_flight_file_and_goes_stale(tmp_path):
+    """The PR's cluster acceptance: chief /metrics carries the worker's
+    host-labelled series; SIGTERM-killing the worker (a) leaves a parseable
+    flight_*.jsonl under model_dir/debug and the process dies BY SIGNAL,
+    and (b) flips the chief's staleness gauges within ~one push interval."""
+    import glob
+    import signal
+    import time
+    import urllib.request
+
+    from tfde_tpu.observability import flightrec
+
+    script = tmp_path / "child_obs.py"
+    script.write_text(_OBS_CHILD)
+    model_dir = str(tmp_path / "run")
+    port_file = str(tmp_path / "chief_port")
+    stop_file = str(tmp_path / "chief_stop")
+
+    ports = [_free_port(), _free_port()]
+    cluster = {"worker": [f"127.0.0.1:{p}" for p in ports]}
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.update(
+            CLUSTER_SPEC=json.dumps(cluster),
+            TASK_INDEX=str(i),
+            JOB_NAME="worker",
+            PYTHONPATH=os.pathsep.join(
+                [os.path.dirname(os.path.dirname(__file__))]
+                + env.get("PYTHONPATH", "").split(os.pathsep)
+            ),
+        )
+        env.pop("TF_CONFIG", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script),
+                 model_dir, port_file, stop_file],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    chief, worker = procs
+    try:
+        deadline = time.time() + 180
+        while not os.path.exists(port_file) and time.time() < deadline:
+            assert chief.poll() is None, chief.communicate()[1][-3000:]
+            time.sleep(0.05)
+        with open(port_file) as f:
+            url = f"http://127.0.0.1:{int(f.read())}/metrics"
+
+        def scrape():
+            return urllib.request.urlopen(url, timeout=5).read().decode()
+
+        body = ""
+        while time.time() < deadline:
+            body = scrape()
+            if 'tfde_train_steps_per_sec{host="1"} 21.0' in body:
+                break
+            time.sleep(0.1)
+        # the worker's pushed snapshot shows up host-labelled, and live
+        assert 'tfde_train_steps_per_sec{host="1"} 21.0' in body
+        assert 'tfde_cluster_host_up{host="1"} 1' in body
+
+        worker.send_signal(signal.SIGTERM)
+        worker.wait(timeout=60)
+        # the flight hook dumped, then chained to SIG_DFL: death BY SIGNAL
+        assert worker.returncode == -signal.SIGTERM, worker.returncode
+        files = glob.glob(os.path.join(model_dir, "debug",
+                                       "flight_*.jsonl"))
+        assert files, "killed worker left no flight file"
+        kinds = [e["kind"] for e in flightrec.load(files[0])]
+        assert "worker_alive" in kinds and "sigterm" in kinds
+        assert kinds[-1] == "dump"
+
+        while time.time() < deadline:
+            body = scrape()
+            if 'tfde_cluster_host_up{host="1"} 0' in body:
+                break
+            time.sleep(0.2)
+        assert 'tfde_cluster_host_up{host="1"} 0' in body
+        assert "tfde_cluster_hosts_stale 1" in body
+
+        with open(stop_file, "w") as f:
+            f.write("x")
+        out, err = chief.communicate(timeout=60)
+        assert chief.returncode == 0, err[-3000:]
+        res = json.loads(out.strip().splitlines()[-1])
+        assert res["hosts_stale"] == 1 and res["stale_hosts"] == [1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()

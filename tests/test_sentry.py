@@ -1,0 +1,237 @@
+"""Numerics sentry acceptance: the pure-jnp update() semantics, the host
+poll cadence, the no-extra-dispatch/no-callback jaxpr guarantee for the
+fused train step, and the end-to-end NaN -> sentry trip -> supervisor
+NUMERICS abort path (without hanging)."""
+
+import glob
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tfde_tpu.models.cnn import PlainCNN
+from tfde_tpu.observability import metrics
+from tfde_tpu.observability.sentry import (
+    FLAG_NONFINITE,
+    FLAG_SPIKE,
+    NumericsError,
+    SentryConfig,
+    SentryMonitor,
+    init_state,
+    resolve,
+    update,
+)
+from tfde_tpu.parallel.strategies import MirroredStrategy
+from tfde_tpu.training.step import init_state as init_train_state
+from tfde_tpu.training.step import make_train_step
+
+
+# -- update(): the fused device-side check ------------------------------------
+def test_finite_steps_never_trip():
+    cfg = SentryConfig()
+    s = init_state()
+    for step in range(5):
+        s = update(cfg, s, step, loss=0.5, grad_norm=1.0)
+    assert int(s["flag"]) == 0
+    assert int(s["trip_step"]) == -1
+    assert int(s["count"]) == 5
+
+
+def test_nonfinite_loss_trips_and_trip_step_is_sticky():
+    cfg = SentryConfig()
+    s = init_state()
+    s = update(cfg, s, 0, loss=1.0)
+    s = update(cfg, s, 1, loss=float("nan"))
+    assert int(s["flag"]) & FLAG_NONFINITE
+    assert int(s["trip_step"]) == 1
+    # later trips must NOT move trip_step: the first blow-up is the one
+    # the post-mortem wants
+    s = update(cfg, s, 2, loss=float("inf"))
+    assert int(s["trip_step"]) == 1
+    assert int(s["flag"]) & FLAG_NONFINITE
+
+
+def test_nonfinite_grad_norm_trips():
+    s = update(SentryConfig(), init_state(), 0, loss=1.0,
+               grad_norm=float("inf"))
+    assert int(s["flag"]) & FLAG_NONFINITE
+
+
+def test_grad_spike_trips_only_after_warmup():
+    cfg = SentryConfig(spike_ratio=10.0, warmup_steps=3, ewma_decay=0.5)
+    s = init_state()
+    # a huge first step is NOT a spike: no baseline yet
+    s = update(cfg, s, 0, loss=1.0, grad_norm=100.0)
+    assert int(s["flag"]) == 0
+    s2 = init_state()
+    for step in range(3):  # build the ~1.0 baseline through warmup
+        s2 = update(cfg, s2, step, loss=1.0, grad_norm=1.0)
+    assert int(s2["flag"]) == 0
+    s2 = update(cfg, s2, 3, loss=1.0, grad_norm=100.0)  # 100x the EWMA
+    assert int(s2["flag"]) & FLAG_SPIKE
+    assert int(s2["trip_step"]) == 3
+
+
+def test_nan_grad_does_not_poison_ewma_baseline():
+    cfg = SentryConfig(warmup_steps=1, ewma_decay=0.5)
+    s = init_state()
+    s = update(cfg, s, 0, loss=1.0, grad_norm=2.0)
+    ewma_before = float(s["ewma"])
+    s = update(cfg, s, 1, loss=1.0, grad_norm=float("nan"))
+    assert float(s["ewma"]) == ewma_before  # NaN skipped, baseline intact
+    assert int(s["count"]) == 1             # ...and not counted
+
+
+def test_config_validation_and_resolve_sugar():
+    with pytest.raises(ValueError):
+        SentryConfig(spike_ratio=0.0)
+    with pytest.raises(ValueError):
+        SentryConfig(ewma_decay=1.5)
+    with pytest.raises(ValueError):
+        SentryConfig(poll_every=0)
+    with pytest.raises(ValueError):
+        SentryConfig(action="explode")
+    assert resolve(None) is None
+    assert resolve(False) is None
+    assert isinstance(resolve(True), SentryConfig)
+    cfg = SentryConfig(poll_every=7)
+    assert resolve(cfg) is cfg
+    with pytest.raises(TypeError):
+        resolve("yes")
+
+
+# -- fused step: one dispatch, no callbacks -----------------------------------
+def _fused_step_and_args():
+    strategy = MirroredStrategy()
+    images = np.random.default_rng(0).random((32, 784), np.float32)
+    labels = np.zeros((32, 1), np.int32)
+    state, _ = init_train_state(PlainCNN(), optax.sgd(0.1), strategy,
+                                images)
+    step = make_train_step(strategy, state, sentry=SentryConfig())
+    return step, state, (images, labels), jax.random.key(0), init_state()
+
+
+def test_sentry_step_lowering_has_no_host_callback():
+    """The satellite guarantee: the sentry rides INSIDE the existing jitted
+    step — no pure_callback/io_callback/debug.print sneaks into the
+    program, so there is no per-step host sync."""
+    step, state, batch, rng, sstate = _fused_step_and_args()
+    text = step.lower(state, batch, rng, sstate).as_text()
+    assert "callback" not in text
+    assert "outfeed" not in text
+
+
+def test_sentry_step_executes_and_threads_carry():
+    step, state, batch, rng, sstate = _fused_step_and_args()
+    for i in range(3):
+        state, m, sstate = step(state, batch, rng, sstate)
+    assert int(sstate["flag"]) == 0
+    assert int(sstate["count"]) == 3
+    assert np.isfinite(float(m["loss"]))
+
+
+# -- SentryMonitor: poll cadence + escalation ---------------------------------
+def _tripped_state(step=4):
+    s = init_state()
+    s["flag"] = jnp.asarray(FLAG_NONFINITE, jnp.int32)
+    s["trip_step"] = jnp.asarray(step, jnp.int32)
+    return s
+
+
+def test_monitor_skips_off_cadence_steps():
+    mon = SentryMonitor(SentryConfig(poll_every=5),
+                        registry=metrics.Registry())
+    # flag is set, but step 4 is off-cadence: NO device_get, no escalation
+    assert mon.maybe_poll(_tripped_state(), 4) is None
+    assert mon.trips == 0
+
+
+def test_monitor_raises_on_cadence_with_action_raise():
+    mon = SentryMonitor(SentryConfig(poll_every=5, action="raise"),
+                        registry=metrics.Registry())
+    assert mon.maybe_poll(init_state(), 5) is None  # clean flag: no trip
+    with pytest.raises(NumericsError) as ei:
+        mon.maybe_poll(_tripped_state(step=4), 5)
+    assert ei.value.flag == FLAG_NONFINITE
+    assert ei.value.trip_step == 4
+    assert ei.value.observed_step == 5
+
+
+def test_monitor_warn_action_reports_and_continues():
+    reg = metrics.Registry()
+    mon = SentryMonitor(SentryConfig(poll_every=2, action="warn"),
+                        registry=reg)
+    info = mon.maybe_poll(_tripped_state(step=1), 2)
+    assert info == {"flag": FLAG_NONFINITE, "trip_step": 1,
+                    "observed_step": 2}
+    assert mon.trips == 1
+    assert reg.counter("sentry/trips").value == 1
+    assert reg.gauge("sentry/trip_step").value == 1
+
+
+# -- end to end: NaN at step k -> supervisor NUMERICS abort, no hang ----------
+def test_nan_trips_sentry_and_aborts_supervisor(tmp_path):
+    from tfde_tpu.observability import flightrec
+    from tfde_tpu.resilience.supervisor import (
+        FailureKind,
+        Supervisor,
+        SupervisorAborted,
+        SupervisorConfig,
+        classify_failure,
+    )
+    from tfde_tpu.training.lifecycle import Estimator, RunConfig
+
+    rngd = np.random.default_rng(0)
+    images = rngd.random((32, 784), np.float32)
+    labels = rngd.integers(0, 10, (32, 1)).astype(np.int32)
+
+    def input_fn():
+        def gen():
+            while True:
+                yield (images, labels)
+        return gen()
+
+    def bad_loss(state, params, batch, rng):
+        x, y = batch
+        logits = state.apply_fn({"params": params}, x, train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y[:, 0]).mean()
+        # blows up at step >= 2: deterministic, so a restart from the
+        # pre-NaN checkpoint would replay it — exactly why NUMERICS is
+        # classified non-restartable
+        loss = jnp.where(state.step >= 2, jnp.nan, loss)
+        return loss, {"loss": loss,
+                      "grad_norm": jnp.asarray(0.0, jnp.float32)}
+
+    md = str(tmp_path / "run")
+
+    def factory():
+        return Estimator(
+            PlainCNN(), optax.sgd(0.1), loss_fn=bad_loss,
+            config=RunConfig(model_dir=md, save_checkpoints_steps=None,
+                             save_summary_steps=10_000,
+                             log_step_count_steps=10_000,
+                             sentry=SentryConfig(poll_every=2)),
+        )
+
+    sup = Supervisor(factory, SupervisorConfig(max_restarts=3))
+    with pytest.raises(SupervisorAborted) as ei:
+        sup.run(input_fn, 20)
+
+    cause = ei.value.__cause__
+    assert isinstance(cause, NumericsError)
+    assert classify_failure(cause) is FailureKind.NUMERICS
+    assert sup.restarts == 0  # non-restartable: no retry before the abort
+
+    # the flight ring was dumped on abort and tells the whole story
+    files = glob.glob(md + "/debug/flight_*.jsonl")
+    assert files, "no flight dump after NUMERICS abort"
+    kinds = [e["kind"] for e in flightrec.load(files[0])]
+    assert "sentry_trip" in kinds
+    assert "supervisor_failure" in kinds
+    assert "supervisor_abort" in kinds
+    trip = next(e for e in flightrec.load(files[0])
+                if e["kind"] == "sentry_trip")
+    assert trip["trip_step"] >= 2  # first NaN step, not the poll step

@@ -77,6 +77,9 @@ class CheckpointManager:
             metrics.counter("checkpoint/saves").incr()
             metrics.gauge("checkpoint/latest_saved_step").set(step)
             log.info("checkpoint saved at step %d -> %s", step, self._dir)
+            from tfde_tpu.observability import flightrec
+
+            flightrec.record("ckpt_save", step=step, forced=bool(force))
         return saved
 
     def wait(self) -> None:
@@ -137,6 +140,9 @@ class CheckpointManager:
                 ) from e
             raise
         log.info("restored checkpoint step %d from %s", step, self._dir)
+        from tfde_tpu.observability import flightrec
+
+        flightrec.record("ckpt_restore", step=step)
         return state.replace(
             step=restored["step"],
             params=restored["params"],

@@ -358,12 +358,14 @@ class _BatcherBase:
             done.extend(self.step())
         return done
 
-    def serve_metrics(self, port: int = 0):
+    def serve_metrics(self, port: int = 0, aggregator=None):
         """Start a /metrics endpoint next to this batcher (exposition.py);
-        returns the MetricsServer (read `.port` back when port=0)."""
+        returns the MetricsServer (read `.port` back when port=0). Pass a
+        ClusterAggregator to also accept worker pushes at /push — the
+        multi-host serving deployment's one-scrape fleet view."""
         from tfde_tpu.observability.exposition import serve_metrics
 
-        return serve_metrics(port=port)
+        return serve_metrics(port=port, aggregator=aggregator)
 
     def _publish_stats(self) -> None:
         """Mirror stats() into the metric registry so serving throughput
@@ -449,6 +451,13 @@ class _BatcherBase:
                 with span("serving/prefill"):
                     toks = self._prefill_wave(prompts, last, rows_pad,
                                               plens, n)
+                # admission waves in the flight ring: one event per wave
+                # (not per request), enough to reconstruct the admit/queue
+                # rhythm in a serving post-mortem
+                from tfde_tpu.observability import flightrec
+
+                flightrec.record("admit", rows=n, bucket=int(bucket),
+                                 queue_depth=len(self._queue))
                 now = time.perf_counter()
                 for i, (rid, prompt, budget) in enumerate(group):
                     r = rows[i]

@@ -1,0 +1,326 @@
+"""Cross-host metric aggregation: the distributed half of observability.
+
+The PR-2 layer is strictly per-process — the chief's `/metrics` endpoint
+knows nothing about workers, so a straggling or NaN-producing host is
+invisible until the supervisor's no-progress abort fires. This module makes
+the fleet visible from one scrape:
+
+- **push path** (`MetricsPusher` / `push_once`): non-chief hosts POST a
+  periodic JSON snapshot (`metrics.flatten_snapshot`, so serving stats and
+  resilience counters ride along for free) to the chief's metrics endpoint
+  at ``/push``. Plain stdlib HTTP — no new dependencies, tolerant of a
+  chief that is not up yet (failures are counted, not raised).
+- **chief side** (`ClusterAggregator`): stores each host's latest snapshot
+  with its arrival time, derives a rolling per-host step-time median from
+  the pushed ``train/step`` histogram deltas, and on every rollup exports:
+
+  - ``cluster/hosts_reporting`` / ``cluster/hosts_stale`` gauges,
+  - cluster step-time rollups ``cluster/step_time_{min,median,max}_ms``
+    (min/median/max of the live hosts' rolling medians),
+  - the **straggler detector**: any host whose rolling median exceeds the
+    cluster median by `straggler_factor` flips ``cluster/straggler_host``
+    (host id, -1 when healthy) and ``cluster/straggler_ratio``, and feeds
+    `resilience/health.note_straggler` so the resilience layer sees it;
+  - a dead host (no push within `stale_after`) is excluded from rollups,
+    counted stale, and reported to `resilience/health.note_stale_host`.
+
+- **exposition**: `prometheus_text()` renders every host's scalar snapshot
+  as genuinely *labelled* series (``tfde_train_steps_per_sec{host="1"}``)
+  plus per-host liveness (``tfde_cluster_host_up{host="1"}``), which
+  `MetricsServer` appends to its `/metrics` body — so one chief scrape
+  answers "which host is sick".
+
+Rollups are recomputed on every ingest AND every scrape, so staleness flips
+without waiting for a (never-arriving) push from the dead host.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from tfde_tpu.observability import metrics
+from tfde_tpu.observability.exposition import prom_name
+
+log = logging.getLogger(__name__)
+
+#: step-time histogram the per-host medians are derived from
+STEP_HIST = "train/step"
+
+
+def snapshot_payload(registry: Optional[metrics.Registry] = None,
+                     host: Optional[int] = None) -> dict:
+    """The push body: this process's flattened snapshot plus identity."""
+    from tfde_tpu.observability.flightrec import _host_id
+
+    reg = registry or metrics.default_registry()
+    return {
+        "host": int(_host_id() if host is None else host),
+        "pid": os.getpid(),
+        "ts": time.time(),
+        "metrics": metrics.flatten_snapshot(reg.snapshot()),
+    }
+
+
+def push_once(url: str, registry: Optional[metrics.Registry] = None,
+              host: Optional[int] = None, timeout: float = 2.0) -> bool:
+    """POST one snapshot to the chief's ``/push``. Returns success; never
+    raises — an unreachable chief must not take a worker down with it."""
+    import urllib.request
+
+    body = json.dumps(snapshot_payload(registry, host)).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return 200 <= resp.status < 300
+    except Exception as e:
+        metrics.counter("cluster/push_errors").incr()
+        log.debug("metrics push to %s failed: %s", url, e)
+        return False
+
+
+class MetricsPusher:
+    """Background thread pushing this host's snapshot every `interval`
+    seconds (plus once at stop, so the chief sees the final state)."""
+
+    def __init__(self, url: str, interval: float = 5.0,
+                 registry: Optional[metrics.Registry] = None,
+                 host: Optional[int] = None, timeout: float = 2.0):
+        if interval <= 0:
+            raise ValueError("interval must be positive")
+        self.url = url
+        self.interval = float(interval)
+        self._reg = registry
+        self._host = host
+        self._timeout = timeout
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tfde-metrics-pusher"
+        )
+        self._thread.start()
+        log.info("metrics pusher -> %s every %.1fs", url, self.interval)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            if push_once(self.url, self._reg, self._host, self._timeout):
+                metrics.counter("cluster/pushes").incr()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        # final push: the chief's last view of this host includes everything
+        # up to shutdown (e.g. the final step's serving stats)
+        push_once(self.url, self._reg, self._host, self._timeout)
+
+
+class _Host:
+    """Chief-side record of one pushing host."""
+
+    def __init__(self, window: int):
+        self.flat: Dict[str, float] = {}
+        self.last_push = 0.0
+        self.pushes = 0
+        self.step_samples: collections.deque = collections.deque(maxlen=window)
+        self._prev_sum: Optional[float] = None
+        self._prev_count: Optional[float] = None
+
+    def ingest(self, flat: Dict[str, float], now: float) -> None:
+        self.flat = flat
+        self.last_push = now
+        self.pushes += 1
+        s = flat.get(f"{STEP_HIST}/sum")
+        c = flat.get(f"{STEP_HIST}/count")
+        if s is None or c is None:
+            return
+        if self._prev_sum is not None and c > self._prev_count:
+            # mean step time over the push interval: recency-aware, unlike
+            # the cumulative p50 the histogram itself would report
+            self.step_samples.append(
+                (s - self._prev_sum) / (c - self._prev_count)
+            )
+        elif self._prev_sum is None and c > 0:
+            self.step_samples.append(s / c)
+        self._prev_sum, self._prev_count = s, c
+
+    def median_step(self) -> Optional[float]:
+        if not self.step_samples:
+            return None
+        vals = sorted(self.step_samples)
+        n = len(vals)
+        mid = n // 2
+        return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def _median(vals: List[float]) -> float:
+    vals = sorted(vals)
+    n = len(vals)
+    mid = n // 2
+    return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+class ClusterAggregator:
+    """Chief-side store + rollup engine for pushed host snapshots.
+
+    `include_local` (a host id, usually 0) folds the chief's OWN registry
+    into every rollup as a synthetic push, so cluster medians cover the
+    chief without it HTTP-pushing to itself.
+    """
+
+    def __init__(self,
+                 registry: Optional[metrics.Registry] = None,
+                 straggler_factor: float = 2.0,
+                 stale_after: float = 15.0,
+                 window: int = 32,
+                 include_local: Optional[int] = None,
+                 on_straggler: Optional[Callable[[int, float], None]] = None,
+                 on_stale: Optional[Callable[[int, float], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if straggler_factor <= 1.0:
+            raise ValueError("straggler_factor must be > 1")
+        self._reg = registry or metrics.default_registry()
+        self.straggler_factor = float(straggler_factor)
+        self.stale_after = float(stale_after)
+        self._window = int(window)
+        self._include_local = include_local
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._hosts: Dict[int, _Host] = {}
+        if on_straggler is None or on_stale is None:
+            from tfde_tpu.resilience import health as _health
+
+            on_straggler = on_straggler or _health.note_straggler
+            on_stale = on_stale or _health.note_stale_host
+        self._on_straggler = on_straggler
+        self._on_stale = on_stale
+        self._flagged_straggler: Optional[int] = None
+        self._known_stale: set = set()
+
+    # -- ingest --------------------------------------------------------------
+    def ingest(self, payload: dict) -> None:
+        """Accept one pushed snapshot ({"host", "metrics", ...})."""
+        host = int(payload["host"])
+        flat = payload.get("metrics") or {}
+        now = self._clock()
+        with self._lock:
+            h = self._hosts.setdefault(host, _Host(self._window))
+            h.ingest({k: float(v) for k, v in flat.items()}, now)
+        metrics.counter("cluster/snapshots_received").incr()
+        self.rollup()
+
+    def _ingest_local_locked(self, now: float) -> None:
+        if self._include_local is None:
+            return
+        h = self._hosts.setdefault(self._include_local, _Host(self._window))
+        h.ingest(metrics.flatten_snapshot(self._reg.snapshot()), now)
+
+    # -- rollups -------------------------------------------------------------
+    def rollup(self) -> dict:
+        """Recompute cluster gauges from the current host set; returns the
+        rollup as plain data (the test/obs_dump surface)."""
+        now = self._clock()
+        with self._lock:
+            self._ingest_local_locked(now)
+            hosts = dict(self._hosts)
+        live, stale = {}, {}
+        for hid, h in hosts.items():
+            if now - h.last_push > self.stale_after:
+                stale[hid] = now - h.last_push
+            else:
+                live[hid] = h
+        medians = {hid: m for hid, h in live.items()
+                   if (m := h.median_step()) is not None}
+
+        g = self._reg.gauge
+        g("cluster/hosts_reporting").set(len(live))
+        g("cluster/hosts_stale").set(len(stale))
+        out = {"hosts_reporting": len(live), "hosts_stale": len(stale),
+               "stale_hosts": sorted(stale), "straggler_host": -1,
+               "straggler_ratio": 0.0, "host_medians_ms": {}}
+
+        for hid, age in stale.items():
+            if hid not in self._known_stale:
+                self._known_stale.add(hid)
+                log.warning("cluster: host %d stale (last push %.1fs ago)",
+                            hid, age)
+                try:
+                    self._on_stale(hid, age)
+                except Exception:
+                    log.exception("on_stale callback failed")
+        self._known_stale &= set(stale)  # re-arm when a host comes back
+
+        if medians:
+            cluster_med = _median(list(medians.values()))
+            g("cluster/step_time_min_ms").set(min(medians.values()) * 1e3)
+            g("cluster/step_time_median_ms").set(cluster_med * 1e3)
+            g("cluster/step_time_max_ms").set(max(medians.values()) * 1e3)
+            out["host_medians_ms"] = {
+                hid: m * 1e3 for hid, m in medians.items()
+            }
+            straggler, ratio = -1, 0.0
+            if len(medians) >= 2 and cluster_med > 0:
+                worst = max(medians, key=medians.get)
+                worst_ratio = medians[worst] / cluster_med
+                if worst_ratio > self.straggler_factor:
+                    straggler, ratio = worst, worst_ratio
+            g("cluster/straggler_host").set(straggler)
+            g("cluster/straggler_ratio").set(ratio)
+            out["straggler_host"], out["straggler_ratio"] = straggler, ratio
+            if straggler >= 0 and straggler != self._flagged_straggler:
+                log.warning(
+                    "cluster: host %d straggling (%.1fx the cluster median "
+                    "step time)", straggler, ratio,
+                )
+                try:
+                    self._on_straggler(straggler, ratio)
+                except Exception:
+                    log.exception("on_straggler callback failed")
+            self._flagged_straggler = straggler if straggler >= 0 else None
+        return out
+
+    # -- exposition ----------------------------------------------------------
+    def prometheus_text(self, prefix: str = "tfde_") -> str:
+        """Per-host labelled series appended to the chief's /metrics body:
+        every pushed scalar as ``<name>{host="<id>"}`` plus liveness/age."""
+        now = self._clock()
+        with self._lock:
+            hosts = {hid: (dict(h.flat), h.last_push)
+                     for hid, h in self._hosts.items()}
+        lines = []
+        for hid in sorted(hosts):
+            flat, last_push = hosts[hid]
+            age = now - last_push
+            up = 0 if age > self.stale_after else 1
+            lines.append(f'{prefix}cluster_host_up{{host="{hid}"}} {up}')
+            lines.append(
+                f'{prefix}cluster_host_age_seconds{{host="{hid}"}} {age:.3f}'
+            )
+            for name in sorted(flat):
+                lines.append(
+                    f'{prom_name(name, prefix)}{{host="{hid}"}} '
+                    f'{float(flat[name])!r}'
+                )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def hosts(self) -> Dict[int, dict]:
+        """{host: {"age": s, "pushes": n, "median_step_ms": ms|None}} —
+        the obs_dump/debugging surface."""
+        now = self._clock()
+        with self._lock:
+            return {
+                hid: {
+                    "age": now - h.last_push,
+                    "pushes": h.pushes,
+                    "median_step_ms": (
+                        m * 1e3 if (m := h.median_step()) is not None else None
+                    ),
+                }
+                for hid, h in self._hosts.items()
+            }

@@ -208,14 +208,23 @@ class MetricsServer:
     """Chief-only scrape endpoint: `/metrics` (Prometheus text),
     `/metrics.json` (flattened snapshot), `/healthz`. Runs a
     ThreadingHTTPServer in a daemon thread; `port=0` binds an ephemeral
-    port (read it back from `.port` — the test/bench pattern)."""
+    port (read it back from `.port` — the test/bench pattern).
+
+    With an `aggregator` (observability/aggregate.ClusterAggregator)
+    attached, `POST /push` ingests worker snapshots and `/metrics` appends
+    the aggregator's host-labelled series + cluster rollups — each scrape
+    re-runs the rollup, so a dead host's staleness gauge flips even though
+    it will never push again."""
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
-                 registry: Optional[metrics.Registry] = None):
+                 registry: Optional[metrics.Registry] = None,
+                 aggregator=None):
         import http.server
 
         reg = registry or metrics.default_registry()
         self._reg = reg
+        self.aggregator = aggregator
+        outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             server_version = "tfde-metrics"
@@ -229,10 +238,17 @@ class MetricsServer:
 
             def do_GET(self):  # noqa: N802 (http.server API)
                 try:
+                    agg = outer.aggregator
                     if self.path.split("?")[0] == "/metrics":
-                        body = to_prometheus_text(registry=reg).encode()
-                        self._send(200, body, PROM_CONTENT_TYPE)
+                        if agg is not None:
+                            agg.rollup()  # staleness flips on scrape too
+                        body = to_prometheus_text(registry=reg)
+                        if agg is not None:
+                            body += agg.prometheus_text()
+                        self._send(200, body.encode(), PROM_CONTENT_TYPE)
                     elif self.path.split("?")[0] == "/metrics.json":
+                        if agg is not None:
+                            agg.rollup()
                         flat = metrics.flatten_snapshot(reg.snapshot())
                         body = json.dumps(flat, sort_keys=True).encode()
                         self._send(200, body, "application/json")
@@ -243,10 +259,41 @@ class MetricsServer:
                 except BrokenPipeError:  # scraper went away mid-response
                     pass
 
+            def do_POST(self):  # noqa: N802 (http.server API)
+                try:
+                    agg = outer.aggregator
+                    if self.path.split("?")[0] != "/push" or agg is None:
+                        self._send(404, b"not found\n", "text/plain")
+                        return
+                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        payload = json.loads(self.rfile.read(n))
+                        agg.ingest(payload)
+                    except (ValueError, KeyError, TypeError) as e:
+                        self._send(400, f"bad push: {e}\n".encode(),
+                                   "text/plain")
+                        return
+                    self._send(200, b"ok\n", "text/plain")
+                except BrokenPipeError:
+                    pass
+
             def log_message(self, fmt, *args):  # scrapes are not log lines
                 log.debug("metrics server: " + fmt, *args)
 
-        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        try:
+            self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        except OSError as e:
+            if port == 0:
+                raise
+            # A configured port that is already bound (a stale process, a
+            # port-sharing collision on one box) must not crash chief
+            # startup — fall back to an ephemeral port and say so loudly.
+            log.warning(
+                "metrics port %d unavailable (%s); falling back to an "
+                "ephemeral port — read it back from MetricsServer.port",
+                port, e,
+            )
+            self._httpd = http.server.ThreadingHTTPServer((host, 0), Handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
@@ -263,7 +310,9 @@ class MetricsServer:
 
 
 def serve_metrics(port: int = 0, host: str = "0.0.0.0",
-                  registry: Optional[metrics.Registry] = None) -> MetricsServer:
+                  registry: Optional[metrics.Registry] = None,
+                  aggregator=None) -> MetricsServer:
     """Convenience: start a MetricsServer over the default registry — the
     one-liner an inference deployment calls next to its batcher."""
-    return MetricsServer(port=port, host=host, registry=registry)
+    return MetricsServer(port=port, host=host, registry=registry,
+                         aggregator=aggregator)

@@ -131,6 +131,26 @@ class StepWindowProfiler:
     def enabled(self) -> bool:
         return self._window is not None
 
+    def arm(self, start_step: int, span: int = 10) -> bool:
+        """Arm a one-shot window [start_step, start_step+span) at runtime —
+        the numerics sentry's auto-capture hook (observability/sentry.py):
+        on a trip it arms the next `span` steps so the blow-up's immediate
+        aftermath lands on an XProf timeline. Refuses (returns False) when
+        a window is already configured/active or there is no usable logdir,
+        so auto-capture never clobbers an operator-requested trace."""
+        if self._window is not None or self._active or self._logdir is None:
+            return False
+        from tfde_tpu.utils import fs
+
+        if fs.is_remote(self._logdir):
+            return False  # same limit as __init__: local trace dirs only
+        if span < 1:
+            raise ValueError("span must be >= 1")
+        self._window = (int(start_step), int(start_step) + int(span))
+        log.info("profiler: auto-armed window [%d, %d) -> %s/plugins/profile",
+                 self._window[0], self._window[1], self._logdir)
+        return True
+
     def _in_window(self, step: int) -> bool:
         if self._window[0] == "every":
             _, n, span = self._window

@@ -1,0 +1,213 @@
+"""Device-resident numerics sentry: catch NaN/Inf and gradient blow-ups
+without paying a per-step host sync.
+
+The naive guard — `if not np.isfinite(loss): ...` every step — forces a
+device->host transfer per step, serializing the async dispatch pipeline the
+whole training loop is built around. The sentry instead keeps its state ON
+DEVICE and fuses the check into the already-compiled train step
+(training/step.py threads it through when a `SentryConfig` is passed):
+
+- ``isfinite(loss)`` and ``isfinite(grad_norm)`` — a NaN/Inf anywhere in
+  the update poisons these first;
+- a gradient-norm EWMA spike ratio: after `warmup_steps` finite samples,
+  ``grad_norm > spike_ratio * ewma`` flags a divergence while the loss
+  still looks plausible;
+- trips accumulate into a sticky device flag (with the first trip's step),
+  so the host can poll **every `poll_every` steps** — one tiny transfer per
+  window, zero extra dispatches, and a trip anywhere inside the window is
+  still caught with its original step number.
+
+On a host-observed trip, `SentryMonitor.on_trip`:
+1. records a flight-recorder event (observability/flightrec.py) — the
+   post-mortem exists even if the escalation path itself dies;
+2. optionally arms a bounded auto `jax.profiler` capture via
+   `StepWindowProfiler.arm()` (profile_span > 0 + action='warn'), so the
+   steps right after the trip land on an XProf timeline;
+3. escalates: action='raise' raises `NumericsError`, which the supervisor
+   classifies as FailureKind.NUMERICS and aborts — restarting from the
+   pre-NaN checkpoint would deterministically replay the blow-up, so a
+   numerics trip is poison with a better error message.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+import jax.numpy as jnp
+
+from tfde_tpu.observability import flightrec, metrics
+
+log = logging.getLogger(__name__)
+
+#: sticky flag bits
+FLAG_NONFINITE = 1  # loss or grad_norm was NaN/Inf
+FLAG_SPIKE = 2      # grad_norm exceeded spike_ratio x EWMA post-warmup
+
+
+class NumericsError(RuntimeError):
+    """A sentry trip escalated by action='raise'. The supervisor maps this
+    to FailureKind.NUMERICS (non-restartable: the blow-up replays from the
+    checkpoint)."""
+
+    def __init__(self, flag: int, trip_step: int, observed_step: int):
+        kinds = []
+        if flag & FLAG_NONFINITE:
+            kinds.append("non-finite loss/grad_norm")
+        if flag & FLAG_SPIKE:
+            kinds.append("grad-norm spike")
+        super().__init__(
+            f"numerics sentry tripped at step {trip_step} "
+            f"({' + '.join(kinds) or f'flag {flag}'}; "
+            f"observed at host poll, step {observed_step})"
+        )
+        self.flag = flag
+        self.trip_step = trip_step
+        self.observed_step = observed_step
+
+
+@dataclasses.dataclass(frozen=True)
+class SentryConfig:
+    """Knobs for the fused check + the host poll cadence."""
+
+    #: grad_norm > spike_ratio * EWMA(grad_norm) trips FLAG_SPIKE
+    spike_ratio: float = 10.0
+    #: EWMA decay (per step) for the grad-norm baseline
+    ewma_decay: float = 0.99
+    #: finite grad-norm samples before the spike check arms (early training
+    #: is legitimately spiky)
+    warmup_steps: int = 20
+    #: host polls the device flag every this many steps (the ONLY added
+    #: device->host transfer; a trip is observed at most poll_every-1 steps
+    #: after it happened, with the true trip step preserved on device)
+    poll_every: int = 25
+    #: on trip, arm a StepWindowProfiler capture of this many steps
+    #: (0 = off). Only useful with action='warn' — a raise unwinds first.
+    profile_span: int = 0
+    #: 'raise' escalates NumericsError to the supervisor; 'warn' logs,
+    #: records, and keeps training (the flag re-arms so each new window's
+    #: first trip is reported once)
+    action: str = "raise"
+
+    def __post_init__(self):
+        if self.poll_every < 1:
+            raise ValueError("poll_every must be >= 1")
+        if self.spike_ratio <= 1.0:
+            raise ValueError("spike_ratio must be > 1")
+        if not 0.0 < self.ewma_decay < 1.0:
+            raise ValueError("ewma_decay must be in (0, 1)")
+        if self.action not in ("raise", "warn"):
+            raise ValueError(f"unknown sentry action {self.action!r}")
+
+
+def init_state() -> dict:
+    """Fresh device-side sentry carry (replicated scalars)."""
+    return {
+        "flag": jnp.zeros((), jnp.int32),
+        "trip_step": jnp.full((), -1, jnp.int32),
+        "ewma": jnp.zeros((), jnp.float32),
+        "count": jnp.zeros((), jnp.int32),
+    }
+
+
+def update(cfg: SentryConfig, sstate: dict, step, loss,
+           grad_norm=None) -> dict:
+    """The fused per-step check: pure jnp, traced INSIDE the train step —
+    no extra dispatch, no host callback (tests assert the jaxpr stays
+    callback-free). Returns the next sentry carry."""
+    step = jnp.asarray(step, jnp.int32)
+    loss = jnp.asarray(loss, jnp.float32)
+    bits = jnp.where(jnp.isfinite(loss), 0, FLAG_NONFINITE).astype(jnp.int32)
+    ewma, count = sstate["ewma"], sstate["count"]
+    if grad_norm is not None:
+        g = jnp.asarray(grad_norm, jnp.float32)
+        finite = jnp.isfinite(g)
+        bits = bits | jnp.where(finite, 0, FLAG_NONFINITE)
+        spike = (
+            (count >= cfg.warmup_steps)
+            & finite
+            & (g > cfg.spike_ratio * jnp.maximum(ewma, 1e-30))
+        )
+        bits = bits | jnp.where(spike, FLAG_SPIKE, 0)
+        # EWMA over finite samples only — one NaN must not poison the
+        # baseline the recovery (action='warn') keeps comparing against
+        new_ewma = jnp.where(
+            finite,
+            jnp.where(count == 0, g,
+                      cfg.ewma_decay * ewma + (1.0 - cfg.ewma_decay) * g),
+            ewma,
+        )
+        ewma = new_ewma
+        count = count + jnp.where(finite, 1, 0)
+    first_trip = (bits != 0) & (sstate["flag"] == 0)
+    return {
+        "flag": sstate["flag"] | bits,
+        "trip_step": jnp.where(first_trip, step, sstate["trip_step"]),
+        "ewma": ewma,
+        "count": count,
+    }
+
+
+class SentryMonitor:
+    """Host-side poller. Owns the poll cadence and the trip escalation;
+    the device state itself threads through the compiled step."""
+
+    def __init__(self, cfg: SentryConfig, profiler=None,
+                 registry: Optional[metrics.Registry] = None):
+        self.cfg = cfg
+        self.profiler = profiler
+        self._reg = registry or metrics.default_registry()
+        self.trips = 0
+
+    def maybe_poll(self, sstate: dict, step: int) -> Optional[dict]:
+        """Call once per completed step with the post-increment step; polls
+        the device flag every cfg.poll_every steps (one scalar device_get —
+        the sentry's entire host cost). Returns the trip info dict when a
+        trip was observed, else None. Raises NumericsError when
+        cfg.action == 'raise'."""
+        if step % self.cfg.poll_every:
+            return None
+        import jax
+
+        flag = int(jax.device_get(sstate["flag"]))
+        if not flag:
+            return None
+        trip_step = int(jax.device_get(sstate["trip_step"]))
+        return self.on_trip(flag, trip_step, step)
+
+    def on_trip(self, flag: int, trip_step: int, step: int) -> dict:
+        self.trips += 1
+        self._reg.counter("sentry/trips").incr()
+        self._reg.gauge("sentry/tripped_flag").set(flag)
+        self._reg.gauge("sentry/trip_step").set(trip_step)
+        info = {"flag": flag, "trip_step": trip_step, "observed_step": step}
+        # flight event FIRST: the record must exist even if escalation
+        # (or anything above us on the stack) dies before the dump hook
+        flightrec.record("sentry_trip", **info)
+        log.error(
+            "numerics sentry tripped: flag=%d at step %d (observed at "
+            "step %d)", flag, trip_step, step,
+        )
+        if self.cfg.profile_span > 0 and self.profiler is not None:
+            armed = self.profiler.arm(step + 1, self.cfg.profile_span)
+            if armed:
+                flightrec.record("sentry_profile_armed", start=step + 1,
+                                 span=self.cfg.profile_span)
+        if self.cfg.action == "raise":
+            raise NumericsError(flag, trip_step, step)
+        return info
+
+
+def resolve(sentry) -> Optional[SentryConfig]:
+    """RunConfig.sentry sugar: None/False -> off, True -> defaults, a
+    SentryConfig passes through."""
+    if sentry is None or sentry is False:
+        return None
+    if sentry is True:
+        return SentryConfig()
+    if isinstance(sentry, SentryConfig):
+        return sentry
+    raise TypeError(
+        f"sentry must be None/bool/SentryConfig, got {type(sentry).__name__}"
+    )

@@ -18,6 +18,13 @@ Metrics exported through the observability registry:
 - ``resilience/heartbeats``            counter — total beats (rate ~ steps/sec)
 - ``resilience/last_step``             gauge — step of the latest beat
 - ``resilience/heartbeat_age_seconds`` gauge — staleness at last watchdog poll
+
+Cluster-level health (fed by observability/aggregate.py's straggler and
+staleness detectors on the chief):
+- ``resilience/stragglers_detected``   counter — straggler flaggings
+- ``resilience/straggler_host``        gauge — slowest flagged host (-1 ok)
+- ``resilience/straggler_ratio``       gauge — its median / cluster median
+- ``resilience/stale_hosts_detected``  counter — hosts gone silent
 """
 
 from __future__ import annotations
@@ -52,6 +59,31 @@ def _default_escalation() -> None:
     """Checkpoint-and-exit: SIGTERM self, landing in the preemption guard's
     force-save path (resilience/preemption.py)."""
     os.kill(os.getpid(), _signal.SIGTERM)
+
+
+def note_straggler(host: int, ratio: float) -> None:
+    """Chief-side sink for the cluster straggler detector
+    (observability/aggregate.py): a host's rolling step-time median exceeds
+    the cluster median by the configured factor. Exported as resilience
+    gauges so dashboards and the supervisor's TB export see it, and
+    recorded in the flight ring for post-mortems."""
+    counters.incr("resilience/stragglers_detected")
+    metrics.gauge("resilience/straggler_host").set(host)
+    metrics.gauge("resilience/straggler_ratio").set(ratio)
+    from tfde_tpu.observability import flightrec
+
+    flightrec.record("straggler", host=int(host), ratio=float(ratio))
+
+
+def note_stale_host(host: int, age_seconds: float) -> None:
+    """Chief-side sink for the dead-host detector: a host stopped pushing
+    snapshots. Liveness itself is per-host (the scheduler's job); this is
+    the fleet-view breadcrumb."""
+    counters.incr("resilience/stale_hosts_detected")
+    from tfde_tpu.observability import flightrec
+
+    flightrec.record("stale_host", host=int(host),
+                     age_seconds=round(float(age_seconds), 3))
 
 
 @dataclasses.dataclass
@@ -119,10 +151,17 @@ class Heartbeat:
             return self
         poll = poll_secs if poll_secs is not None else max(0.1, self.stall_timeout_secs / 10.0)
 
+        from tfde_tpu.observability import flightrec
+
         def run():
             while not self._stop.wait(poll):
                 a = self.age()
                 metrics.gauge("resilience/heartbeat_age_seconds").set(a)
+                # watchdog-cadence health beats in the flight ring: cheap
+                # (one event per poll, not per step) and exactly the "was it
+                # alive, was it progressing" trail a post-mortem wants
+                flightrec.record("health_beat", age_seconds=round(a, 3),
+                                 last_step=self.last_step)
                 if a > self.stall_timeout_secs:
                     if not self._stalled:
                         self._stalled = True
@@ -131,6 +170,8 @@ class Heartbeat:
                             "stall detected: no progress for %.1fs (last "
                             "step %s); escalating", a, self.last_step,
                         )
+                        flightrec.record("stall", age_seconds=round(a, 3),
+                                         last_step=self.last_step)
                         try:
                             self.on_stall()
                         except Exception:

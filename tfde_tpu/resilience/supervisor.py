@@ -9,9 +9,11 @@ restart loop:
 
 - **classify**: a failure is a PREEMPTION (signal, checkpoint already
   committed by the guard), TRANSIENT (I/O blip that outlived the retry
-  policy's budget), a STALL (watchdog escalation), or POISON (deterministic
+  policy's budget), a STALL (watchdog escalation), POISON (deterministic
   error — an assertion, a shape mismatch — that would recur on every
-  restart and must abort);
+  restart and must abort), or NUMERICS (a sentry-reported NaN/blow-up —
+  poison with a better error message: the replayed steps are
+  deterministic, so restarting from the pre-NaN checkpoint re-trips);
 - **restart**: restartable kinds rebuild a fresh Estimator from the
   factory; resume-by-default restores the latest *committed* step, so the
   restart replays at most save_checkpoints_steps-1 steps;
@@ -62,13 +64,21 @@ class FailureKind(enum.Enum):
     TRANSIENT = "transient"
     STALL = "stall"
     POISON = "poison"
+    #: numerics-sentry trip (observability/sentry.py NumericsError).
+    #: Non-restartable like POISON: resume-by-default restores the pre-NaN
+    #: checkpoint and the blow-up deterministically replays.
+    NUMERICS = "numerics"
 
 
 def classify_failure(exc: BaseException) -> FailureKind:
     """Map a failure to its restart semantics. KeyboardInterrupt is NOT
     classified here — operator intent aborts before classification."""
+    from tfde_tpu.observability.sentry import NumericsError
+
     if isinstance(exc, Preempted):
         return FailureKind.PREEMPTION
+    if isinstance(exc, NumericsError):
+        return FailureKind.NUMERICS
     if isinstance(exc, StallError):
         return FailureKind.STALL
     if isinstance(exc, RetryBudgetExceeded):
@@ -78,6 +88,11 @@ def classify_failure(exc: BaseException) -> FailureKind:
     if isinstance(exc, (OSError, TimeoutError, ConnectionError, TransientError)):
         return FailureKind.TRANSIENT
     return FailureKind.POISON
+
+
+#: kinds the supervisor refuses to restart: the failure replays from the
+#: restored checkpoint, so a restart is a slower way to fail again
+_NON_RESTARTABLE = (FailureKind.POISON, FailureKind.NUMERICS)
 
 
 class SupervisorAborted(RuntimeError):
@@ -183,6 +198,18 @@ class Supervisor:
 
         return wrapped
 
+    @staticmethod
+    def _abort_dump(flightrec, kind: FailureKind) -> None:
+        """Flush the flight ring before SupervisorAborted unwinds — the
+        abort is the post-mortem moment; without this the ring's last
+        window (the trip, the failed restarts) dies with the process if
+        nothing above catches the abort."""
+        try:
+            flightrec.record("supervisor_abort", failure_kind=kind.value)
+            flightrec.dump("supervisor_abort")
+        except Exception:
+            log.debug("flight dump on abort failed", exc_info=True)
+
     def _export(self, est, step: int) -> None:
         """Chief-side metric export as TensorBoard scalars next to the
         run's curves — the resilience counters plus the run-level goodput
@@ -253,16 +280,25 @@ class Supervisor:
                     counters.incr("resilience/lost_steps", lost)
                 counters.incr(f"resilience/failures_{kind.value}")
                 self.last_failure = e
+                from tfde_tpu.observability import flightrec
 
-                if kind is FailureKind.POISON:
-                    log.error("poison failure (%s: %s); aborting run",
-                              type(e).__name__, e)
+                flightrec.record(
+                    "supervisor_failure", failure_kind=kind.value,
+                    error=f"{type(e).__name__}: {e}",
+                    committed_step=committed, restarts=self.restarts,
+                )
+
+                if kind in _NON_RESTARTABLE:
+                    log.error("%s failure (%s: %s); aborting run",
+                              kind.value, type(e).__name__, e)
+                    self._abort_dump(flightrec, kind)
                     raise SupervisorAborted(
                         f"non-restartable failure after {self.restarts} "
                         f"restart(s): {type(e).__name__}: {e}",
                         restarts=self.restarts,
                     ) from e
                 if self.restarts >= cfg.max_restarts:
+                    self._abort_dump(flightrec, kind)
                     raise SupervisorAborted(
                         f"restart budget ({cfg.max_restarts}) exhausted; "
                         f"last failure: {type(e).__name__}: {e}",
@@ -278,6 +314,7 @@ class Supervisor:
                     no_progress = 0
                 committed_before = committed
                 if no_progress >= cfg.no_progress_limit:
+                    self._abort_dump(flightrec, kind)
                     raise SupervisorAborted(
                         f"no checkpoint progress across {no_progress} "
                         f"consecutive restarts (stuck at step {committed}); "
@@ -287,6 +324,9 @@ class Supervisor:
 
                 self.restarts += 1
                 counters.incr("resilience/restarts")
+                flightrec.record("supervisor_restart", attempt=self.restarts,
+                                 from_step=committed,
+                                 failure_kind=kind.value)
                 delay = cfg.restart_policy.backoff(self.restarts, self._rng)
                 # backoff sleep is pure restart tax — the goodput ledger
                 # reads this back as part of restart_loss

@@ -189,6 +189,38 @@ def coordinator_endpoint(coord: str, default_port: int = 8476) -> str:
     return f"{host}:{port}"
 
 
+def metrics_push_url(info: Optional[ClusterInfo] = None,
+                     port: Optional[int] = None) -> Optional[str]:
+    """Where a non-chief host pushes metric snapshots
+    (observability/aggregate.MetricsPusher), derived from the same spec
+    that placed the chief:
+
+    - ``TFDE_METRICS_PUSH_URL`` wins outright (explicit endpoint —
+      required when the chief's server fell back to an ephemeral port);
+    - else the coordinator's *host* + ``TFDE_METRICS_PORT``/`port` — the
+      chief runs next to the jax.distributed coordinator, and its metrics
+      server listens on the port every process already agrees on.
+
+    Returns None when neither is derivable (single-process, or no fixed
+    metrics port configured) — callers treat that as "pushing disabled".
+    """
+    env = os.environ.get("TFDE_METRICS_PUSH_URL")
+    if env:
+        return env
+    if port is None:
+        raw = os.environ.get("TFDE_METRICS_PORT", "")
+        port = int(raw) if raw else None
+    if not port:  # None or 0 (ephemeral): workers can't guess the binding
+        return None
+    info = info or resolve_cluster()
+    if not info.is_distributed or not info.coordinator_address:
+        return None
+    coord = info.coordinator_address
+    tail = coord.rsplit("]")[-1]  # IPv6-bracket aware, like coordinator_endpoint
+    host = coord.rsplit(":", 1)[0] if ":" in tail else coord
+    return f"http://{host}:{port}/push"
+
+
 def bootstrap(coordinator_port: int = 8476) -> ClusterInfo:
     """Resolve the cluster and initialize `jax.distributed` if multi-process.
 
@@ -239,4 +271,10 @@ def bootstrap(coordinator_port: int = 8476) -> ClusterInfo:
             counter="resilience/bootstrap_retries",
         )
         _INITIALIZED = True
+        from tfde_tpu.observability import flightrec
+
+        flightrec.record(
+            "bootstrap", num_processes=info.num_processes,
+            process_id=info.process_id, coordinator=coord,
+        )
     return info

@@ -36,7 +36,8 @@ from tfde_tpu.checkpoint.manager import CheckpointManager
 from tfde_tpu.data.device import device_prefetch
 from tfde_tpu.resilience.preemption import PreemptionGuard as _PreemptionGuard
 from tfde_tpu.data.pipeline import AutoShardPolicy
-from tfde_tpu.observability import exposition, metrics
+from tfde_tpu.observability import aggregate, exposition, flightrec, metrics
+from tfde_tpu.observability import sentry as sentry_lib
 from tfde_tpu.observability.goodput import GoodputLedger
 from tfde_tpu.observability.profiler import StepWindowProfiler
 from tfde_tpu.observability.spans import record, span
@@ -84,8 +85,20 @@ class RunConfig:
     seed: int = 0
     # Chief-only HTTP /metrics endpoint (observability/exposition.py):
     # 0 binds an ephemeral port (read estimator.metrics_server.port back),
-    # None defers to $TFDE_METRICS_PORT (unset = no server).
+    # None defers to $TFDE_METRICS_PORT (unset = no server). The chief's
+    # server carries a ClusterAggregator, so worker pushes (below) show up
+    # host-labelled in one scrape with straggler/staleness rollups.
     metrics_port: Optional[int] = None
+    # Non-chief hosts POST periodic snapshots here (".../push"). None
+    # derives it from the cluster spec: $TFDE_METRICS_PUSH_URL wins, else
+    # the coordinator host + $TFDE_METRICS_PORT (runtime/cluster.py).
+    metrics_push_url: Optional[str] = None
+    metrics_push_interval: float = 5.0
+    # Device-resident numerics sentry (observability/sentry.py):
+    # None/False off, True = SentryConfig() defaults, or a SentryConfig.
+    # Fused into the compiled train step — no extra dispatch; a NaN/Inf or
+    # grad-norm blow-up raises NumericsError at the next poll window.
+    sentry: Any = None
 
 
 @dataclasses.dataclass
@@ -174,6 +187,8 @@ class Estimator:
         self._writers: dict[str, SummaryWriter] = {}
         self._metrics_srv: Optional[exposition.MetricsServer] = None
         self._metrics_log: Optional[exposition.JsonlMetricsLog] = None
+        self._aggregator: Optional[aggregate.ClusterAggregator] = None
+        self._pusher: Optional[aggregate.MetricsPusher] = None
 
     # -- internals -----------------------------------------------------------
     @property
@@ -203,8 +218,32 @@ class Estimator:
             env = os.environ.get("TFDE_METRICS_PORT", "")
             port = int(env) if env else None
         if port is not None:
-            self._metrics_srv = exposition.MetricsServer(port=port)
+            # include_local=0 folds the chief's own registry into every
+            # rollup as host 0, so cluster medians cover the chief without
+            # it HTTP-pushing to itself; single-process runs just see a
+            # one-host "cluster"
+            self._aggregator = aggregate.ClusterAggregator(include_local=0)
+            self._metrics_srv = exposition.MetricsServer(
+                port=port, aggregator=self._aggregator
+            )
         return self._metrics_srv
+
+    def _ensure_metrics_pusher(self) -> Optional[aggregate.MetricsPusher]:
+        """Non-chief: start the periodic snapshot push to the chief's
+        /push endpoint, if a push URL is configured or derivable."""
+        if self._pusher is not None or self._is_chief:
+            return self._pusher
+        url = self.config.metrics_push_url
+        if url is None:
+            from tfde_tpu.runtime import cluster
+
+            url = cluster.metrics_push_url()
+        if url:
+            self._pusher = aggregate.MetricsPusher(
+                url, interval=self.config.metrics_push_interval,
+                host=jax.process_index(),
+            )
+        return self._pusher
 
     def _ensure_metrics_log(self) -> Optional[exposition.JsonlMetricsLog]:
         """Chief-only JSONL snapshot log under <model_dir>/metrics/."""
@@ -334,6 +373,13 @@ class Estimator:
         cfg = self.config
         ledger = GoodputLedger()  # baseline first: init counts toward wall
         self._ensure_metrics_server()
+        self._ensure_metrics_pusher()
+        scfg = sentry_lib.resolve(cfg.sentry)
+        if cfg.model_dir is not None:
+            # arm BEFORE the PreemptionGuard below: the guard saves this
+            # handler as "previous", so after the guard's force-save commits
+            # and the signal re-raises, the ring dumps on the way out
+            flightrec.arm(cfg.model_dir)
         with span("train/init"):
             host_iter = iter(input_fn())
             first = next(host_iter)
@@ -353,16 +399,17 @@ class Estimator:
                         make_lora_loss(self._lora_base,
                                        self.loss_fn or _classification_loss,
                                        self.lora),
-                        grad_accum=self.grad_accum,
+                        grad_accum=self.grad_accum, sentry=scfg,
                     )
                 elif self.loss_fn is not None:
                     self._train_step = make_custom_train_step(
                         self.strategy, state, self.loss_fn,
-                        grad_accum=self.grad_accum,
+                        grad_accum=self.grad_accum, sentry=scfg,
                     )
                 else:
                     self._train_step = make_train_step(
-                        self.strategy, state, grad_accum=self.grad_accum
+                        self.strategy, state, grad_accum=self.grad_accum,
+                        sentry=scfg,
                     )
 
         rng = jax.random.key(cfg.seed + 1)
@@ -384,6 +431,15 @@ class Estimator:
                                    wait_metric="train/data_wait")
             mlog = self._ensure_metrics_log()
             ops_writer = self._writer("ops") if writer is not None else None
+            # sentry carry lives ON DEVICE; the monitor polls one scalar
+            # every poll_every steps — the sentry's entire host-side cost
+            monitor = (sentry_lib.SentryMonitor(scfg, profiler=profiler)
+                       if scfg is not None else None)
+            sstate = sentry_lib.init_state() if scfg is not None else None
+        flightrec.record("train_start", start_step=start_step,
+                         max_steps=max_steps,
+                         resumed=bool(self._from_checkpoint),
+                         sentry=scfg is not None)
         last_metrics = None
         compiled = False  # first step = trace+compile+execute, timed apart
         t_window = time.perf_counter()
@@ -414,20 +470,31 @@ class Estimator:
                     # the first steps/sec window (both were poisoned by it
                     # before)
                     t0 = time.perf_counter()
-                    state, last_metrics = self._train_step(state, batch, rng)
+                    if sstate is not None:
+                        state, last_metrics, sstate = self._train_step(
+                            state, batch, rng, sstate)
+                    else:
+                        state, last_metrics = self._train_step(
+                            state, batch, rng)
                     jax.block_until_ready(last_metrics)
                     compile_s = time.perf_counter() - t0
                     iter_overhead += compile_s
                     compiled = True
                     metrics.counter("train/compile_seconds").incr(compile_s)
                     log.info("first step (compile): %.2fs", compile_s)
+                    flightrec.record("compile", seconds=round(compile_s, 3),
+                                     step=step + 1)
                     if writer is not None:
                         writer.scalars(step + 1,
                                        {"compile_seconds": compile_s})
                 else:
                     with span("train/dispatch"):
-                        state, last_metrics = self._train_step(
-                            state, batch, rng)
+                        if sstate is not None:
+                            state, last_metrics, sstate = self._train_step(
+                                state, batch, rng, sstate)
+                        else:
+                            state, last_metrics = self._train_step(
+                                state, batch, rng)
                 # keep the live reference fresh: the previous state's
                 # buffers were donated to the step, so a stale self._state
                 # would reference deleted arrays if train() is interrupted
@@ -439,6 +506,13 @@ class Estimator:
                     t_window = time.perf_counter()
                     window_step = step
                 profiler.step(step)
+                if monitor is not None:
+                    # polls the device flag every poll_every steps; raises
+                    # NumericsError (action='raise') which unwinds through
+                    # the guard to the supervisor as FailureKind.NUMERICS —
+                    # before this step's summary/checkpoint below, so no
+                    # post-NaN state is written
+                    monitor.maybe_poll(sstate, step)
                 if writer is not None and step % cfg.save_summary_steps == 0:
                     t_sync = time.perf_counter()
                     with span("train/device_sync"):
@@ -466,6 +540,8 @@ class Estimator:
                     if writer is not None:
                         writer.scalars(step, {"global_step/sec": sps})
                     log.info("step %d: %.2f steps/sec", step, sps)
+                    flightrec.record("step", step=step,
+                                     steps_per_sec=round(sps, 3))
                     t_window = time.perf_counter()
                     window_step = step
                     excluded = 0.0
@@ -485,6 +561,10 @@ class Estimator:
 
             self._state = state
             profiler.close()
+            flightrec.record(
+                "train_end", step=step,
+                preempted=(None if guard.fired is None else int(guard.fired)),
+            )
             if mngr is not None:
                 # also the preemption save: on a caught SIGTERM/SIGINT the
                 # loop broke out and this force-save + wait commits the
@@ -712,9 +792,13 @@ class Estimator:
         if self._metrics_log is not None:
             self._metrics_log.close()
             self._metrics_log = None
+        if self._pusher is not None:
+            self._pusher.close()  # final push: chief sees the end state
+            self._pusher = None
         if self._metrics_srv is not None:
             self._metrics_srv.close()
             self._metrics_srv = None
+            self._aggregator = None
 
 
 def continuous_eval(

@@ -200,22 +200,51 @@ def _with_mesh(fn, mesh):
     return wrapped
 
 
+def _sentried(step_fn, sentry_cfg):
+    """Fuse the numerics sentry (observability/sentry.py) onto a step fn:
+    the returned fn takes an extra device-side sentry carry and returns the
+    updated carry. Pure jnp on metrics already in registers — the check
+    compiles INTO the step (no second dispatch, no host callback); the host
+    polls the carry's sticky flag only every poll_every steps."""
+    from tfde_tpu.observability import sentry as sentry_lib
+
+    def fused(state, batch, rng, sstate):
+        new_state, m = step_fn(state, batch, rng)
+        new_sstate = sentry_lib.update(
+            sentry_cfg, sstate, new_state.step, m["loss"], m.get("grad_norm")
+        )
+        return new_state, m, new_sstate
+
+    return fused
+
+
 def make_train_step(strategy: Strategy, state: TrainState, donate: bool = True,
-                    grad_accum: int = 1):
+                    grad_accum: int = 1, sentry=None):
     """Compile train_step with the strategy's shardings pinned. `grad_accum`
     splits the batch into that many sequential microbatches per update (see
-    make_custom_train_step)."""
+    make_custom_train_step). `sentry` (a SentryConfig) fuses the numerics
+    check into the compiled step; the returned callable then takes and
+    returns an extra sentry-state pytree: (state, batch, rng, sstate) ->
+    (state, metrics, sstate)."""
     if grad_accum != 1:
         return make_custom_train_step(
             strategy, state, _classification_loss, donate=donate,
-            grad_accum=grad_accum,
+            grad_accum=grad_accum, sentry=sentry,
         )
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
+    if sentry is None:
+        return jax.jit(
+            _with_mesh(train_step, strategy.mesh),
+            in_shardings=(shardings, (batch_sh, batch_sh), None),
+            out_shardings=(shardings, None),
+            donate_argnums=(0,) if donate else (),
+        )
+    rep = NamedSharding(strategy.mesh, P())  # sentry carry: tiny, replicated
     return jax.jit(
-        _with_mesh(train_step, strategy.mesh),
-        in_shardings=(shardings, (batch_sh, batch_sh), None),
-        out_shardings=(shardings, None),
+        _with_mesh(_sentried(train_step, sentry), strategy.mesh),
+        in_shardings=(shardings, (batch_sh, batch_sh), None, rep),
+        out_shardings=(shardings, None, rep),
         donate_argnums=(0,) if donate else (),
     )
 
@@ -226,6 +255,7 @@ def make_custom_train_step(
     loss_fn: Callable[[TrainState, Any, Any, jax.Array], Tuple[jax.Array, dict]],
     donate: bool = True,
     grad_accum: int = 1,
+    sentry=None,
 ):
     """Compile a train step with a user loss over an arbitrary batch pytree.
 
@@ -363,17 +393,31 @@ def make_custom_train_step(
     def batch_shardings(batch):
         return jax.tree_util.tree_map(lambda _: batch_sh, batch)
 
-    jitted = jax.jit(
-        _with_mesh(step, strategy.mesh),
-        in_shardings=(shardings, None, None),  # batch shardings via device_put
-        out_shardings=(shardings, None),
-        donate_argnums=(0,) if donate else (),
-    )
+    if sentry is None:
+        jitted = jax.jit(
+            _with_mesh(step, strategy.mesh),
+            in_shardings=(shardings, None, None),  # batch via device_put
+            out_shardings=(shardings, None),
+            donate_argnums=(0,) if donate else (),
+        )
 
-    def run(state: TrainState, batch, rng):
-        batch = jax.device_put(batch, batch_shardings(batch))
-        return jitted(state, batch, rng)
+        def run(state: TrainState, batch, rng):
+            batch = jax.device_put(batch, batch_shardings(batch))
+            return jitted(state, batch, rng)
+    else:
+        rep = NamedSharding(strategy.mesh, P())  # sentry carry: replicated
+        jitted = jax.jit(
+            _with_mesh(_sentried(step, sentry), strategy.mesh),
+            in_shardings=(shardings, None, None, rep),
+            out_shardings=(shardings, None, rep),
+            donate_argnums=(0,) if donate else (),
+        )
 
+        def run(state: TrainState, batch, rng, sstate):
+            batch = jax.device_put(batch, batch_shardings(batch))
+            return jitted(state, batch, rng, sstate)
+
+    run.jitted = jitted  # the lower()/jaxpr inspection hook (tests)
     return run
 
 

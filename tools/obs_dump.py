@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""obs_dump: pretty-print the observability artifacts of a run.
+
+The post-mortem companion to WORKFLOWS.md's debugging runbook. Three
+surfaces, composable in one invocation:
+
+- ``python tools/obs_dump.py <model_dir>`` — summarize every flight
+  recorder ring dump (``<model_dir>/debug/flight_*.jsonl``): event
+  histogram, the latest sentry trip / stall / straggler / preemption
+  breadcrumbs, and the tail of the ring; plus the last metrics snapshot
+  from ``<model_dir>/metrics/*.jsonl`` (steps/sec, goodput, cluster and
+  resilience gauges).
+- ``python tools/obs_dump.py --url http://chief:9090`` — scrape a LIVE
+  chief ``/metrics`` and print the per-host table (up/stale, snapshot
+  age, steps/sec, push counts) plus the cluster rollups (min/median/max
+  step time, straggler) the aggregator exported.
+- ``--tail N`` — how many trailing flight events to print (default 10).
+
+Reads only; stdlib only — safe to run against a production model_dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+import sys
+import urllib.request
+
+#: flight-event kinds worth surfacing on their own line, newest occurrence
+_HEADLINE_KINDS = (
+    "sentry_trip", "stall", "straggler", "stale_host", "supervisor_abort",
+    "supervisor_failure", "supervisor_restart", "preempted",
+)
+
+#: metric-name prefixes worth printing from the last JSONL snapshot
+_SNAPSHOT_PREFIXES = ("train/", "goodput/", "cluster/", "resilience/",
+                      "sentry/", "checkpoint/")
+
+_LABELLED = re.compile(r'^(\w+)\{host="(\d+)"\}\s+(\S+)$')
+
+
+def _load_jsonl(path: str) -> list:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass  # truncated tail of a crash-time dump
+    return out
+
+
+def _fmt_event(e: dict) -> str:
+    extra = {k: v for k, v in e.items() if k not in ("ts", "kind")}
+    fields = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+    return f"  {e.get('ts', 0):.3f}  {e.get('kind', '?'):<18} {fields}"
+
+
+def dump_flight(path: str, tail: int) -> None:
+    events = _load_jsonl(path)
+    print(f"\n== flight: {path} ({len(events)} events)")
+    if not events:
+        return
+    hist = collections.Counter(e.get("kind", "?") for e in events)
+    print("  kinds: " + ", ".join(f"{k}x{n}" for k, n in sorted(hist.items())))
+    for kind in _HEADLINE_KINDS:
+        latest = next((e for e in reversed(events) if e.get("kind") == kind),
+                      None)
+        if latest is not None:
+            print("  latest " + kind + ":")
+            print("  " + _fmt_event(latest))
+    print(f"  last {min(tail, len(events))} events:")
+    for e in events[-tail:]:
+        print(_fmt_event(e))
+
+
+def dump_metrics_log(path: str) -> None:
+    rows = _load_jsonl(path)
+    print(f"\n== metrics log: {path} ({len(rows)} snapshots)")
+    if not rows:
+        return
+    last = rows[-1]
+    print(f"  last snapshot: step {last.get('step')} ts {last.get('ts', 0):.1f}")
+    flat = last.get("metrics", {})
+    for name in sorted(flat):
+        if name.startswith(_SNAPSHOT_PREFIXES):
+            print(f"    {name:<40} {flat[name]}")
+
+
+def dump_live(url: str) -> None:
+    target = url.rstrip("/")
+    if not target.endswith("/metrics"):
+        target += "/metrics"
+    body = urllib.request.urlopen(target, timeout=5).read().decode()
+    hosts: dict = collections.defaultdict(dict)
+    rollups = {}
+    for line in body.splitlines():
+        m = _LABELLED.match(line)
+        if m:
+            name, host, val = m.groups()
+            hosts[int(host)][name] = float(val)
+            continue
+        if line.startswith("tfde_cluster_") and " " in line:
+            name, _, val = line.rpartition(" ")
+            try:
+                rollups[name] = float(val)
+            except ValueError:
+                pass
+    print(f"== live scrape: {target}")
+    if rollups:
+        print("  cluster rollups:")
+        for name in sorted(rollups):
+            print(f"    {name:<36} {rollups[name]}")
+    if hosts:
+        print(f"  {'host':>4} {'up':>3} {'age_s':>8} {'steps/sec':>10} "
+              f"{'pushes':>7}")
+        for hid in sorted(hosts):
+            h = hosts[hid]
+            print(f"  {hid:>4} "
+                  f"{int(h.get('tfde_cluster_host_up', 1)):>3} "
+                  f"{h.get('tfde_cluster_host_age_seconds', 0.0):>8.1f} "
+                  f"{h.get('tfde_train_steps_per_sec', float('nan')):>10.2f} "
+                  f"{int(h.get('tfde_cluster_pushes_total', 0)):>7}")
+    else:
+        print("  (no host-labelled series — single process, or no "
+              "aggregator on this endpoint)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("model_dir", nargs="?",
+                    help="run directory holding debug/ and metrics/")
+    ap.add_argument("--url", help="live chief to scrape, e.g. "
+                                  "http://chief:9090")
+    ap.add_argument("--tail", type=int, default=10,
+                    help="trailing flight events to print (default 10)")
+    args = ap.parse_args(argv)
+    if not args.model_dir and not args.url:
+        ap.error("give a model_dir, --url, or both")
+
+    if args.url:
+        dump_live(args.url)
+    if args.model_dir:
+        flights = sorted(glob.glob(
+            os.path.join(args.model_dir, "debug", "flight_*.jsonl")))
+        logs = sorted(glob.glob(
+            os.path.join(args.model_dir, "metrics", "*.jsonl")))
+        if not flights and not logs:
+            print(f"no flight or metrics files under {args.model_dir} "
+                  f"(expected debug/flight_*.jsonl, metrics/*.jsonl)")
+            return 1
+        for p in flights:
+            dump_flight(p, args.tail)
+        for p in logs:
+            dump_metrics_log(p)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
