@@ -19,7 +19,56 @@ from tfde_tpu.ops.quant import (
     int8_dot_general,
     quantize_model,
     quantize_params,
+    stochastic_round,
 )
+
+
+# -- stochastic rounding (the gradient transport's mode) ----------------------
+def test_stochastic_round_unbiased_in_expectation():
+    # E[floor(x + U[0,1))] == x exactly; averaging over many keys the
+    # empirical mean must approach x with s.e. <= 0.5/sqrt(n_keys)
+    x = jnp.asarray([0.25, -1.75, 3.5, 0.0, -0.001, 7.999], jnp.float32)
+    n = 400
+    acc = jnp.zeros_like(x)
+    for k in range(n):
+        acc = acc + stochastic_round(x, jax.random.key(k))
+    mean = acc / n
+    # 4 standard errors of the worst-case Bernoulli variance
+    assert jnp.all(jnp.abs(mean - x) < 4 * 0.5 / np.sqrt(n)), mean
+
+
+def test_stochastic_round_deterministic_under_fixed_key(rng):
+    x = jnp.asarray(rng.normal(size=(64,)) * 10, jnp.float32)
+    key = jax.random.key(7)
+    a = stochastic_round(x, key)
+    b = stochastic_round(x, key)
+    assert jnp.array_equal(a, b)
+    # results are integers adjacent to x
+    assert jnp.all((a == jnp.floor(x)) | (a == jnp.ceil(x)))
+    # a different key flips at least one non-integer element (64 draws)
+    c = stochastic_round(x, jax.random.key(8))
+    assert not jnp.array_equal(a, c)
+
+
+def test_absmax_quantize_rng_none_unchanged(rng):
+    # the serving path (rng=None) must be bit-identical to the historical
+    # nearest-rounding behavior
+    w = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+    q, scale = absmax_quantize(w, 1)
+    expected = jnp.clip(
+        jnp.round(w / (jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-12) / 127)),
+        -127, 127,
+    ).astype(jnp.int8)
+    assert jnp.array_equal(q, expected)
+
+
+def test_absmax_quantize_stochastic_mode_bounded(rng):
+    w = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+    q, scale = absmax_quantize(w, 1, rng=jax.random.key(0))
+    assert q.dtype == jnp.int8
+    # stochastic rounding moves at most 1 quantum vs nearest
+    qn, _ = absmax_quantize(w, 1)
+    assert int(jnp.max(jnp.abs(q.astype(jnp.int32) - qn.astype(jnp.int32)))) <= 1
 
 
 def test_absmax_roundtrip_error_bound(rng):

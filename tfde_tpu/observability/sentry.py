@@ -42,8 +42,11 @@ from tfde_tpu.observability import flightrec, metrics
 log = logging.getLogger(__name__)
 
 #: sticky flag bits
-FLAG_NONFINITE = 1  # loss or grad_norm was NaN/Inf
-FLAG_SPIKE = 2      # grad_norm exceeded spike_ratio x EWMA post-warmup
+FLAG_NONFINITE = 1      # loss or grad_norm was NaN/Inf
+FLAG_SPIKE = 2          # grad_norm exceeded spike_ratio x EWMA post-warmup
+FLAG_COMM_OVERFLOW = 4  # int8 gradient transport saw a non-finite quantizer
+                        # scale (parallel/comms.py) — saturation never passes
+                        # silently
 
 
 class NumericsError(RuntimeError):
@@ -57,6 +60,8 @@ class NumericsError(RuntimeError):
             kinds.append("non-finite loss/grad_norm")
         if flag & FLAG_SPIKE:
             kinds.append("grad-norm spike")
+        if flag & FLAG_COMM_OVERFLOW:
+            kinds.append("int8 grad-transport quantizer overflow")
         super().__init__(
             f"numerics sentry tripped at step {trip_step} "
             f"({' + '.join(kinds) or f'flag {flag}'}; "
@@ -108,14 +113,25 @@ def init_state() -> dict:
         "trip_step": jnp.full((), -1, jnp.int32),
         "ewma": jnp.zeros((), jnp.float32),
         "count": jnp.zeros((), jnp.int32),
+        # EWMA of the int8 transport's error-feedback residual norm
+        # (parallel/comms.py) — 0 under fp32 transport. A residual baseline
+        # that drifts up means the quantizer is shedding more signal each
+        # step (shrink the block size or raise the threshold).
+        "res_ewma": jnp.zeros((), jnp.float32),
     }
 
 
 def update(cfg: SentryConfig, sstate: dict, step, loss,
-           grad_norm=None) -> dict:
+           grad_norm=None, residual_norm=None, comm_overflow=None) -> dict:
     """The fused per-step check: pure jnp, traced INSIDE the train step —
     no extra dispatch, no host callback (tests assert the jaxpr stays
-    callback-free). Returns the next sentry carry."""
+    callback-free). Returns the next sentry carry.
+
+    `residual_norm`/`comm_overflow` arrive from the int8 gradient
+    transport: the residual norm feeds its own EWMA (telemetry; a
+    non-finite value also trips FLAG_NONFINITE), a positive overflow flag
+    trips FLAG_COMM_OVERFLOW — a quantizer that saw NaN/Inf absmaxes must
+    abort loudly, not saturate silently."""
     step = jnp.asarray(step, jnp.int32)
     loss = jnp.asarray(loss, jnp.float32)
     bits = jnp.where(jnp.isfinite(loss), 0, FLAG_NONFINITE).astype(jnp.int32)
@@ -140,12 +156,28 @@ def update(cfg: SentryConfig, sstate: dict, step, loss,
         )
         ewma = new_ewma
         count = count + jnp.where(finite, 1, 0)
+    res_ewma = sstate.get("res_ewma", jnp.zeros((), jnp.float32))
+    if residual_norm is not None:
+        r = jnp.asarray(residual_norm, jnp.float32)
+        r_finite = jnp.isfinite(r)
+        bits = bits | jnp.where(r_finite, 0, FLAG_NONFINITE)
+        # no warm-start branch: the residual starts at exactly zero (the
+        # carry is initialized to zeros), so the EWMA ramps from 0 honestly
+        res_ewma = jnp.where(
+            r_finite,
+            cfg.ewma_decay * res_ewma + (1.0 - cfg.ewma_decay) * r,
+            res_ewma,
+        )
+    if comm_overflow is not None:
+        tripped = jnp.asarray(comm_overflow, jnp.float32) > 0
+        bits = bits | jnp.where(tripped, FLAG_COMM_OVERFLOW, 0)
     first_trip = (bits != 0) & (sstate["flag"] == 0)
     return {
         "flag": sstate["flag"] | bits,
         "trip_step": jnp.where(first_trip, step, sstate["trip_step"]),
         "ewma": ewma,
         "count": count,
+        "res_ewma": res_ewma,
     }
 
 

@@ -37,17 +37,39 @@ import jax
 import jax.numpy as jnp
 
 
-def absmax_quantize(w: jax.Array, contract_ndim: int) -> Tuple[jax.Array, jax.Array]:
+def stochastic_round(x: jax.Array, rng: jax.Array) -> jax.Array:
+    """Randomized round-to-integer, unbiased in expectation:
+    ``floor(x + u)`` with ``u ~ U[0, 1)``, so ``E[result] == x`` exactly
+    (the fractional part rounds up with probability equal to itself).
+    Deterministic under a fixed key. Used by the gradient transport
+    (parallel/comms.py) — nearest rounding is biased toward the grid,
+    and that bias accumulates over an all-reduce where stochastic noise
+    averages out across devices and steps."""
+    u = jax.random.uniform(rng, x.shape, dtype=jnp.float32)
+    return jnp.floor(x.astype(jnp.float32) + u)
+
+
+def absmax_quantize(
+    w: jax.Array, contract_ndim: int, *, rng: jax.Array | None = None
+) -> Tuple[jax.Array, jax.Array]:
     """Symmetric int8 quantization of a kernel whose LEADING `contract_ndim`
     axes are contracted (the nn.DenseGeneral layout): returns
     (q int8 [same shape], scale fp32 [w.shape[contract_ndim:]]) with
     `w ~= q * scale` broadcast over the leading axes — one scale per output
-    channel, the grain that keeps per-channel dynamic range."""
+    channel, the grain that keeps per-channel dynamic range.
+
+    `rng` switches nearest rounding to `stochastic_round` — the gradient
+    quantizer's mode (unbiased in expectation; serving-side weight
+    quantization keeps the default nearest mode, which minimizes
+    per-tensor error)."""
     w = w.astype(jnp.float32)
     axes = tuple(range(contract_ndim))
     amax = jnp.max(jnp.abs(w), axis=axes)
     scale = jnp.maximum(amax, 1e-12) / 127.0
-    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    rounded = jnp.round(w / scale) if rng is None else stochastic_round(
+        w / scale, rng
+    )
+    q = jnp.clip(rounded, -127, 127).astype(jnp.int8)
     return q, scale
 
 

@@ -25,3 +25,6 @@ from tfde_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
     stack_stage_params,
 )
+from tfde_tpu.parallel.comms import (  # noqa: F401
+    CommsConfig,
+)

@@ -32,21 +32,45 @@ from typing import Any, Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tfde_tpu.parallel import comms as comms_lib
 from tfde_tpu.parallel import sharding as shd
 from tfde_tpu.runtime import mesh as mesh_lib
 
 
 class Strategy:
-    """Base: replicated params, batch split over data-like mesh axes."""
+    """Base: replicated params, batch split over data-like mesh axes.
 
-    def __init__(self, mesh: Optional[Mesh] = None):
+    `grad_transport` selects the gradient-exchange wire format
+    (parallel/comms.py): 'fp32' (default — the implicit SPMD psum,
+    byte-identical to always) or 'int8' (blockwise-quantized all-reduce
+    with error feedback); a CommsConfig tunes threshold/block/rounding.
+    None defers to $TFDE_GRAD_TRANSPORT, then 'fp32'.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, grad_transport=None):
         self._mesh = mesh
+        self._comms = (
+            comms_lib.resolve(grad_transport)
+            if grad_transport is not None else None
+        )
 
     @property
     def mesh(self) -> Mesh:
         if self._mesh is None:
             self._mesh = self._default_mesh()
         return self._mesh
+
+    @property
+    def comms(self) -> "comms_lib.CommsConfig":
+        """The gradient-transport config; resolved lazily so an unset knob
+        reads $TFDE_GRAD_TRANSPORT at first use, not at import."""
+        if self._comms is None:
+            self._comms = comms_lib.resolve(None)
+        return self._comms
+
+    @comms.setter
+    def comms(self, value) -> None:
+        self._comms = comms_lib.resolve(value)
 
     def _default_mesh(self) -> Mesh:
         return mesh_lib.data_parallel_mesh()
@@ -151,8 +175,9 @@ class ParameterServerStrategy(Strategy):
     synchronous math. Params stay replicated (ZeRO-1).
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None, min_shard_elems: int = 2**14):
-        super().__init__(mesh)
+    def __init__(self, mesh: Optional[Mesh] = None, min_shard_elems: int = 2**14,
+                 grad_transport=None):
+        super().__init__(mesh, grad_transport=grad_transport)
         self._zero = _ZeroConfig(min_shard_elems)
 
     def opt_state_spec(self, opt_state: Any, params: Any) -> Any:
@@ -249,12 +274,12 @@ class TensorParallelStrategy(Strategy):
 
     def __init__(self, mesh: Optional[Mesh] = None, data: int = 1,
                  extra_rules=(), zero1: bool = False,
-                 min_shard_elems: int = 2**14):
+                 min_shard_elems: int = 2**14, grad_transport=None):
         self._data = data
         self._extra = tuple(extra_rules)
         self._zero1 = zero1
         self._min = min_shard_elems
-        super().__init__(mesh)
+        super().__init__(mesh, grad_transport=grad_transport)
 
     def _default_mesh(self) -> Mesh:
         return mesh_lib.make_mesh({"data": self._data, "tensor": -1})
@@ -307,9 +332,10 @@ class ExpertParallelStrategy(Strategy):
     all-to-all-style exchange over ICI.
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None, data: int = 1):
+    def __init__(self, mesh: Optional[Mesh] = None, data: int = 1,
+                 grad_transport=None):
         self._data = data
-        super().__init__(mesh)
+        super().__init__(mesh, grad_transport=grad_transport)
 
     def _default_mesh(self) -> Mesh:
         return mesh_lib.make_mesh({"data": self._data, "expert": -1})
@@ -345,9 +371,10 @@ class SequenceParallelStrategy(Strategy):
     axis size, which must divide the sequence length evenly.
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None, data: int = 1):
+    def __init__(self, mesh: Optional[Mesh] = None, data: int = 1,
+                 grad_transport=None):
         self._data = data
-        super().__init__(mesh)
+        super().__init__(mesh, grad_transport=grad_transport)
 
     def _default_mesh(self) -> Mesh:
         return mesh_lib.make_mesh({"data": self._data, "seq": -1})
@@ -386,12 +413,13 @@ class PipelineParallelStrategy(Strategy):
         pipe: Optional[int] = None,
         tensor: int = 1,
         seq: int = 1,
+        grad_transport=None,
     ):
         self._data = data
         self._pipe = pipe
         self._tensor = tensor
         self._seq = seq
-        super().__init__(mesh)
+        super().__init__(mesh, grad_transport=grad_transport)
 
     def _default_mesh(self) -> Mesh:
         axes = {"data": self._data, "pipe": self._pipe or -1}
@@ -461,10 +489,11 @@ class FSDPStrategy(Strategy):
         mesh: Optional[Mesh] = None,
         data: int = 1,
         min_shard_elems: int = 2**10,
+        grad_transport=None,
     ):
         self._data = data
         self._min = min_shard_elems
-        super().__init__(mesh)
+        super().__init__(mesh, grad_transport=grad_transport)
 
     def _default_mesh(self) -> Mesh:
         return mesh_lib.make_mesh({"data": self._data, "fsdp": -1})

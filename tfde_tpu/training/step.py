@@ -13,7 +13,9 @@ Loss convention: mean over the *global* batch == sum x 1/global_batch
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import logging
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -23,8 +25,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tfde_tpu.ops import losses, metrics as metrics_lib
 from tfde_tpu.parallel import axes as axes_lib
+from tfde_tpu.parallel import comms as comms_lib
 from tfde_tpu.parallel.strategies import Strategy
 from tfde_tpu.training.train_state import TrainState
+from tfde_tpu.utils import compat
+
+log = logging.getLogger(__name__)
 
 
 def sown_losses_by_name(mutated_losses) -> dict:
@@ -144,6 +150,12 @@ def _state_shardings(strategy: Strategy, state: TrainState):
         opt_state=ns(strategy.opt_state_spec(state.opt_state, state.params)),
         apply_fn=state.apply_fn,
         tx=state.tx,
+        # error-feedback residual (parallel/comms.py): nominally replicated
+        # — each device's copy differs, but only the exchange reads it, so
+        # the claim is safe and XLA never moves the bytes
+        comm_residual=ns(
+            jax.tree_util.tree_map(lambda _: P(), state.comm_residual)
+        ),
     )
 
 
@@ -161,6 +173,7 @@ def init_state(
     (state, state_shardings).
     """
     mesh = strategy.mesh
+    ccfg = comms_lib.effective(strategy.comms, mesh)
 
     def init_fn(rng):
         # a tuple sample feeds multi-input models positionally (the T5
@@ -178,6 +191,13 @@ def init_state(
             opt_state=tx.init(params),
             apply_fn=model.apply,
             tx=tx,
+            # int8 transport: allocate the error-feedback residual up
+            # front so the step's carry structure is fixed. fp32 keeps
+            # None — state structure (and checkpoints) byte-identical.
+            comm_residual=(
+                comms_lib.init_residual(params, ccfg)
+                if ccfg.transport == "int8" else None
+            ),
         )
 
     abstract = jax.eval_shape(init_fn, jax.random.key(seed))
@@ -211,25 +231,263 @@ def _sentried(step_fn, sentry_cfg):
     def fused(state, batch, rng, sstate):
         new_state, m = step_fn(state, batch, rng)
         new_sstate = sentry_lib.update(
-            sentry_cfg, sstate, new_state.step, m["loss"], m.get("grad_norm")
+            sentry_cfg, sstate, new_state.step, m["loss"], m.get("grad_norm"),
+            # int8 gradient transport (parallel/comms.py): the residual
+            # norm feeds its EWMA; a quantizer overflow trips the sentry
+            # instead of saturating silently
+            residual_norm=m.get("comm_residual_norm"),
+            comm_overflow=m.get("comm_overflow"),
         )
         return new_state, m, new_sstate
 
     return fused
 
 
+def _resolve_comms(strategy: Strategy, state: TrainState, comms):
+    """The one resolution point for the grad_transport knob: explicit arg >
+    strategy knob ($TFDE_GRAD_TRANSPORT-aware), downgraded to fp32 on
+    ineligible meshes (comms.effective) or when the state carries no
+    error-feedback residual (e.g. built before the knob was set, or the
+    LoRA path — the adapters are tiny; compressing them saves nothing)."""
+    cfg = comms_lib.resolve(comms if comms is not None else strategy.comms)
+    cfg = comms_lib.effective(cfg, strategy.mesh)
+    if cfg.transport == "int8" and state.comm_residual is None:
+        log.warning(
+            "grad_transport='int8' but the TrainState has no comm_residual "
+            "(built with fp32 transport?) — falling back to fp32. "
+            "Re-init the state with the strategy's grad_transport set."
+        )
+        cfg = dataclasses.replace(cfg, transport="fp32")
+    return cfg
+
+
+def _make_int8_step(strategy: Strategy, state: TrainState, loss_fn,
+                    cfg: comms_lib.CommsConfig, grad_accum: int):
+    """Build the int8-transport step fn: gradients computed per device on
+    the LOCAL batch shard inside a `shard_map` over the data axis, then
+    exchanged through the quantized all-reduce (parallel/comms.py) instead
+    of the partitioner's implicit fp32 psum.
+
+    The microbatch semantics match the fp32 path exactly: the device-major
+    split there means global microbatch `a` is the concatenation of every
+    device's a-th local sub-chunk — which is precisely the local
+    [A, b_local/A] reshape here. Weighted accumulation decomposes too:
+    sum_i sum_a w_ia * g_ia / sum w_ia over LOCAL masked means equals the
+    global weighted update, because w*grad(masked mean) == grad(masked
+    sum). Compression happens ONCE per update, after the accumulation —
+    never per microbatch.
+
+    Known (documented) deviations from the fp32 oracle: dropout keys fold
+    in the shard index (per-shard masks instead of one global mask — same
+    statistics, different bits), and BatchNorm batch statistics are the
+    mean of per-shard statistics.
+    """
+    mesh = strategy.mesh
+    axis = comms_lib.data_axis(mesh)
+    nshards = int(mesh.shape[axis])
+    apply_fn, tx = state.apply_fn, state.tx
+    mask_leaves = jax.tree_util.tree_leaves(
+        comms_lib.compress_mask(state.params, cfg)
+    )
+
+    def micro_grads_local(pstate, mb, r):
+        def wrapped(params):
+            # no active mesh inside the manual region: the models'
+            # activation `constrain` calls degrade to identity (they only
+            # speak batch/model axes, all trivial on a per-device shard)
+            with axes_lib.use_axes(None):
+                return loss_fn(pstate, params, mb, r)
+
+        (loss, metrics), grads = jax.value_and_grad(wrapped, has_aux=True)(
+            pstate.params
+        )
+        metrics = dict(metrics)
+        new_stats = metrics.pop("batch_stats", pstate.batch_stats)
+        weight = metrics.pop("grad_weight", None)
+        return grads, loss, metrics, new_stats, weight
+
+    def as_weight(w):
+        return (jnp.ones((), jnp.float32) if w is None
+                else jnp.asarray(w, jnp.float32))
+
+    def body(step_c, params, batch_stats, residual, batch, key):
+        shard = jax.lax.axis_index(axis)
+        key = jax.random.fold_in(key, shard)
+        pstate = TrainState(
+            step=step_c, params=params, batch_stats=batch_stats,
+            opt_state=(), apply_fn=apply_fn, tx=tx,
+        )
+        # -- local microbatch accumulation (mirrors the fp32 path) --------
+        if grad_accum == 1:
+            g, l, m, stats, w = micro_grads_local(
+                pstate, batch, jax.random.fold_in(key, 0)
+            )
+            w0 = as_weight(w)
+            grads = jax.tree_util.tree_map(lambda x: x * w0, g)
+            loss, wsum = l * w0, w0
+            metrics = jax.tree_util.tree_map(lambda x: x * w0, m)
+        else:
+            def split(x):
+                a = x.shape[0] // grad_accum
+                return x.reshape(grad_accum, a, *x.shape[1:])
+
+            micro = jax.tree_util.tree_map(split, batch)
+            first = jax.tree_util.tree_map(lambda x: x[0], micro)
+            rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
+            g, l, m, stats, w = micro_grads_local(
+                pstate, first, jax.random.fold_in(key, 0)
+            )
+            w0 = as_weight(w)
+            grads = jax.tree_util.tree_map(lambda x: x * w0, g)
+            loss = l * w0
+            metrics = jax.tree_util.tree_map(lambda x: x * w0, m)
+
+            def scan_body(carry, inp):
+                grads_sum, loss_sum, metrics_sum, wsum, stats = carry
+                i, mb = inp
+                st = pstate.replace(batch_stats=stats)
+                gi, li, mi, stats, wi = micro_grads_local(
+                    st, mb, jax.random.fold_in(key, i)
+                )
+                wi = as_weight(wi)
+                return (
+                    jax.tree_util.tree_map(
+                        lambda a, b: a + b * wi, grads_sum, gi),
+                    loss_sum + li * wi,
+                    jax.tree_util.tree_map(
+                        lambda a, b: a + b * wi, metrics_sum, mi),
+                    wsum + wi,
+                    stats,
+                ), None
+
+            idx = jnp.arange(1, grad_accum)
+            (grads, loss, metrics, wsum, stats), _ = jax.lax.scan(
+                scan_body, (grads, loss, metrics, w0, stats), (idx, rest)
+            )
+
+        # -- the exchange: one packed fp32 psum (small leaves + scalars), --
+        # -- one quantized all-reduce (everything else)                   --
+        grads_l, gdef = jax.tree_util.tree_flatten(grads)
+        res_l = jax.tree_util.tree_flatten(residual)[0]
+        big_g = [g for g, c in zip(grads_l, mask_leaves) if c]
+        big_r = [r for r, c in zip(res_l, mask_leaves) if c]
+        small_g = [g for g, c in zip(grads_l, mask_leaves) if not c]
+        res_sq = sum(
+            (jnp.sum(jnp.square(r)) for r in big_r),
+            jnp.zeros((), jnp.float32),
+        )
+        mkeys = sorted(metrics)
+        stats_l, stats_def = jax.tree_util.tree_flatten(stats)
+        aux = (list(small_g) + [loss, wsum, res_sq]
+               + [metrics[k] for k in mkeys] + list(stats_l))
+        aux = comms_lib.psum_packed(aux, axis)
+        ns_small = len(small_g)
+        small_sum = aux[:ns_small]
+        loss_g, wsum_g, res_sq_g = aux[ns_small:ns_small + 3]
+        moff = ns_small + 3
+        metrics_g = aux[moff:moff + len(mkeys)]
+        stats_g = [s / nshards for s in aux[moff + len(mkeys):]]
+
+        # wsum == 0 (every microbatch weightless on every shard) must give
+        # the clean zero-gradient update, same as the fp32 path
+        inv = 1.0 / jnp.where(wsum_g > 0, wsum_g, 1.0)
+
+        if big_g:
+            gvec, gshapes = comms_lib.pack(
+                [g * inv for g in big_g]
+            )
+            rvec, _ = comms_lib.pack(big_r)
+            out_vec, new_rvec, overflow = comms_lib.int8_reduce(
+                gvec, rvec, cfg, axis, nshards,
+                rng=(jax.random.fold_in(key, grad_accum)
+                     if cfg.stochastic else None),
+            )
+            big_out = comms_lib.unpack(out_vec, gshapes)
+            new_big_r = comms_lib.unpack(new_rvec, gshapes)
+        else:
+            overflow = jnp.zeros((), jnp.float32)
+            big_out, new_big_r = [], []
+
+        out_l, new_res_l, bi, si = [], [], 0, 0
+        for r, c in zip(res_l, mask_leaves):
+            if c:
+                out_l.append(big_out[bi])
+                new_res_l.append(new_big_r[bi])
+                bi += 1
+            else:
+                out_l.append(small_sum[si] * inv)
+                new_res_l.append(r)
+                si += 1
+        grads_mean = jax.tree_util.tree_unflatten(gdef, out_l)
+        new_residual = jax.tree_util.tree_unflatten(gdef, new_res_l)
+        new_stats = jax.tree_util.tree_unflatten(stats_def, stats_g)
+        metrics_out = {k: v * inv for k, v in zip(mkeys, metrics_g)}
+        return (grads_mean, loss_g * inv, metrics_out, new_stats,
+                new_residual, overflow, jnp.sqrt(res_sq_g))
+
+    def step(state: TrainState, batch, rng):
+        step_rng = jax.random.fold_in(rng, state.step)
+        for leaf in jax.tree_util.tree_leaves(batch):
+            n = leaf.shape[0]
+            if n % (grad_accum * nshards):
+                raise ValueError(
+                    f"global batch {n} not divisible by grad_accum="
+                    f"{grad_accum} x {nshards} data shards"
+                )
+        batch_specs = jax.tree_util.tree_map(
+            lambda l: P(axis, *(None,) * (l.ndim - 1)), batch
+        )
+        exchanged = compat.shard_map(
+            body, mesh,
+            in_specs=(P(), P(), P(), P(), batch_specs, P()),
+            out_specs=P(),
+            check_vma=False,  # the residual is deliberately device-varying
+        )(state.step, state.params, state.batch_stats, state.comm_residual,
+          batch, step_rng)
+        grads, loss, metrics, new_stats, new_residual, overflow, res_norm = (
+            exchanged
+        )
+        new_state = state.apply_gradients(
+            grads, new_batch_stats=new_stats, new_comm_residual=new_residual
+        )
+        metrics = dict(metrics)
+        metrics.setdefault("grad_norm", optax.global_norm(grads))
+        metrics["comm_residual_norm"] = res_norm
+        metrics["comm_overflow"] = overflow
+        return new_state, {"loss": loss, **metrics}
+
+    return step
+
+
+def _export_comm_gauges(state: TrainState, cfg, nshards: int) -> None:
+    """Publish the analytic wire-byte accounting as comm/* gauges — set
+    once at step-build time (the numbers are static per model x config)."""
+    from tfde_tpu.observability import metrics as obs_metrics
+
+    b = comms_lib.comm_bytes(state.params, cfg, nshards)
+    reg = obs_metrics.default_registry()
+    reg.gauge("comm/bytes_per_step_fp32").set(b["fp32"])
+    reg.gauge("comm/bytes_per_step_int8").set(b["int8"])
+    reg.gauge("comm/compression_ratio").set(b["ratio"])
+    reg.gauge("comm/compressed_elems").set(b["compressed_elems"])
+    reg.gauge("comm/fp32_elems").set(b["fp32_elems"])
+
+
 def make_train_step(strategy: Strategy, state: TrainState, donate: bool = True,
-                    grad_accum: int = 1, sentry=None):
+                    grad_accum: int = 1, sentry=None, comms=None):
     """Compile train_step with the strategy's shardings pinned. `grad_accum`
     splits the batch into that many sequential microbatches per update (see
     make_custom_train_step). `sentry` (a SentryConfig) fuses the numerics
     check into the compiled step; the returned callable then takes and
     returns an extra sentry-state pytree: (state, batch, rng, sstate) ->
-    (state, metrics, sstate)."""
-    if grad_accum != 1:
+    (state, metrics, sstate). `comms` overrides the strategy's
+    grad_transport knob (parallel/comms.py); int8 routes through the
+    custom-step machinery, fp32 is byte-identical to always."""
+    cfg = _resolve_comms(strategy, state, comms)
+    if grad_accum != 1 or cfg.transport == "int8":
         return make_custom_train_step(
             strategy, state, _classification_loss, donate=donate,
-            grad_accum=grad_accum, sentry=sentry,
+            grad_accum=grad_accum, sentry=sentry, comms=cfg,
         )
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
@@ -256,6 +514,7 @@ def make_custom_train_step(
     donate: bool = True,
     grad_accum: int = 1,
     sentry=None,
+    comms=None,
 ):
     """Compile a train step with a user loss over an arbitrary batch pytree.
 
@@ -284,11 +543,18 @@ def make_custom_train_step(
     of the final averaged gradients); a loss_fn returning its own
     ``grad_norm`` metric takes precedence.
     The standard route to reference-scale global batches on few chips.
+
+    `comms` selects the gradient transport (parallel/comms.py): None reads
+    the strategy's grad_transport knob; 'fp32' (the default everywhere) is
+    byte-identical to the historical path; 'int8' swaps the step body for
+    the quantized exchange with error feedback — compression happens once
+    per update, after grad accumulation.
     """
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    ccfg = _resolve_comms(strategy, state, comms)
 
     def micro_grads(state: TrainState, batch, rng):
         def wrapped(params):
@@ -390,6 +656,17 @@ def make_custom_train_step(
         )
         return new_state, {"loss": loss, **metrics}
 
+    if ccfg.transport == "int8":
+        # swap the whole step body: local grads + explicit quantized
+        # exchange instead of the partitioner's implicit fp32 psum. The
+        # fp32 `step` above is never traced, so the default path's jaxpr
+        # stays byte-identical.
+        step = _make_int8_step(strategy, state, loss_fn, ccfg, grad_accum)
+        _export_comm_gauges(
+            state, ccfg,
+            int(strategy.mesh.shape[comms_lib.data_axis(strategy.mesh)]),
+        )
+
     def batch_shardings(batch):
         return jax.tree_util.tree_map(lambda _: batch_sh, batch)
 
@@ -418,6 +695,7 @@ def make_custom_train_step(
             return jitted(state, batch, rng, sstate)
 
     run.jitted = jitted  # the lower()/jaxpr inspection hook (tests)
+    run.lower = jitted.lower  # quacks like the jitted fast path for guards
     return run
 
 

@@ -20,8 +20,21 @@ class TrainState(flax.struct.PyTreeNode):
     opt_state: Any
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    # Error-feedback residual for the quantized gradient transport
+    # (parallel/comms.py): params-congruent fp32 tree holding what the int8
+    # quantizer dropped last step, re-injected into the next exchange. None
+    # under grad_transport='fp32' — None is an empty pytree, so the default
+    # keeps the state structure (and every existing checkpoint/jaxpr)
+    # byte-identical. Per-device contents (each replica carries ITS OWN
+    # compression error); only the exchange ever reads it. Deliberately
+    # NOT checkpointed (checkpoint/manager.py saves {step, params,
+    # batch_stats, opt_state}): a resumed run restarts the residual from
+    # zeros — a few warm-up steps of extra quantization error, and
+    # fp32<->int8 checkpoint resume stays compatible in both directions.
+    comm_residual: Any = None
 
-    def apply_gradients(self, grads, new_batch_stats=None):
+    def apply_gradients(self, grads, new_batch_stats=None,
+                        new_comm_residual=None):
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
         return self.replace(
@@ -31,4 +44,8 @@ class TrainState(flax.struct.PyTreeNode):
                 new_batch_stats if new_batch_stats is not None else self.batch_stats
             ),
             opt_state=new_opt_state,
+            comm_residual=(
+                new_comm_residual if new_comm_residual is not None
+                else self.comm_residual
+            ),
         )

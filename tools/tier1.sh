@@ -5,6 +5,11 @@
 # counts across revisions even when the exit code is nonzero.
 #
 # Usage: tools/tier1.sh            (from the repo root)
+#        TFDE_GRAD_TRANSPORT=int8 tools/tier1.sh
+#                                  (re-run the whole suite with the
+#                                   quantized gradient exchange as the
+#                                   default transport — parallel/comms.py;
+#                                   non-DP meshes warn-fallback to fp32)
 #
 # Also prints DOTS_DELTA (this run's DOTS_PASSED minus the previous
 # run's, from /tmp/_t1.passed) so a regression is visible at a glance
@@ -14,6 +19,7 @@ cd "$(dirname "$0")/.." || exit 1
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    TFDE_GRAD_TRANSPORT="${TFDE_GRAD_TRANSPORT:-fp32}" \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     --durations=10 \
