@@ -775,6 +775,167 @@ def comms_child_mode() -> None:
     }))
 
 
+def _bench_zero(n_chips: int, smoke: bool) -> dict:
+    """ZeRO weight-update sharding (parallel/zero.py): analytic optimizer
+    memory for the real BERT-base shapes plus a measured replicated-vs-
+    sharded A/B on a CPU mesh.
+
+    Same two-layer shape as `_bench_comms`:
+
+    - **Analytic bytes** price Adam's mu/nu for BertBase under both
+      layouts (`zero.state_bytes`, the accounting behind the
+      `opt/state_bytes` gauge): replicated ~= 2 x params x 4B per device,
+      sharded ~= 1/N of that (quantum padding keeps it off the exact 1/N).
+      The acceptance bar is sharded <= 1/4 x replicated on the 8-way mesh.
+    - **The A/B run** happens in a `--zero-child` subprocess forced to an
+      8-way CPU mesh: step time + measured per-device opt-state bytes +
+      loss parity for all four transport x sharding combos. fp32 x shard
+      must match the replicated fp32 oracle BITWISE; int8 x shard within
+      the int8 tolerance. On CPU the gather/scatter is compute, not
+      network, so step-time deltas validate the path rather than the perf
+      claim — the byte ratio is the claim.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tfde_tpu.models.bert import BertBase
+    from tfde_tpu.parallel import comms as comms_lib
+    from tfde_tpu.parallel import zero as zero_lib
+
+    model = BertBase(dropout_rate=0.0, pad_vocab=True)
+    sample = np.zeros((2, 8), np.int32)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), sample, train=False)
+    )["params"]
+    nshards = n_chips if n_chips >= 2 else 8
+    tx = optax.adam(1e-3)
+    layout = zero_lib.build_layout(abstract, comms_lib.CommsConfig(), nshards)
+    rep_bytes = zero_lib.state_bytes(jax.eval_shape(tx.init, abstract))
+    sh_bytes = zero_lib.state_bytes(
+        jax.eval_shape(lambda p: tx.init(zero_lib.pack_params(p, layout)),
+                       abstract),
+        layout,
+    )
+    out = {
+        "zero_opt_bytes_per_device_replicated": int(rep_bytes),
+        "zero_opt_bytes_per_device_sharded": int(sh_bytes),
+        "zero_opt_bytes_ratio": round(sh_bytes / rep_bytes, 4),
+        "zero_analytic_nshards": nshards,
+        "zero_param_gather_bytes": int(zero_lib.param_gather_bytes(layout)),
+    }
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env.pop(zero_lib.ENV_OPT_SHARDING, None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--zero-child"],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        child = _last_json(proc.stdout)
+        if child is None:
+            out["zero_child_error"] = (proc.stderr or "no output")[-400:]
+        else:
+            out.update(child)
+    except subprocess.TimeoutExpired:
+        out["zero_child_error"] = "zero child timed out"
+    return out
+
+
+def zero_child_mode() -> None:
+    """`bench.py --zero-child`: the replicated-vs-sharded x fp32-vs-int8
+    A/B on the 8-way CPU mesh the parent pinned via env. Prints one JSON
+    line."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tfde_tpu.models.bert import Bert
+    from tfde_tpu.ops import losses
+    from tfde_tpu.parallel.strategies import MirroredStrategy
+    from tfde_tpu.parallel import zero as zero_lib
+    from tfde_tpu.training.step import init_state, make_custom_train_step
+
+    seq, per_chip_batch, steps = 128, 2, 8
+    model = Bert(vocab_size=1024, hidden_size=128, depth=2, num_heads=4,
+                 mlp_dim=256, dropout_rate=0.0, pad_vocab=True)
+    n_chips = len(jax.local_devices())
+    global_batch = per_chip_batch * n_chips
+
+    def loss_fn(state, params, batch, rng):
+        input_ids, labels = batch
+        logits = state.apply_fn({"params": params}, input_ids, train=True,
+                                rngs={"dropout": rng})
+        loss, acc = losses.masked_lm_loss(logits, labels)
+        return loss, {"mlm_accuracy": acc}
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size,
+                       (global_batch, seq)).astype(np.int32)
+    labels = np.full((global_batch, seq), -100, np.int32)
+    labels[:, ::7] = ids[:, ::7]
+    key = jax.random.key(0)
+
+    def trajectory(mode, transport):
+        strategy = MirroredStrategy(grad_transport=transport,
+                                    opt_sharding=mode)
+        state, _ = init_state(model, optax.adamw(1e-4), strategy, ids)
+        step_fn = make_custom_train_step(strategy, state, loss_fn)
+        opt_bytes = zero_lib.state_bytes(state.opt_state, state.opt_layout)
+        state, m = step_fn(state, (ids, labels), key)  # compile + step 0
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        traj = [float(m["loss"])]
+        for _ in range(steps - 1):
+            state, m = step_fn(state, (ids, labels), key)
+            traj.append(float(m["loss"]))
+        dt = (time.perf_counter() - t0) / (steps - 1)
+        return traj, dt, opt_bytes
+
+    runs = {
+        (mode, transport): trajectory(mode, transport)
+        for mode in ("replicated", "shard")
+        for transport in ("fp32", "int8")
+    }
+    oracle = runs[("replicated", "fp32")][0]
+
+    def max_diff(mode, transport):
+        return max(abs(a - b)
+                   for a, b in zip(oracle, runs[(mode, transport)][0]))
+
+    scale = max(1.0, abs(oracle[0]))
+    fp32_rep_dt = runs[("replicated", "fp32")][1]
+    fp32_sh_dt = runs[("shard", "fp32")][1]
+    print(json.dumps({
+        "zero_step_ms_fp32_replicated": round(fp32_rep_dt * 1e3, 2),
+        "zero_step_ms_fp32_sharded": round(fp32_sh_dt * 1e3, 2),
+        "zero_step_ms_int8_replicated": round(
+            runs[("replicated", "int8")][1] * 1e3, 2),
+        "zero_step_ms_int8_sharded": round(
+            runs[("shard", "int8")][1] * 1e3, 2),
+        "zero_step_delta_pct": round(
+            (fp32_sh_dt - fp32_rep_dt) / fp32_rep_dt * 100.0, 1),
+        "zero_measured_opt_bytes_replicated": int(
+            runs[("replicated", "fp32")][2]),
+        "zero_measured_opt_bytes_sharded": int(runs[("shard", "fp32")][2]),
+        # fp32 x shard is bitwise vs the oracle for plain-mean losses
+        # (tests/test_zero.py pins that); the masked-LM loss here
+        # normalizes by non-power-of-two token counts, so the local-sum
+        # decomposition rounds differently — tight, not bitwise
+        "zero_loss_max_diff_fp32": round(max_diff("shard", "fp32"), 7),
+        "zero_parity_ok_fp32": bool(max_diff("shard", "fp32") < 0.01 * scale),
+        "zero_loss_max_diff_int8": round(max_diff("shard", "int8"), 5),
+        "zero_parity_ok_int8": bool(
+            max_diff("shard", "int8") < 0.05 * scale),
+        "zero_child_n_chips": n_chips,
+    }))
+
+
 def _bench_flash(clock: _Clock, smoke: bool) -> dict:
     """Hardware qualification of the Pallas flash-attention kernel
     (VERDICT r2 next-steps 4): numerics vs the reference einsum, then
@@ -1459,6 +1620,7 @@ def run_mode() -> None:
         ("obs", lambda: _bench_obs(strategy, smoke)),
         ("bert", lambda: _bench_bert_mfu(clock, strategy, n_chips, peak, smoke)),
         ("comms", lambda: _bench_comms(n_chips, smoke)),
+        ("zero", lambda: _bench_zero(n_chips, smoke)),
         ("flash", lambda: _bench_flash(clock, smoke)),
         # stretch configs: ordered last so an attempt-timeout salvages the
         # core numbers above (run mode emits a cumulative line per config)
@@ -1847,6 +2009,8 @@ if __name__ == "__main__":
         run_mode()
     elif "--comms-child" in sys.argv:
         comms_child_mode()
+    elif "--zero-child" in sys.argv:
+        zero_child_mode()
     elif "--probe" in sys.argv:
         probe_mode()
     elif "--watch" in sys.argv:

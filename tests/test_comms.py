@@ -191,8 +191,10 @@ def test_int8_reduce_overflow_flag(rng):
 
 
 # -- step integration ---------------------------------------------------------
-def _cnn_setup(transport, n=4, batch=16, grad_accum=1, sentry=None):
-    strategy = MirroredStrategy(mesh=_dp_mesh(n), grad_transport=transport)
+def _cnn_setup(transport, n=4, batch=16, grad_accum=1, sentry=None,
+               opt_sharding=None):
+    strategy = MirroredStrategy(mesh=_dp_mesh(n), grad_transport=transport,
+                                opt_sharding=opt_sharding)
     rng = np.random.default_rng(0)
     images = rng.random((batch, 784), np.float32)
     labels = rng.integers(0, 10, (batch, 1)).astype(np.int32)
@@ -205,7 +207,10 @@ def _cnn_setup(transport, n=4, batch=16, grad_accum=1, sentry=None):
 def test_fp32_default_is_bit_identical_noop(monkeypatch):
     """grad_transport='fp32' (and unset) must not change the traced program
     at all: identical lowered HLO text."""
+    from tfde_tpu.parallel import zero
+
     monkeypatch.delenv(comms.ENV_TRANSPORT, raising=False)
+    monkeypatch.delenv(zero.ENV_OPT_SHARDING, raising=False)
     strategy = MirroredStrategy(mesh=_dp_mesh(4))
     rng = np.random.default_rng(0)
     images = rng.random((16, 784), np.float32)
@@ -246,8 +251,11 @@ def test_int8_step_lowering_collective_count_and_no_callback():
     """The fixed-five-collectives guarantee, pinned from the lowered HLO:
     pmax + fp32-sidecar psum (all_reduce x2), int8 reduce_scatter x1,
     all_gather x2 — independent of model tensor count — and no host
-    callback sneaks in (the sentry/async-dispatch contract)."""
-    step, state, batch = _cnn_setup("int8")
+    callback sneaks in (the sentry/async-dispatch contract). Pins the
+    REPLICATED budget explicitly — under opt_sharding='shard' the trailing
+    gradient all-gather becomes a param all-gather (see
+    test_sharded_step_lowering_collective_counts)."""
+    step, state, batch = _cnn_setup("int8", opt_sharding="replicated")
     text = step.jitted.lower(state, batch, jax.random.key(0)).as_text()
     assert "callback" not in text
     assert "outfeed" not in text
@@ -259,7 +267,42 @@ def test_int8_step_lowering_collective_count_and_no_callback():
 def test_int8_collective_count_independent_of_grad_accum():
     """Compression happens once per update, AFTER accumulation: the
     collective count must not scale with grad_accum."""
-    step, state, batch = _cnn_setup("int8", grad_accum=4)
+    step, state, batch = _cnn_setup("int8", grad_accum=4,
+                                    opt_sharding="replicated")
+    text = step.jitted.lower(state, batch, jax.random.key(0)).as_text()
+    assert _count(text, '"stablehlo.all_reduce"') == 2
+    assert _count(text, '"stablehlo.reduce_scatter"') == 1
+    assert _count(text, '"stablehlo.all_gather"') == 2
+
+
+def test_sharded_step_lowering_collective_counts():
+    """The ZeRO x transport collective budgets, pinned from the lowered
+    HLO: fp32 x shard = 3 (fp32-sidecar psum + fp32 reduce_scatter + the
+    param all_gather), int8 x shard = 4 (sidecar + pmax all_reduce x2 +
+    int8 reduce_scatter + param all_gather). The trailing gradient
+    all-gather of the replicated int8 path is REPLACED by the updated-
+    param all-gather (grad_norm rides its payload), so every combo stays
+    within PR 5's five-collective budget — and no host callback."""
+    for transport, ar, rs, ag in [("fp32", 1, 1, 1), ("int8", 2, 1, 1)]:
+        step, state, batch = _cnn_setup(transport, opt_sharding="shard")
+        assert state.opt_sharded
+        text = step.jitted.lower(state, batch, jax.random.key(0)).as_text()
+        assert "callback" not in text
+        assert "outfeed" not in text
+        assert _count(text, '"stablehlo.all_reduce"') == ar, transport
+        assert _count(text, '"stablehlo.reduce_scatter"') == rs, transport
+        assert _count(text, '"stablehlo.all_gather"') == ag, transport
+
+
+def test_explicit_replicated_pin_keeps_int8_budget_exact(monkeypatch):
+    """opt_sharding='replicated' (explicit, env cleared) must leave the
+    int8 step exactly as before the ZeRO work: five collectives, no packed
+    opt state — the tier1.sh TFDE_OPT_SHARDING=replicated contract."""
+    from tfde_tpu.parallel import zero
+
+    monkeypatch.delenv(zero.ENV_OPT_SHARDING, raising=False)
+    step, state, batch = _cnn_setup("int8", opt_sharding="replicated")
+    assert not state.opt_sharded
     text = step.jitted.lower(state, batch, jax.random.key(0)).as_text()
     assert _count(text, '"stablehlo.all_reduce"') == 2
     assert _count(text, '"stablehlo.reduce_scatter"') == 1

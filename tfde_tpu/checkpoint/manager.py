@@ -130,6 +130,21 @@ class CheckpointManager:
             # an unrelated ValueError that happens to mention "structure"
             # must surface unrelabeled.
             if self._saved_structure_differs(step, abstract):
+                bridged = self._restore_cross_format(step, state, abstract)
+                if bridged is not None:
+                    log.info(
+                        "restored checkpoint step %d from %s "
+                        "(cross-format opt state)",
+                        int(jax.device_get(bridged.step)), self._dir,
+                    )
+                    from tfde_tpu.observability import flightrec
+
+                    flightrec.record(
+                        "ckpt_restore",
+                        step=int(jax.device_get(bridged.step)),
+                        cross_format=True,
+                    )
+                    return bridged
                 raise ValueError(
                     f"checkpoint step {step} in {self._dir} does not match "
                     f"the current train state's structure — most commonly "
@@ -148,6 +163,110 @@ class CheckpointManager:
             params=restored["params"],
             batch_stats=restored["batch_stats"],
             opt_state=restored["opt_state"],
+        )
+
+    @staticmethod
+    def _find_packed(node):
+        """First ZeRO packed-slot dict (exactly {packed_big, packed_small})
+        in an orbax metadata tree, or None. Marks a checkpoint written with
+        opt_sharding='shard' (parallel/zero.py)."""
+        if isinstance(node, dict):
+            if set(node.keys()) == {"packed_big", "packed_small"}:
+                return node
+            children = node.values()
+        elif isinstance(node, (list, tuple)):
+            children = node
+        else:
+            return None
+        for child in children:
+            found = CheckpointManager._find_packed(child)
+            if found is not None:
+                return found
+        return None
+
+    def _restore_cross_format(self, step, state, abstract):
+        """Bridge the two optimizer-state formats on restore: a checkpoint
+        written with opt_sharding='replicated' resumed into a ZeRO-sharded
+        state (pack after a replicated restore), or one written with
+        'shard' resumed into a replicated state (restore the packed slots,
+        then unpack). Both directions are bit-exact — pack/unpack are pure
+        reshapes of the same numbers. Conservative: any failure returns
+        None and the direct path's structure-mismatch guidance surfaces
+        instead."""
+        try:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from tfde_tpu.parallel import comms as comms_lib
+            from tfde_tpu.parallel import zero as zero_lib
+
+            meta = self._mngr.item_metadata(step)
+            meta = getattr(meta, "tree", meta)
+            saved_packed = self._find_packed(meta["opt_state"])
+            layout = getattr(state, "opt_layout", None)
+            leaves = jax.tree_util.tree_leaves(state.params)
+            if not leaves:
+                return None
+            psh = leaves[0].sharding
+            rep = (NamedSharding(psh.mesh, PartitionSpec())
+                   if hasattr(psh, "mesh") else psh)
+
+            def abstract_rep(tree):
+                return jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                   sharding=rep),
+                    tree,
+                )
+
+            if layout is not None and saved_packed is None:
+                # saved replicated -> live sharded: restore the
+                # params-congruent slots fully replicated, pack, reshard
+                ab_opt = abstract_rep(jax.eval_shape(state.tx.init,
+                                                     state.params))
+                restored = self._restore_opt_variant(step, abstract, ab_opt)
+                opt = zero_lib.pack_opt_state(restored["opt_state"], layout)
+            elif layout is None and saved_packed is not None:
+                # saved sharded -> live replicated: rebuild the writer's
+                # layout from the packed shapes, restore, unpack
+                big_shape = tuple(saved_packed["packed_big"].shape)
+                cand = zero_lib.build_layout(
+                    state.params, comms_lib.CommsConfig(), int(big_shape[0]))
+                if (big_shape != (cand.nshards, cand.chunk_big)
+                        or tuple(saved_packed["packed_small"].shape)
+                        != (cand.nshards, cand.chunk_small)):
+                    return None  # non-default comms block/threshold knobs
+                ab_opt = abstract_rep(jax.eval_shape(
+                    lambda p: state.tx.init(zero_lib.pack_params(p, cand)),
+                    state.params,
+                ))
+                restored = self._restore_opt_variant(step, abstract, ab_opt)
+                opt = zero_lib.unpack_opt_state(restored["opt_state"], cand)
+            else:
+                return None
+            opt = jax.device_put(
+                opt,
+                jax.tree_util.tree_map(lambda x: x.sharding, state.opt_state),
+            )
+            return state.replace(
+                step=restored["step"],
+                params=restored["params"],
+                batch_stats=restored["batch_stats"],
+                opt_state=opt,
+            )
+        except Exception:
+            log.debug("cross-format restore attempt failed", exc_info=True)
+            return None
+
+    def _restore_opt_variant(self, step, abstract, ab_opt):
+        """Restore with the direct path's abstract tree, opt_state swapped
+        for the other format's abstract."""
+        alt = dict(abstract)
+        alt["opt_state"] = ab_opt
+        return retry_call(
+            self._mngr.restore,
+            step,
+            args=ocp.args.StandardRestore(alt),
+            policy=self._retry,
+            what=f"checkpoint restore(step={step}, cross-format)",
+            counter="resilience/checkpoint_retries",
         )
 
     @staticmethod

@@ -231,27 +231,20 @@ def _round(x: jax.Array, rng: Optional[jax.Array]) -> jax.Array:
     return quant_lib.stochastic_round(x, rng)
 
 
-def int8_reduce(
+def _int8_scatter_phase(
     vec: jax.Array,
     residual: jax.Array,
     cfg: CommsConfig,
     axis: str,
     nshards: int,
     rng: Optional[jax.Array] = None,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """The EQuARX-style exchange, called INSIDE a shard_map body.
-
-    `vec` is this device's local contribution (already in final units:
-    sum over devices == the desired global gradient) and `residual` the
-    error-feedback carry from the previous step, both [L] fp32. Returns
-    (global_sum [L] — bit-identical on every device, new_residual [L] —
-    per-device, overflow flag — 1.0 when a quantizer scale went
-    non-finite, i.e. the incoming gradients held NaN/Inf; the numerics
-    sentry trips on it rather than letting saturation pass silently).
-
-    Collectives: pmax (shared block scales) + psum_scatter (int8 payload,
-    int32 accumulator) + all_gather x2 (re-quantized chunks + scales).
-    """
+):
+    """Stages 1-2 of the exchange (shared scales + int8 reduce-scatter),
+    shared between `int8_reduce` (which re-quantizes and all-gathers the
+    gradient back) and `int8_scatter` (ZeRO weight-update sharding,
+    parallel/zero.py: the owner chunk feeds the optimizer directly and the
+    all-gather carries updated params instead). Returns
+    (t, q, scale, partial, overflow, padded, chunk, idx)."""
     if nshards < 2:
         raise ValueError("int8_reduce needs >= 2 shards")
     length = vec.shape[0]
@@ -289,6 +282,64 @@ def int8_reduce(
     idx = jax.lax.axis_index(axis)
     my_scale = jax.lax.dynamic_slice_in_dim(scale, idx * cblocks, cblocks)
     partial = sums.astype(jnp.float32).reshape(-1, cfg.block) * my_scale[:, None]
+    return t, q, scale, partial, overflow, padded, chunk, idx
+
+
+def int8_scatter(
+    vec: jax.Array,
+    residual: jax.Array,
+    cfg: CommsConfig,
+    axis: str,
+    nshards: int,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The scatter-only half of the exchange, for the sharded weight
+    update (parallel/zero.py): returns (owner_chunk [padded/N] — the EXACT
+    dequantized partial sum of the chunk this device owns, new_residual
+    [L] — the input-side quantization error only (there is no
+    re-quantization leg; the chunk feeds the optimizer at full fp32),
+    overflow flag). `vec` must already be padded to the layout quantum
+    contract or shorter — padding appends zeros, which quantize to zero.
+
+    Collectives: pmax + psum_scatter (2; the trailing all-gather of the
+    gradient is replaced by the caller's all-gather of updated params).
+    The EF identity still holds: sum_dev(new_residual) + concat_of_chunks
+    == sum_dev(vec + residual).
+    """
+    length = vec.shape[0]
+    t, q, scale, partial, overflow, padded, chunk, idx = _int8_scatter_phase(
+        vec, residual, cfg, axis, nshards, rng
+    )
+    deq_in = (q.astype(jnp.float32) * scale[:, None]).reshape(padded)
+    new_res = (t - deq_in)[:length]
+    return partial.reshape(chunk), new_res, overflow
+
+
+def int8_reduce(
+    vec: jax.Array,
+    residual: jax.Array,
+    cfg: CommsConfig,
+    axis: str,
+    nshards: int,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The EQuARX-style exchange, called INSIDE a shard_map body.
+
+    `vec` is this device's local contribution (already in final units:
+    sum over devices == the desired global gradient) and `residual` the
+    error-feedback carry from the previous step, both [L] fp32. Returns
+    (global_sum [L] — bit-identical on every device, new_residual [L] —
+    per-device, overflow flag — 1.0 when a quantizer scale went
+    non-finite, i.e. the incoming gradients held NaN/Inf; the numerics
+    sentry trips on it rather than letting saturation pass silently).
+
+    Collectives: pmax (shared block scales) + psum_scatter (int8 payload,
+    int32 accumulator) + all_gather x2 (re-quantized chunks + scales).
+    """
+    length = vec.shape[0]
+    t, q, scale, partial, overflow, padded, chunk, idx = _int8_scatter_phase(
+        vec, residual, cfg, axis, nshards, rng
+    )
 
     # 3. re-quantize the owned chunk's partial sums (fresh blockwise scale
     # — the sum's dynamic range grew by up to nshards)
@@ -324,7 +375,8 @@ def int8_reduce(
 
 
 # -- analytic wire-byte accounting --------------------------------------------
-def comm_bytes(tree: Any, cfg: CommsConfig, nshards: int) -> dict:
+def comm_bytes(tree: Any, cfg: CommsConfig, nshards: int,
+               opt_sharding: str = "replicated") -> dict:
     """Per-step gradient-exchange bytes on the wire, per device, for the
     fp32 ring vs the int8 transport — the numbers behind the
     `comm/bytes_per_step_{fp32,int8}` gauges and the bench `comms` config.
@@ -332,7 +384,14 @@ def comm_bytes(tree: Any, cfg: CommsConfig, nshards: int) -> dict:
     Ring cost model: an all-reduce moves 2(N-1)/N bytes-per-payload-byte,
     a reduce-scatter or all-gather (N-1)/N. The int8 path pays
     reduce-scatter + all-gather on the 1-byte payload plus the fp32 scale
-    sidecars (pmax of block absmaxes, all-gather of re-quant scales)."""
+    sidecars (pmax of block absmaxes, all-gather of re-quant scales).
+
+    Under `opt_sharding='shard'` (parallel/zero.py) the dataflow changes:
+    the big segment is only reduce-SCATTERED (fp32 or int8; no gradient
+    all-gather, no re-quant leg), and a trailing fp32 all-gather moves the
+    updated params (both padded segments + one norm scalar per shard)
+    instead — the `param_gather` key, folded into both transports' totals
+    so the `comm/bytes_per_step_*` gauges stay truthful."""
     nshards = max(int(nshards), 1)
     ring = 2.0 * (nshards - 1) / nshards
     half = (nshards - 1) / nshards
@@ -346,18 +405,36 @@ def comm_bytes(tree: Any, cfg: CommsConfig, nshards: int) -> dict:
     quantum = nshards * cfg.block
     big_pad = -(-big // quantum) * quantum if big else 0
     blocks = big_pad // cfg.block
-    fp32_bytes = 4.0 * ring * (big + small)
-    int8_bytes = (
-        4.0 * ring * small            # packed fp32 sidecar psum
-        + 1.0 * half * big_pad        # int8 reduce-scatter
-        + 1.0 * half * big_pad        # int8 all-gather
-        + 4.0 * ring * blocks         # pmax of block absmaxes
-        + 4.0 * half * blocks         # all-gather of re-quant scales
-    )
+    if opt_sharding == "shard":
+        small_pad = -(-small // nshards) * nshards if small else 0
+        # fp32 all-gather of updated params: both segments + N norm scalars
+        gather = 4.0 * half * (big_pad + small_pad + nshards)
+        fp32_bytes = (
+            4.0 * ring * small        # packed fp32 sidecar psum
+            + 4.0 * half * big_pad    # fp32 reduce-scatter of the big seg
+            + gather
+        )
+        int8_bytes = (
+            4.0 * ring * small        # packed fp32 sidecar psum
+            + 1.0 * half * big_pad    # int8 reduce-scatter
+            + 4.0 * ring * blocks     # pmax of block absmaxes
+            + gather
+        )
+    else:
+        gather = 0.0
+        fp32_bytes = 4.0 * ring * (big + small)
+        int8_bytes = (
+            4.0 * ring * small            # packed fp32 sidecar psum
+            + 1.0 * half * big_pad        # int8 reduce-scatter
+            + 1.0 * half * big_pad        # int8 all-gather
+            + 4.0 * ring * blocks         # pmax of block absmaxes
+            + 4.0 * half * blocks         # all-gather of re-quant scales
+        )
     return {
         "fp32": fp32_bytes,
         "int8": int8_bytes if cfg.transport == "int8" else fp32_bytes,
         "ratio": (int8_bytes / fp32_bytes) if fp32_bytes else 1.0,
+        "param_gather": gather,
         "compressed_elems": big,
         "fp32_elems": small,
     }

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tfde_tpu.parallel import comms as comms_lib
 from tfde_tpu.parallel import sharding as shd
+from tfde_tpu.parallel import zero as zero_lib
 from tfde_tpu.runtime import mesh as mesh_lib
 
 
@@ -45,13 +46,25 @@ class Strategy:
     byte-identical to always) or 'int8' (blockwise-quantized all-reduce
     with error feedback); a CommsConfig tunes threshold/block/rounding.
     None defers to $TFDE_GRAD_TRANSPORT, then 'fp32'.
+
+    `opt_sharding` selects the weight-update layout (parallel/zero.py):
+    'replicated' (default — every replica holds full optimizer state and
+    redoes the full update) or 'shard' (ZeRO-style: optimizer state and
+    update sharded 1/N over the data axis, params all-gathered after).
+    None defers to $TFDE_OPT_SHARDING, then 'replicated'. Warn-falls-back
+    on ineligible meshes/strategies exactly like the comms knob.
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None, grad_transport=None):
+    def __init__(self, mesh: Optional[Mesh] = None, grad_transport=None,
+                 opt_sharding=None):
         self._mesh = mesh
         self._comms = (
             comms_lib.resolve(grad_transport)
             if grad_transport is not None else None
+        )
+        self._opt_sharding = (
+            zero_lib.resolve(opt_sharding)
+            if opt_sharding is not None else None
         )
 
     @property
@@ -71,6 +84,18 @@ class Strategy:
     @comms.setter
     def comms(self, value) -> None:
         self._comms = comms_lib.resolve(value)
+
+    @property
+    def opt_sharding(self) -> str:
+        """The weight-update sharding mode; resolved lazily so an unset
+        knob reads $TFDE_OPT_SHARDING at first use, not at import."""
+        if self._opt_sharding is None:
+            self._opt_sharding = zero_lib.resolve(None)
+        return self._opt_sharding
+
+    @opt_sharding.setter
+    def opt_sharding(self, value) -> None:
+        self._opt_sharding = zero_lib.resolve(value)
 
     def _default_mesh(self) -> Mesh:
         return mesh_lib.data_parallel_mesh()
@@ -176,8 +201,9 @@ class ParameterServerStrategy(Strategy):
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, min_shard_elems: int = 2**14,
-                 grad_transport=None):
-        super().__init__(mesh, grad_transport=grad_transport)
+                 grad_transport=None, opt_sharding=None):
+        super().__init__(mesh, grad_transport=grad_transport,
+                         opt_sharding=opt_sharding)
         self._zero = _ZeroConfig(min_shard_elems)
 
     def opt_state_spec(self, opt_state: Any, params: Any) -> Any:
@@ -274,12 +300,14 @@ class TensorParallelStrategy(Strategy):
 
     def __init__(self, mesh: Optional[Mesh] = None, data: int = 1,
                  extra_rules=(), zero1: bool = False,
-                 min_shard_elems: int = 2**14, grad_transport=None):
+                 min_shard_elems: int = 2**14, grad_transport=None,
+                 opt_sharding=None):
         self._data = data
         self._extra = tuple(extra_rules)
         self._zero1 = zero1
         self._min = min_shard_elems
-        super().__init__(mesh, grad_transport=grad_transport)
+        super().__init__(mesh, grad_transport=grad_transport,
+                         opt_sharding=opt_sharding)
 
     def _default_mesh(self) -> Mesh:
         return mesh_lib.make_mesh({"data": self._data, "tensor": -1})
@@ -333,9 +361,10 @@ class ExpertParallelStrategy(Strategy):
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, data: int = 1,
-                 grad_transport=None):
+                 grad_transport=None, opt_sharding=None):
         self._data = data
-        super().__init__(mesh, grad_transport=grad_transport)
+        super().__init__(mesh, grad_transport=grad_transport,
+                         opt_sharding=opt_sharding)
 
     def _default_mesh(self) -> Mesh:
         return mesh_lib.make_mesh({"data": self._data, "expert": -1})
@@ -372,9 +401,10 @@ class SequenceParallelStrategy(Strategy):
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, data: int = 1,
-                 grad_transport=None):
+                 grad_transport=None, opt_sharding=None):
         self._data = data
-        super().__init__(mesh, grad_transport=grad_transport)
+        super().__init__(mesh, grad_transport=grad_transport,
+                         opt_sharding=opt_sharding)
 
     def _default_mesh(self) -> Mesh:
         return mesh_lib.make_mesh({"data": self._data, "seq": -1})
@@ -414,12 +444,14 @@ class PipelineParallelStrategy(Strategy):
         tensor: int = 1,
         seq: int = 1,
         grad_transport=None,
+        opt_sharding=None,
     ):
         self._data = data
         self._pipe = pipe
         self._tensor = tensor
         self._seq = seq
-        super().__init__(mesh, grad_transport=grad_transport)
+        super().__init__(mesh, grad_transport=grad_transport,
+                         opt_sharding=opt_sharding)
 
     def _default_mesh(self) -> Mesh:
         axes = {"data": self._data, "pipe": self._pipe or -1}
@@ -490,10 +522,12 @@ class FSDPStrategy(Strategy):
         data: int = 1,
         min_shard_elems: int = 2**10,
         grad_transport=None,
+        opt_sharding=None,
     ):
         self._data = data
         self._min = min_shard_elems
-        super().__init__(mesh, grad_transport=grad_transport)
+        super().__init__(mesh, grad_transport=grad_transport,
+                         opt_sharding=opt_sharding)
 
     def _default_mesh(self) -> Mesh:
         return mesh_lib.make_mesh({"data": self._data, "fsdp": -1})

@@ -105,6 +105,13 @@ class RunConfig:
     # meshes), or a comms.CommsConfig for the threshold/block knobs. None
     # defers to the strategy's own grad_transport / $TFDE_GRAD_TRANSPORT.
     grad_transport: Any = None
+    # Weight-update sharding (parallel/zero.py): 'replicated' (the default
+    # — every device runs the full optimizer update), or 'shard' (ZeRO-1:
+    # optimizer state partitioned over the data axis, each device updates
+    # its 1/N chunk and all-gathers the result — ~N x less optimizer
+    # memory on pure-DP meshes). None defers to the strategy's own
+    # opt_sharding / $TFDE_OPT_SHARDING.
+    opt_sharding: Any = None
 
 
 @dataclasses.dataclass
@@ -190,6 +197,10 @@ class Estimator:
             # flips the transport for the whole run (init_state allocates
             # the error-feedback residual off the same strategy.comms)
             self.strategy.comms = self.config.grad_transport
+        if self.config.opt_sharding is not None:
+            # same precedence for the ZeRO knob: init_state decides the
+            # packed-vs-replicated opt layout off strategy.opt_sharding
+            self.strategy.opt_sharding = self.config.opt_sharding
         self._state: Optional[TrainState] = None
         self._ckpt: Optional[CheckpointManager] = None
         self._train_step = None

@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tfde_tpu.ops import losses, metrics as metrics_lib
 from tfde_tpu.parallel import axes as axes_lib
 from tfde_tpu.parallel import comms as comms_lib
+from tfde_tpu.parallel import zero as zero_lib
 from tfde_tpu.parallel.strategies import Strategy
 from tfde_tpu.training.train_state import TrainState
 from tfde_tpu.utils import compat
@@ -141,13 +142,29 @@ def _state_shardings(strategy: Strategy, state: TrainState):
             is_leaf=lambda x: isinstance(x, P),
         )
 
+    if state.opt_layout is not None:
+        # ZeRO-sharded optimizer state (parallel/zero.py): [N, C] chunk
+        # leaves shard row-wise over the data axis — genuinely distributed
+        # arrays, 1/N bytes per device, checkpointed shard-by-shard. On a
+        # mesh whose data axis does not match the layout (e.g. an eval
+        # strategy) the chunks replicate; only the train step needs them
+        # distributed.
+        daxis = comms_lib.data_axis(mesh)
+        if daxis is not None and int(mesh.shape[daxis]) == state.opt_layout.nshards:
+            opt_spec = zero_lib.opt_state_spec(
+                state.opt_state, daxis, state.opt_layout.nshards
+            )
+        else:
+            opt_spec = jax.tree_util.tree_map(lambda _: P(), state.opt_state)
+    else:
+        opt_spec = strategy.opt_state_spec(state.opt_state, state.params)
     return TrainState(
         step=NamedSharding(mesh, P()),
         params=ns(strategy.params_spec(state.params)),
         batch_stats=ns(
             jax.tree_util.tree_map(lambda _: P(), state.batch_stats)
         ),
-        opt_state=ns(strategy.opt_state_spec(state.opt_state, state.params)),
+        opt_state=ns(opt_spec),
         apply_fn=state.apply_fn,
         tx=state.tx,
         # error-feedback residual (parallel/comms.py): nominally replicated
@@ -156,6 +173,7 @@ def _state_shardings(strategy: Strategy, state: TrainState):
         comm_residual=ns(
             jax.tree_util.tree_map(lambda _: P(), state.comm_residual)
         ),
+        opt_layout=state.opt_layout,  # static field: treedefs must match
     )
 
 
@@ -175,20 +193,45 @@ def init_state(
     mesh = strategy.mesh
     ccfg = comms_lib.effective(strategy.comms, mesh)
 
-    def init_fn(rng):
+    def base_init(rng):
         # a tuple sample feeds multi-input models positionally (the T5
         # encoder-decoder takes (input_ids, decoder_input_ids)); a bare
         # array keeps the single-input contract every other family uses
         sample = jax.tree_util.tree_map(jnp.zeros_like, sample_input)
         args = sample if isinstance(sample, tuple) else (sample,)
         variables = model.init(rng, *args, train=False)
-        params = variables["params"]
-        batch_stats = variables.get("batch_stats", {})
+        return variables["params"], variables.get("batch_stats", {})
+
+    # ZeRO weight-update sharding (parallel/zero.py): decide eligibility
+    # from shapes alone, then init the optimizer on the PACKED params (tx
+    # init depends on param values for e.g. param-EMA slots, so pack the
+    # real values, not zeros) with the chunk arrays born sharded.
+    layout = None
+    if zero_lib.resolve(strategy.opt_sharding) == "shard":
+        ab_params, _ = jax.eval_shape(base_init, jax.random.key(seed))
+        zaxis = zero_lib.eligible_axis(strategy, ab_params)
+        if zaxis is not None:
+            if zero_lib.packable(jax.eval_shape(tx.init, ab_params)):
+                layout = zero_lib.build_layout(
+                    ab_params, ccfg, int(mesh.shape[zaxis])
+                )
+            else:
+                log.warning(
+                    "opt_sharding='shard' with a masked optimizer "
+                    "(optax.masked / a decay mask) would re-evaluate the "
+                    "mask on the packed tree — falling back to replicated"
+                )
+
+    def init_fn(rng):
+        params, batch_stats = base_init(rng)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
             batch_stats=batch_stats,
-            opt_state=tx.init(params),
+            opt_state=(
+                tx.init(zero_lib.pack_params(params, layout))
+                if layout is not None else tx.init(params)
+            ),
             apply_fn=model.apply,
             tx=tx,
             # int8 transport: allocate the error-feedback residual up
@@ -198,6 +241,7 @@ def init_state(
                 comms_lib.init_residual(params, ccfg)
                 if ccfg.transport == "int8" else None
             ),
+            opt_layout=layout,
         )
 
     abstract = jax.eval_shape(init_fn, jax.random.key(seed))
@@ -261,12 +305,46 @@ def _resolve_comms(strategy: Strategy, state: TrainState, comms):
     return cfg
 
 
-def _make_int8_step(strategy: Strategy, state: TrainState, loss_fn,
-                    cfg: comms_lib.CommsConfig, grad_accum: int):
-    """Build the int8-transport step fn: gradients computed per device on
-    the LOCAL batch shard inside a `shard_map` over the data axis, then
-    exchanged through the quantized all-reduce (parallel/comms.py) instead
-    of the partitioner's implicit fp32 psum.
+def _resolve_opt_sharding(strategy: Strategy, state: TrainState,
+                          opt_sharding=None) -> bool:
+    """The one resolution point for the weight-update sharding knob
+    (parallel/zero.py): the STATE's physical layout is authoritative — the
+    optimizer state either is packed/sharded or it is not — and the knob
+    (explicit arg > strategy > $TFDE_OPT_SHARDING) only gets to warn when
+    it disagrees (state built before the knob was set, or an ineligible
+    mesh already fell back at init)."""
+    mode = zero_lib.resolve(
+        opt_sharding if opt_sharding is not None else strategy.opt_sharding
+    )
+    if state.opt_layout is not None:
+        if mode != "shard":
+            log.warning(
+                "opt_sharding='replicated' requested but the TrainState "
+                "carries a sharded (packed) optimizer state — using the "
+                "sharded update. Re-init the state to change layouts."
+            )
+        return True
+    if mode == "shard":
+        log.warning(
+            "opt_sharding='shard' but the TrainState's optimizer state is "
+            "replicated (built before the knob was set, or the mesh/"
+            "optimizer was ineligible at init) — falling back to the "
+            "replicated update. Re-init the state with the strategy's "
+            "opt_sharding set."
+        )
+    return False
+
+
+def _make_comms_step(strategy: Strategy, state: TrainState, loss_fn,
+                     cfg: comms_lib.CommsConfig, grad_accum: int):
+    """Build the explicit-exchange step fn: gradients computed per device
+    on the LOCAL batch shard inside a `shard_map` over the data axis, then
+    exchanged through the quantized all-reduce (parallel/comms.py) and/or
+    updated through the ZeRO owner-chunk path (parallel/zero.py) instead
+    of the partitioner's implicit fp32 psum + replicated update. Serves
+    three of the four mode combinations (int8 x replicated — the original
+    `_make_int8_step` — plus fp32/int8 x sharded); fp32 x replicated never
+    reaches here, keeping that jaxpr byte-identical.
 
     The microbatch semantics match the fp32 path exactly: the device-major
     split there means global microbatch `a` is the concatenation of every
@@ -281,14 +359,28 @@ def _make_int8_step(strategy: Strategy, state: TrainState, loss_fn,
     in the shard index (per-shard masks instead of one global mask — same
     statistics, different bits), and BatchNorm batch statistics are the
     mean of per-shard statistics.
+
+    Sharded-update collective budget (within PR 5's five-collective pin):
+    fp32 x shard = sidecar psum + fp32 psum_scatter + param all_gather
+    (3); int8 x shard = sidecar psum + scale pmax + int8 psum_scatter +
+    param all_gather (4) — the gradient all-gather x2 of the replicated
+    int8 path is REPLACED by one fp32 all-gather of updated params, which
+    also carries each chunk's squared grad-norm so `grad_norm` costs no
+    extra collective.
     """
     mesh = strategy.mesh
     axis = comms_lib.data_axis(mesh)
     nshards = int(mesh.shape[axis])
     apply_fn, tx = state.apply_fn, state.tx
+    zlay = state.opt_layout
     mask_leaves = jax.tree_util.tree_leaves(
         comms_lib.compress_mask(state.params, cfg)
     )
+    if zlay is not None:
+        assert tuple(mask_leaves) == zlay.mask, (
+            "opt_layout disagrees with the comms compress mask — state "
+            "built under a different CommsConfig than the step's"
+        )
 
     def micro_grads_local(pstate, mb, r):
         def wrapped(params):
@@ -310,12 +402,12 @@ def _make_int8_step(strategy: Strategy, state: TrainState, loss_fn,
         return (jnp.ones((), jnp.float32) if w is None
                 else jnp.asarray(w, jnp.float32))
 
-    def body(step_c, params, batch_stats, residual, batch, key):
+    def body(step_c, params, batch_stats, opt_local, residual, batch, key):
         shard = jax.lax.axis_index(axis)
         key = jax.random.fold_in(key, shard)
         pstate = TrainState(
             step=step_c, params=params, batch_stats=batch_stats,
-            opt_state=(), apply_fn=apply_fn, tx=tx,
+            opt_state=opt_local, apply_fn=apply_fn, tx=tx,
         )
         # -- local microbatch accumulation (mirrors the fp32 path) --------
         if grad_accum == 1:
@@ -391,6 +483,87 @@ def _make_int8_step(strategy: Strategy, state: TrainState, loss_fn,
         # wsum == 0 (every microbatch weightless on every shard) must give
         # the clean zero-gradient update, same as the fp32 path
         inv = 1.0 / jnp.where(wsum_g > 0, wsum_g, 1.0)
+        metrics_out = {k: v * inv for k, v in zip(mkeys, metrics_g)}
+        new_stats = jax.tree_util.tree_unflatten(stats_def, stats_g)
+
+        if zlay is not None:
+            # -- ZeRO owner-chunk update (parallel/zero.py): reduce-
+            # SCATTER the mean gradient, update only this replica's 1/N
+            # packed slice (optimizer state is the matching local slice),
+            # then all-gather updated params — the gradient all-gather of
+            # the replicated path becomes a param all-gather, whose
+            # payload also carries each chunk's squared grad-norm.
+            idx = jax.lax.axis_index(axis)
+            cb, cs = zlay.chunk_big, zlay.chunk_small
+            if big_g:
+                gvec, _ = comms_lib.pack([g * inv for g in big_g])
+                if cfg.transport == "int8":
+                    rvec, rshapes = comms_lib.pack(big_r)
+                    g_chunk, new_rvec, overflow = comms_lib.int8_scatter(
+                        gvec, rvec, cfg, axis, nshards,
+                        rng=(jax.random.fold_in(key, grad_accum)
+                             if cfg.stochastic else None),
+                    )
+                    new_big_r = comms_lib.unpack(new_rvec, rshapes)
+                else:
+                    gvec = jnp.pad(
+                        gvec, (0, zlay.padded_big - gvec.shape[0])
+                    )
+                    g_chunk = jax.lax.psum_scatter(
+                        gvec, axis, scatter_dimension=0, tiled=True
+                    )
+                    overflow = jnp.zeros((), jnp.float32)
+                    new_big_r = list(big_r)
+            else:
+                g_chunk = jnp.zeros((cb,), jnp.float32)
+                overflow = jnp.zeros((), jnp.float32)
+                new_big_r = []
+            svec, _ = comms_lib.pack([s * inv for s in small_sum])
+            svec = jnp.pad(svec, (0, zlay.padded_small - svec.shape[0]))
+            s_chunk = jax.lax.dynamic_slice_in_dim(svec, idx * cs, cs)
+            pb_vec, ps_vec = zero_lib.segment_vectors(params, zlay)
+            g_chunks = {
+                zero_lib.BIG: g_chunk[None],
+                zero_lib.SMALL: s_chunk[None],
+            }
+            p_chunks = {
+                zero_lib.BIG: jax.lax.dynamic_slice_in_dim(
+                    pb_vec, idx * cb, cb)[None],
+                zero_lib.SMALL: jax.lax.dynamic_slice_in_dim(
+                    ps_vec, idx * cs, cs)[None],
+            }
+            new_p_chunks, new_opt = pstate.apply_chunk_gradients(
+                g_chunks, p_chunks
+            )
+            gnorm_sq = (jnp.sum(jnp.square(g_chunk))
+                        + jnp.sum(jnp.square(s_chunk)))
+            payload = jnp.concatenate([
+                new_p_chunks[zero_lib.BIG].reshape(-1),
+                new_p_chunks[zero_lib.SMALL].reshape(-1),
+                gnorm_sq[None],
+            ])
+            full = jax.lax.all_gather(payload, axis, tiled=True)
+            full = full.reshape(nshards, cb + cs + 1)
+            new_params = zero_lib.unpack_params(
+                full[:, :cb].reshape(-1),
+                full[:, cb:cb + cs].reshape(-1),
+                zlay,
+            )
+            grad_norm = jnp.sqrt(jnp.sum(full[:, -1]))
+            if residual is None:
+                new_residual = None
+            else:
+                new_res_l, bi = [], 0
+                for r, c in zip(res_l, mask_leaves):
+                    if c:
+                        new_res_l.append(new_big_r[bi])
+                        bi += 1
+                    else:
+                        new_res_l.append(r)
+                new_residual = jax.tree_util.tree_unflatten(gdef, new_res_l)
+            return (new_params, new_opt, loss_g * inv, metrics_out,
+                    new_stats, new_residual, overflow,
+                    jnp.sqrt(res_sq_g), grad_norm)
 
         if big_g:
             gvec, gshapes = comms_lib.pack(
@@ -420,8 +593,6 @@ def _make_int8_step(strategy: Strategy, state: TrainState, loss_fn,
                 si += 1
         grads_mean = jax.tree_util.tree_unflatten(gdef, out_l)
         new_residual = jax.tree_util.tree_unflatten(gdef, new_res_l)
-        new_stats = jax.tree_util.tree_unflatten(stats_def, stats_g)
-        metrics_out = {k: v * inv for k, v in zip(mkeys, metrics_g)}
         return (grads_mean, loss_g * inv, metrics_out, new_stats,
                 new_residual, overflow, jnp.sqrt(res_sq_g))
 
@@ -437,23 +608,52 @@ def _make_int8_step(strategy: Strategy, state: TrainState, loss_fn,
         batch_specs = jax.tree_util.tree_map(
             lambda l: P(axis, *(None,) * (l.ndim - 1)), batch
         )
-        exchanged = compat.shard_map(
+        if zlay is None:
+            exchanged = compat.shard_map(
+                lambda s, p, bs, r, b, k: body(s, p, bs, (), r, b, k),
+                mesh,
+                in_specs=(P(), P(), P(), P(), batch_specs, P()),
+                out_specs=P(),
+                check_vma=False,  # the residual is deliberately device-varying
+            )(state.step, state.params, state.batch_stats,
+              state.comm_residual, batch, step_rng)
+            grads, loss, metrics, new_stats, new_residual, overflow, res_norm = (
+                exchanged
+            )
+            new_state = state.apply_gradients(
+                grads, new_batch_stats=new_stats,
+                new_comm_residual=new_residual
+            )
+            metrics = dict(metrics)
+            metrics.setdefault("grad_norm", optax.global_norm(grads))
+            metrics["comm_residual_norm"] = res_norm
+            metrics["comm_overflow"] = overflow
+            return new_state, {"loss": loss, **metrics}
+
+        # sharded update: params/opt emerge from the shard_map already
+        # final — no apply_gradients outside (the update ran on-chunk)
+        opt_specs = zero_lib.opt_state_spec(state.opt_state, axis, nshards)
+        outs = compat.shard_map(
             body, mesh,
-            in_specs=(P(), P(), P(), P(), batch_specs, P()),
-            out_specs=P(),
+            in_specs=(P(), P(), P(), opt_specs, P(), batch_specs, P()),
+            out_specs=(P(), opt_specs, P(), P(), P(), P(), P(), P(), P()),
             check_vma=False,  # the residual is deliberately device-varying
-        )(state.step, state.params, state.batch_stats, state.comm_residual,
-          batch, step_rng)
-        grads, loss, metrics, new_stats, new_residual, overflow, res_norm = (
-            exchanged
-        )
-        new_state = state.apply_gradients(
-            grads, new_batch_stats=new_stats, new_comm_residual=new_residual
+        )(state.step, state.params, state.batch_stats, state.opt_state,
+          state.comm_residual, batch, step_rng)
+        (new_params, new_opt, loss, metrics, new_stats, new_residual,
+         overflow, res_norm, grad_norm) = outs
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+            comm_residual=new_residual,
         )
         metrics = dict(metrics)
-        metrics.setdefault("grad_norm", optax.global_norm(grads))
-        metrics["comm_residual_norm"] = res_norm
-        metrics["comm_overflow"] = overflow
+        metrics.setdefault("grad_norm", grad_norm)
+        if cfg.transport == "int8":
+            metrics["comm_residual_norm"] = res_norm
+            metrics["comm_overflow"] = overflow
         return new_state, {"loss": loss, **metrics}
 
     return step
@@ -464,7 +664,9 @@ def _export_comm_gauges(state: TrainState, cfg, nshards: int) -> None:
     once at step-build time (the numbers are static per model x config)."""
     from tfde_tpu.observability import metrics as obs_metrics
 
-    b = comms_lib.comm_bytes(state.params, cfg, nshards)
+    opt_sharding = "shard" if state.opt_layout is not None else "replicated"
+    b = comms_lib.comm_bytes(state.params, cfg, nshards,
+                             opt_sharding=opt_sharding)
     reg = obs_metrics.default_registry()
     reg.gauge("comm/bytes_per_step_fp32").set(b["fp32"])
     reg.gauge("comm/bytes_per_step_int8").set(b["int8"])
@@ -473,8 +675,26 @@ def _export_comm_gauges(state: TrainState, cfg, nshards: int) -> None:
     reg.gauge("comm/fp32_elems").set(b["fp32_elems"])
 
 
+def _export_opt_gauges(state: TrainState) -> None:
+    """Publish the weight-update-sharding memory/wire accounting as opt/*
+    gauges: per-device optimizer-state bytes (the ~N x saving the ZeRO
+    layout buys) and the trailing param all-gather's wire bytes (0 when
+    replicated — there is no gather). Static per model x config, set once
+    at step-build time."""
+    from tfde_tpu.observability import metrics as obs_metrics
+
+    reg = obs_metrics.default_registry()
+    reg.gauge("opt/state_bytes").set(
+        zero_lib.state_bytes(state.opt_state, state.opt_layout)
+    )
+    reg.gauge("opt/param_gather_bytes").set(
+        zero_lib.param_gather_bytes(state.opt_layout)
+    )
+
+
 def make_train_step(strategy: Strategy, state: TrainState, donate: bool = True,
-                    grad_accum: int = 1, sentry=None, comms=None):
+                    grad_accum: int = 1, sentry=None, comms=None,
+                    opt_sharding=None):
     """Compile train_step with the strategy's shardings pinned. `grad_accum`
     splits the batch into that many sequential microbatches per update (see
     make_custom_train_step). `sentry` (a SentryConfig) fuses the numerics
@@ -482,13 +702,19 @@ def make_train_step(strategy: Strategy, state: TrainState, donate: bool = True,
     returns an extra sentry-state pytree: (state, batch, rng, sstate) ->
     (state, metrics, sstate). `comms` overrides the strategy's
     grad_transport knob (parallel/comms.py); int8 routes through the
-    custom-step machinery, fp32 is byte-identical to always."""
+    custom-step machinery, fp32 is byte-identical to always.
+    `opt_sharding` overrides the strategy's weight-update-sharding knob
+    (parallel/zero.py); a sharded (packed-opt) state routes through the
+    custom-step machinery too."""
     cfg = _resolve_comms(strategy, state, comms)
-    if grad_accum != 1 or cfg.transport == "int8":
+    if (grad_accum != 1 or cfg.transport == "int8"
+            or _resolve_opt_sharding(strategy, state, opt_sharding)):
         return make_custom_train_step(
             strategy, state, _classification_loss, donate=donate,
             grad_accum=grad_accum, sentry=sentry, comms=cfg,
+            opt_sharding=opt_sharding,
         )
+    _export_opt_gauges(state)
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
     if sentry is None:
@@ -515,6 +741,7 @@ def make_custom_train_step(
     grad_accum: int = 1,
     sentry=None,
     comms=None,
+    opt_sharding=None,
 ):
     """Compile a train step with a user loss over an arbitrary batch pytree.
 
@@ -549,12 +776,21 @@ def make_custom_train_step(
     byte-identical to the historical path; 'int8' swaps the step body for
     the quantized exchange with error feedback — compression happens once
     per update, after grad accumulation.
+
+    `opt_sharding` selects the weight-update layout (parallel/zero.py):
+    None reads the strategy's knob; 'replicated' (the default) keeps every
+    replica updating the full params; a state whose optimizer state was
+    built sharded ('shard' at init_state) routes through the same
+    explicit-exchange body as int8, with the update run on each replica's
+    owned 1/N chunk and updated params all-gathered — composing with both
+    transports inside the five-collective budget.
     """
     shardings = _state_shardings(strategy, state)
     batch_sh = strategy.batch_sharding()
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     ccfg = _resolve_comms(strategy, state, comms)
+    zshard = _resolve_opt_sharding(strategy, state, opt_sharding)
 
     def micro_grads(state: TrainState, batch, rng):
         def wrapped(params):
@@ -656,16 +892,18 @@ def make_custom_train_step(
         )
         return new_state, {"loss": loss, **metrics}
 
-    if ccfg.transport == "int8":
-        # swap the whole step body: local grads + explicit quantized
-        # exchange instead of the partitioner's implicit fp32 psum. The
-        # fp32 `step` above is never traced, so the default path's jaxpr
-        # stays byte-identical.
-        step = _make_int8_step(strategy, state, loss_fn, ccfg, grad_accum)
+    if ccfg.transport == "int8" or zshard:
+        # swap the whole step body: local grads + explicit exchange
+        # (quantized and/or owner-chunk-updated) instead of the
+        # partitioner's implicit fp32 psum + replicated update. The fp32
+        # `step` above is never traced, so the default path's jaxpr stays
+        # byte-identical.
+        step = _make_comms_step(strategy, state, loss_fn, ccfg, grad_accum)
         _export_comm_gauges(
             state, ccfg,
             int(strategy.mesh.shape[comms_lib.data_axis(strategy.mesh)]),
         )
+    _export_opt_gauges(state)
 
     def batch_shardings(batch):
         return jax.tree_util.tree_map(lambda _: batch_sh, batch)

@@ -32,6 +32,30 @@ class TrainState(flax.struct.PyTreeNode):
     # zeros — a few warm-up steps of extra quantization error, and
     # fp32<->int8 checkpoint resume stays compatible in both directions.
     comm_residual: Any = None
+    # ZeRO weight-update sharding (parallel/zero.py): the static chunk
+    # layout when the optimizer state is packed/sharded over the data axis
+    # ({packed_big: [N, Cb], packed_small: [N, Cs]} slots instead of
+    # params-congruent ones), or None for the replicated default. Static
+    # (non-pytree) so the step builder can branch on it at trace time; a
+    # Layout is hashable, so treedefs still compare/jit-cache correctly.
+    opt_layout: Any = flax.struct.field(pytree_node=False, default=None)
+
+    @property
+    def opt_sharded(self) -> bool:
+        return self.opt_layout is not None
+
+    def apply_chunk_gradients(self, grad_chunks, param_chunks):
+        """The ZeRO owner-chunk update: run the optimizer on this replica's
+        1/N packed slice only. `grad_chunks`/`param_chunks` are local
+        {packed_big: [1, Cb], packed_small: [1, Cs]} trees and
+        `self.opt_state` the matching local slice (inside the step's
+        shard_map body). Returns (new_param_chunks, new_opt_state). For
+        elementwise transforms this is bit-identical to the replicated
+        per-leaf update — see parallel/zero.py's correctness contract."""
+        updates, new_opt_state = self.tx.update(
+            grad_chunks, self.opt_state, param_chunks
+        )
+        return optax.apply_updates(param_chunks, updates), new_opt_state
 
     def apply_gradients(self, grads, new_batch_stats=None,
                         new_comm_residual=None):

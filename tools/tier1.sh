@@ -10,6 +10,13 @@
 #                                   quantized gradient exchange as the
 #                                   default transport — parallel/comms.py;
 #                                   non-DP meshes warn-fallback to fp32)
+#        TFDE_OPT_SHARDING=shard tools/tier1.sh
+#                                  (re-run with ZeRO weight-update
+#                                   sharding as the default —
+#                                   parallel/zero.py; ineligible meshes/
+#                                   optimizers warn-fallback to
+#                                   replicated, and parity-pinning tests
+#                                   request 'replicated' explicitly)
 #
 # Also prints DOTS_DELTA (this run's DOTS_PASSED minus the previous
 # run's, from /tmp/_t1.passed) so a regression is visible at a glance
@@ -20,6 +27,7 @@ cd "$(dirname "$0")/.." || exit 1
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     TFDE_GRAD_TRANSPORT="${TFDE_GRAD_TRANSPORT:-fp32}" \
+    TFDE_OPT_SHARDING="${TFDE_OPT_SHARDING:-replicated}" \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     --durations=10 \
