@@ -45,6 +45,9 @@ Configs measured (each in try/except; one failure never kills the line):
   mnist_e2e — same model fed by the real host pipeline: images/sec/chip
   bert    — BERT-base MLM fwd+bwd bf16 @ seq 512: MFU vs chip peak
   flash   — Pallas flash kernel vs reference attention @ S=2048
+  gpt_long_win — gpt_long with Gemma-2 deltas (alternating window 1024 +
+            softcap 50) on the fused path, MFU vs the windowed-flop model
+            (ops/roofline.py; tools/roofline.py has the per-op view)
 
 Env knobs: TFDE_BENCH_BUDGET_S (total retry budget, default 900),
 TFDE_BENCH_ATTEMPT_TIMEOUT_S (per attempt, default 600),
@@ -1066,16 +1069,26 @@ def _bench_flash(clock: _Clock, smoke: bool) -> dict:
 
 
 def gpt_train_flops_per_token(hidden: int, mlp: int, depth: int,
-                              seq: int, vocab: int) -> float:
+                              seq: int, vocab: int, window=None,
+                              window_pattern: str = "all") -> float:
     """Analytic matmul FLOPs per token for one causal-LM fwd+bwd step: qkvo
-    + mlp per-layer terms as in BERT, attention matmuls counted at HALF the
-    bidirectional figure (2*S*H not 4*S*H) because the flash kernel's
-    causal predication skips future K-tiles entirely — counting full
-    attention would inflate MFU by ~20% at S=4096. The diagonal tiles make
-    true executed work (n+1)/2n of full, so half-counting is ~1/(2n)
-    conservative. Plus the tied LM head 2HV; training = 3x forward."""
-    per_layer = 8 * hidden * hidden + 4 * hidden * mlp + 2 * seq * hidden
-    return 3.0 * (depth * per_layer + 2 * hidden * vocab)
+    + mlp per-layer terms as in BERT; attention matmuls credited by the
+    EXACT in-band count from ops/roofline.py — (S+1)/2 mean attended keys
+    for plain causal (the flash kernels skip future tiles in forward AND
+    backward, so counting full bidirectional attention would inflate MFU
+    by ~20% at S=4096; the old half-count 2*S*H was ~1/(2n) conservative
+    on the diagonal, now exact), the triangle-plus-band mean for a
+    sliding `window`, and the per-layer average when `window_pattern=
+    'alternate'` windows only even layers (gpt_long_win / Gemma-2). Plus
+    the tied LM head 2HV; training = 3x forward."""
+    from tfde_tpu.ops.roofline import stacked_attention_flops_per_token
+
+    per_layer = 8 * hidden * hidden + 4 * hidden * mlp
+    attn = stacked_attention_flops_per_token(
+        hidden, seq, depth, causal=True, window=window,
+        window_pattern=window_pattern,
+    )
+    return 3.0 * (depth * per_layer + attn + 2 * hidden * vocab)
 
 
 def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
@@ -1092,6 +1105,14 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
       attributes the 42%-vs-73% gap to h=768 GEMM efficiency, and this
       config measures what wider GEMMs recover (36.6% at first light vs
       20% for gpt_long: width + shorter S both lift it).
+    - ``gpt_long_win``: the Gemma-2-shaped variant of gpt_long — sliding
+      window 1024 with window_pattern='alternate' plus attention logit
+      softcap 50.0, all running through the fused flash kernels (forward
+      AND backward skip out-of-band tiles). MFU is reported against the
+      corrected windowed-flop model (gpt_train_flops_per_token with
+      window/pattern — ops/roofline.py credits banded layers their true
+      in-band work), so the number is comparable to gpt_long instead of
+      flattered by phantom full-causal flops.
     """
     import jax
     import numpy as np
@@ -1101,6 +1122,7 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
     from tfde_tpu.training.step import init_state, make_custom_train_step
 
     medium = prefix == "gpt_medium"
+    windowed = prefix == "gpt_long_win"
     if smoke:
         import jax.numpy as jnp
 
@@ -1108,9 +1130,26 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
         model = GPT(vocab_size=512, hidden_size=64, depth=2, num_heads=2,
                     mlp_dim=128, max_position=seq, dtype=jnp.float32,
                     attn_impl="flash" if medium else "auto",
-                    # smoke must cover the remat path gpt_long4 ships with
+                    # smoke must cover the knob composition the full
+                    # configs ship with: gpt_long4's remat, gpt_long_win's
+                    # alternating window + softcap
+                    sliding_window=64 if windowed else None,
+                    sliding_window_pattern="alternate" if windowed
+                    else "all",
+                    attn_logit_cap=50.0 if windowed else None,
                     remat="dots" if prefix == "gpt_long4" else False)
         warmup = 1
+    elif windowed:
+        # gpt_long with the Gemma-2 attention deltas: even layers banded at
+        # 1024, odd layers full causal, logits softcapped at 50 — the
+        # whole stack stays on the fused flash path (auto-dispatch at
+        # S=4096), and MFU below uses the windowed-flop model
+        seq, per_chip_batch = 4096, 1
+        model = GPT(max_position=seq, dropout_rate=0.0,  # GPT-2 small dims
+                    sliding_window=1024,
+                    sliding_window_pattern="alternate",
+                    attn_logit_cap=50.0)
+        warmup = 2
     elif medium:
         seq, per_chip_batch = 1024, 8
         model = GPT(hidden_size=1024, depth=24, num_heads=16, mlp_dim=4096,
@@ -1156,7 +1195,9 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
     step_s = window / reps
     tokens_per_step = global_batch * seq
     flops_per_token = gpt_train_flops_per_token(
-        model.hidden_size, model.mlp_dim, model.depth, seq, model.vocab_size
+        model.hidden_size, model.mlp_dim, model.depth, seq,
+        model.vocab_size, window=model.sliding_window,
+        window_pattern=model.sliding_window_pattern,
     )
     achieved = tokens_per_step * flops_per_token / step_s / n_chips
     out = {
@@ -1164,6 +1205,9 @@ def _bench_gpt_long(clock: _Clock, strategy, n_chips: int, peak: float,
         f"{prefix}_step_ms": round(step_s * 1e3, 2),
         f"{prefix}_loss_moved": bool(abs(loss_end - loss_start) > 1e-9),
     }
+    if model.sliding_window is not None:
+        out[f"{prefix}_window"] = model.sliding_window
+        out[f"{prefix}_window_pattern"] = model.sliding_window_pattern
     if _gate(out, prefix, achieved, peak):
         out.update({
             f"{prefix}_mfu": round(achieved / peak, 4),
@@ -1185,11 +1229,14 @@ def moe_gpt_train_flops_per_token(hidden: int, mlp: int, depth: int,
     the router GEMM 2HE. The dispatch/combine one-hot einsums are real
     MXU work but move no information per FLOP, so they are NOT counted:
     `moe_mfu` is useful-FLOP MFU and understates hardware utilization —
-    the honest direction (the same rule that half-counts causal
-    attention in gpt_train_flops_per_token)."""
+    the honest direction (attention credited at the exact in-band count
+    from ops/roofline.py, same as gpt_train_flops_per_token)."""
+    from tfde_tpu.ops.roofline import attention_flops_per_token
+
     n_moe = depth // moe_every
     n_dense = depth - n_moe
-    attn_qkvo = 8 * hidden * hidden + 2 * seq * hidden
+    attn_qkvo = (8 * hidden * hidden
+                 + attention_flops_per_token(hidden, seq, causal=True))
     dense_layer = attn_qkvo + 4 * hidden * mlp
     moe_layer = (attn_qkvo + experts_per_token * 4 * hidden * mlp
                  + 2 * hidden * num_experts)
@@ -1644,6 +1691,9 @@ def run_mode() -> None:
         ("gpt_long4", lambda: _bench_gpt_long(clock, strategy, n_chips,
                                               peak, smoke,
                                               prefix="gpt_long4")),
+        ("gpt_long_win", lambda: _bench_gpt_long(clock, strategy, n_chips,
+                                                 peak, smoke,
+                                                 prefix="gpt_long_win")),
         ("moe", lambda: _bench_moe(clock, strategy, n_chips, peak, smoke)),
         ("decode", lambda: _bench_decode(clock, smoke)),
         ("serve", lambda: _bench_serve(clock, smoke)),

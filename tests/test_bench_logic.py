@@ -43,11 +43,13 @@ def test_bert_flops_formula_scales_correctly():
 
 def test_gpt_flops_formula_vs_bert():
     # GPT drops the MLM transform dense (2H^2) and counts causal attention
-    # at half the bidirectional figure (the flash kernel skips future
-    # tiles; counting full would inflate MFU)
+    # at the EXACT in-band figure — mean (S+1)/2 attended keys vs BERT's
+    # bidirectional S (ops/roofline.py; the flash kernels skip future
+    # tiles in forward AND backward, so counting full would inflate MFU)
     b = bench.bert_train_flops_per_token(768, 3072, 12, 512, 50257)
     g = bench.gpt_train_flops_per_token(768, 3072, 12, 512, 50257)
-    assert b - g == 3 * (2 * 768 * 768 + 12 * 2 * 512 * 768)
+    attn_delta = 12 * (4 * 512 * 768 - 4 * 768 * (512 + 1) / 2)
+    assert b - g == 3 * (2 * 768 * 768 + attn_delta)
 
 
 def test_last_json_salvages_cumulative_lines():

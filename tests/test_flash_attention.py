@@ -139,7 +139,7 @@ def test_auto_dispatch_flash_on_tpu_threshold(monkeypatch):
         chosen.append("flash")
         return q
 
-    def fake_ref(q, k, v, mask=None, causal=False, window=None):
+    def fake_ref(q, k, v, mask=None, causal=False, window=None, **kw):
         chosen.append("reference")
         return q
 

@@ -340,7 +340,7 @@ def test_auto_dispatch_skips_flash_under_abstract_mesh(monkeypatch):
     monkeypatch.setattr(att, "_on_tpu", lambda: True)
     monkeypatch.setattr(
         att, "reference_attention",
-        lambda q, k, v, mask=None, causal=False, window=None:
+        lambda q, k, v, mask=None, causal=False, window=None, **kw:
         (chosen.append("reference"), q)[1],
     )
     q = jnp.zeros((1, 4096, 1, 4), jnp.bfloat16)

@@ -99,10 +99,11 @@ class GPT(nn.Module):
     # int8; wpe and norms stay fp32. Build params with quantize_model.
     quant: Optional[str] = None
     # sliding-window attention (the Mistral family): each position attends
-    # the last `sliding_window` positions. The flash FORWARD skips
-    # out-of-band tiles (compute and DMA drop to O(S * window)); the
-    # backward currently masks but still scans all tiles (full-causal
-    # cost). The decode cache mask carries the band. None = full causal.
+    # the last `sliding_window` positions. The flash forward AND backward
+    # skip out-of-band tiles (compute and DMA drop to O(S * window) for
+    # the full fwd+bwd step — the backward scans only the statically
+    # in-band tile pairs). The decode cache mask carries the band.
+    # None = full causal.
     sliding_window: Optional[int] = None
     # 'all' | 'alternate' (Gemma-2: even blocks windowed, odd blocks full)
     sliding_window_pattern: str = "all"

@@ -107,10 +107,10 @@ class MultiHeadAttention(nn.Module):
     window: Optional[int] = None
     # Gemma-2 attention deltas: attn_scale overrides the 1/sqrt(head_dim)
     # score scale (query_pre_attn_scalar^-0.5); attn_logit_cap softcaps
-    # scores (cap * tanh(s/cap)). Either set routes attention to the
-    # grouped einsum directly — the flash kernel and the seq ring do not
-    # implement them, and a silent fallback that DROPPED the cap would be
-    # a different model.
+    # scores (cap * tanh(s/cap)). Both route through the attention()
+    # dispatcher like every other knob — the flash kernel applies them
+    # inside the fused forward AND backward and the seq ring inside its
+    # chunk step, so capped models train fused and sequence-parallel.
     attn_scale: Optional[float] = None
     attn_logit_cap: Optional[float] = None
     # rolling KV cache (decode + window only): the cache holds min(budget,
@@ -201,30 +201,22 @@ class MultiHeadAttention(nn.Module):
                 )
             y = self._decode_attention(q, k, v, b)
         else:
-            if self.attn_scale is not None or self.attn_logit_cap is not None:
-                from tfde_tpu.ops.attention import _seq_parallel_active
-
-                if _seq_parallel_active():
-                    raise NotImplementedError(
-                        "attn_scale/attn_logit_cap (the Gemma-2 attention "
-                        "deltas) do not compose with sequence parallelism "
-                        "— the ring does not implement them"
-                    )
-                y = attn_lib.grouped_attention(
-                    q, k, v, mask=mask, causal=self.causal,
-                    window=self.window, scale=self.attn_scale,
-                    logit_cap=self.attn_logit_cap,
-                )
-            else:
-                # GQA included: K/V stay kv_heads-shaped end to end — the
-                # dispatcher routes to the flash kernel (GQA head-folding
-                # index maps), the seq ring (kv_heads-sized shards
-                # rotate), or the grouped einsum; never a
-                # repeat-then-attend expansion
-                y = attn_lib.attention(
-                    q, k, v, mask=mask, causal=self.causal,
-                    impl=self.attn_impl, window=self.window,
-                )
+            # GQA included: K/V stay kv_heads-shaped end to end — the
+            # dispatcher routes to the flash kernel (GQA head-folding
+            # index maps), the seq ring (kv_heads-sized shards rotate),
+            # or the grouped einsum; never a repeat-then-attend
+            # expansion. attn_scale/attn_logit_cap (the Gemma-2
+            # attention deltas) go through the dispatcher too — every
+            # impl applies them natively (flash inside the fused
+            # forward+backward, ring inside its chunk step), so capped/
+            # windowed models train fused and sequence-parallel; an impl
+            # without cap support warn-falls-back to the grouped einsum
+            # in the dispatcher rather than refusing here
+            y = attn_lib.attention(
+                q, k, v, mask=mask, causal=self.causal,
+                impl=self.attn_impl, window=self.window,
+                scale=self.attn_scale, logit_cap=self.attn_logit_cap,
+            )
         y = constrain(y, b, "seq", "tensor")
         y = proj(features=x.shape[-1], axis=(-2, -1), name="out")(y)
         y = constrain(y, b, "seq")

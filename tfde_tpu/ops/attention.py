@@ -48,6 +48,8 @@ def reference_attention(
     causal: bool = False,
     window: Optional[int] = None,
     bias: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    logit_cap: Optional[float] = None,
 ) -> jax.Array:
     """Plain softmax(QK^T/sqrt(d))V with fp32 accumulation.
 
@@ -55,7 +57,7 @@ def reference_attention(
     masking in fp32 keeps bf16 inputs numerically safe. window: sliding-
     window (Mistral-style) band — position i attends [i-window+1, i];
     requires causal=True. bias: additive pre-softmax score bias (see
-    grouped_attention).
+    grouped_attention). scale/logit_cap: see grouped_attention.
 
     The numerics oracle every other kernel is tested against. Internally
     the degenerate (groups == 1) case of `grouped_attention` — ONE
@@ -63,7 +65,8 @@ def reference_attention(
     decode path cannot drift.
     """
     return grouped_attention(q, k, v, mask=mask, causal=causal,
-                             window=window, bias=bias)
+                             window=window, bias=bias, scale=scale,
+                             logit_cap=logit_cap)
 
 
 def grouped_attention(
@@ -110,6 +113,11 @@ def grouped_attention(
         raise ValueError(
             f"window={window} requires causal=True and window >= 1 — the "
             f"sliding window is a band below the causal diagonal"
+        )
+    if logit_cap is not None and logit_cap <= 0:
+        raise ValueError(
+            f"logit_cap={logit_cap} must be > 0 (cap * tanh(score / cap) "
+            f"divides by the cap) — same check as the flash kernel"
         )
     g = h // kv
     sk = k.shape[1]
@@ -188,6 +196,46 @@ def _have(module: str) -> bool:
     return importlib.util.find_spec(f"tfde_tpu.ops.{module}") is not None
 
 
+# impls whose kernels take scale/logit_cap natively. All three current
+# impls do (flash applies the cap inside the fused forward AND backward);
+# the capability check below is the warn-fallback safety net for any impl
+# that loses (or ships without) cap support — the model keeps training on
+# the grouped einsum instead of hard-refusing.
+_KNOWN_IMPLS = ("reference", "flash", "ring")
+_CAP_IMPLS = frozenset(_KNOWN_IMPLS)
+
+
+def _flash_min_seq(causal: bool) -> Optional[int]:
+    """Parse ``TFDE_FLASH`` into a minimum auto-dispatch sequence length.
+
+    '0'/'false' disable the flash auto-pick (None); '1'/'true' lower both
+    thresholds to 1024; ''/'auto' keep the r04-measured defaults (2048
+    causal / 4096 non-causal). Any OTHER value used to fall through a
+    ``.get(env, 1024)`` — a typo like ``TFDE_FLASH=ture`` silently
+    LOWERED the threshold to 1024 instead of doing nothing; now it warns
+    once per call site and falls back to auto."""
+    import os
+
+    env = os.environ.get("TFDE_FLASH", "auto")
+    default_min = 2048 if causal else 4096
+    table = {
+        "0": None, "false": None, "False": None,
+        "": default_min, "auto": default_min,
+        "1": 1024, "true": 1024, "True": 1024,
+    }
+    if env in table:
+        return table[env]
+    import warnings
+
+    warnings.warn(
+        f"TFDE_FLASH={env!r} is not a recognized value (expected 0/false, "
+        f"1/true, or auto); ignoring it — flash auto-dispatch keeps the "
+        f"measured default (S >= {default_min})",
+        stacklevel=3,
+    )
+    return default_min
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -196,16 +244,25 @@ def attention(
     causal: bool = False,
     impl: str = "auto",
     window: Optional[int] = None,
+    scale: Optional[float] = None,
+    logit_cap: Optional[float] = None,
 ) -> jax.Array:
     """Dispatching attention: [B,S,H,D] -> [B,S,H,D].
 
     window: sliding-window band (Mistral convention — position i attends
     the last `window` positions inclusive, requires causal). Composes with
-    every impl: 'reference' masks, 'flash' skips out-of-band tiles
-    (compute and DMA O(S * window); the backward masks but scans all
-    tiles), and 'ring' masks on global positions — the band is exact
-    across shard boundaries, so sliding-window models train under
-    sequence parallelism and pp x sp.
+    every impl: 'reference' masks, 'flash' skips out-of-band tiles in the
+    forward AND the backward (compute and DMA O(S * window) fwd+bwd — the
+    backward scans only the statically in-band tile pairs), and 'ring'
+    masks on global positions — the band is exact across shard boundaries,
+    so sliding-window models train under sequence parallelism and pp x sp.
+
+    scale: logit multiplier (None = 1/sqrt(d)); logit_cap: Gemma-2 tanh
+    softcapping, cap * tanh(score / cap) before masking. Both compose with
+    every impl — flash applies them inside the fused kernels, ring inside
+    its online-softmax chunk step. If a selected impl ever lacks cap
+    support (`_CAP_IMPLS`), dispatch warns and falls back to the grouped
+    reference einsum instead of refusing.
 
     impl: 'auto' | 'reference' | 'flash' | 'ring'. 'auto' picks ring when the
     active mesh shards 'seq'; on TPU it picks flash for CAUSAL
@@ -245,16 +302,11 @@ def attention(
 
         return ra.ring_attention_manual(
             q, k, v, causal=causal, ring_size=ring_size,
-            vary_axes=vary_axes, window=window,
+            vary_axes=vary_axes, window=window, scale=scale,
+            logit_cap=logit_cap,
         )
     if impl == "auto":
-        import os
-
-        flash_env = os.environ.get("TFDE_FLASH", "auto")
-        default_min = 2048 if causal else 4096
-        flash_min_seq = {"0": None, "false": None, "False": None,
-                         "": default_min, "auto": default_min
-                         }.get(flash_env, 1024)
+        flash_min_seq = _flash_min_seq(causal)
         if _seq_parallel_active() and _have("ring_attention"):
             impl = "ring"
         elif (
@@ -278,9 +330,20 @@ def attention(
             impl = "flash"
         else:
             impl = "reference"
+    if ((scale is not None or logit_cap is not None)
+            and impl in _KNOWN_IMPLS and impl not in _CAP_IMPLS):
+        import warnings
+
+        warnings.warn(
+            f"attention impl {impl!r} does not support scale/logit_cap; "
+            f"falling back to the grouped reference einsum",
+            stacklevel=2,
+        )
+        impl = "reference"
     if impl == "reference":
         return reference_attention(q, k, v, mask=mask, causal=causal,
-                                   window=window)
+                                   window=window, scale=scale,
+                                   logit_cap=logit_cap)
     if impl == "flash":
         if mask is not None:
             raise NotImplementedError(
@@ -288,19 +351,20 @@ def attention(
                 "impl='reference' (or 'auto', which refuses flash when a "
                 "mask is present)"
             )
-        return _flash_sharded(q, k, v, causal, window)
+        return _flash_sharded(q, k, v, causal, window, scale, logit_cap)
     if impl == "ring":
         from tfde_tpu.ops import ring_attention
 
         return ring_attention.ring_attention(
             q, k, v, mask=mask, causal=causal, mesh=axes_lib.current_mesh(),
-            window=window,
+            window=window, scale=scale, logit_cap=logit_cap,
         )
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
 def _flash_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
-                   causal: bool, window=None) -> jax.Array:
+                   causal: bool, window=None, scale=None,
+                   logit_cap=None) -> jax.Array:
     """Call the Pallas flash kernel batch-parallel over the active mesh.
 
     A pallas_call under plain jit with sharded operands is NOT partitioned
@@ -332,7 +396,8 @@ def _flash_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
         )
     if not isinstance(mesh, jax.sharding.Mesh):
         return fa.flash_attention(q, k, v, causal=causal, window=window,
-                                  interpret=interpret)
+                                  interpret=interpret, scale=scale,
+                                  logit_cap=logit_cap)
     from jax.sharding import PartitionSpec as P
 
     from tfde_tpu.parallel.sharding import data_axes as _data_axes
@@ -351,11 +416,13 @@ def _flash_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
         batch_axes, d = (), 1
     if d <= 1 and heads is None:
         return fa.flash_attention(q, k, v, causal=causal, window=window,
-                                  interpret=interpret)
+                                  interpret=interpret, scale=scale,
+                                  logit_cap=logit_cap)
     spec = P(batch_axes if batch_axes else None, None, heads, None)
     fn = _compat_shard_map(
         lambda q, k, v: fa.flash_attention(
-            q, k, v, causal=causal, window=window, interpret=interpret
+            q, k, v, causal=causal, window=window, interpret=interpret,
+            scale=scale, logit_cap=logit_cap
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -16,17 +16,29 @@ Forward is a Pallas kernel (per /opt/skills/guides/pallas_guide.md):
   1.25-1.45x over 128 at every shape tried).
 - causal masking predicates whole future K-tiles off (pl.when), halving the
   work for causal models rather than masking it.
+- `scale` and `logit_cap` (Gemma-2 tanh softcapping) apply inside the
+  kernel, so capped/scaled models stay on the fused path.
 
 Backward DEFAULTS to the blockwise-JAX recurrence (`_bwd_blockwise`):
-recompute P tile-by-tile from the saved logsumexp under a `lax.scan`, O(S)
-memory, XLA-scheduled matmuls. The r04 hardware A/B (tools/flash_ab.py on
-v5e) measured it at 1.15x/1.28x/1.30x of the XLA reference einsum at
-S=2048/4096/8192 (causal fwd+bwd), while the round-3 Pallas dK/dV + dQ
-kernel pair (`TFDE_FLASH_BWD=pallas`, FlashAttention-2 arrangement,
-retained below with 128-lane lse/delta layout and causal prefetch index
-maps) lands at 0.6-0.73x — XLA's own scheduling of the same recurrence
-beats the hand pipeline on this chip generation, so the kernel pair is
-opt-in until it wins a measurement.
+recompute P tile-by-tile from the saved logsumexp, O(S) memory,
+XLA-scheduled matmuls. For causal (and windowed) attention the recurrence
+is a `lax.scan` over the STATICALLY enumerated in-band (Q-tile, K-tile)
+pairs (`_band_tile_pairs`) — strictly-future tiles and tiles outside the
+sliding band are never visited, so compute and DMA drop to ~half for plain
+causal and to O(S * window) for windowed, in both the dq and dk/dv
+accumulations (they share the pair scan). The non-causal backward keeps
+the r04-measured full K-tile scan (tools/flash_ab.py on v5e: 1.15x/1.28x/
+1.30x of the XLA reference einsum at S=2048/4096/8192 causal fwd+bwd),
+while the round-3 Pallas dK/dV + dQ kernel pair (`TFDE_FLASH_BWD=pallas`,
+FlashAttention-2 arrangement, retained below with 128-lane lse/delta
+layout and band-aware prefetch index maps) lands at 0.6-0.73x — XLA's own
+scheduling of the same recurrence beats the hand pipeline on this chip
+generation, so the kernel pair is opt-in until it wins a measurement.
+
+The band membership predicate (`_tile_in_band`) is shared by the forward
+kernel, both backward paths, the DMA-eliding index maps, and the roofline
+tile-visit counter (ops/roofline.py) — one source of truth, so a counter
+regression in tier-1 means the kernels' schedule actually changed.
 
 Ring attention (ops/ring_attention.py) composes with this by construction:
 its per-device block computation is the same recurrence, so the flash kernel
@@ -35,6 +47,7 @@ can serve as its local step on TPU.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Tuple
 
@@ -43,6 +56,36 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _NEG = -1e30
+
+# Trace-time tile-visit recorder (see `record_tile_visits`). None when
+# disabled; a dict while a recording context is open.
+_TILE_COUNTS = None
+
+
+@contextlib.contextmanager
+def record_tile_visits():
+    """Record the tile schedule of flash calls traced inside the context.
+
+    Yields a dict that the forward/backward builders populate at TRACE
+    time with the statically-known schedule: number of grid steps, number
+    of in-band (executed) tile visits per pass, and the resolved tile
+    sizes. Because `pl.when` predication and the backward pair-scan length
+    are decided by the same `_tile_in_band` predicate recorded here, these
+    numbers are exactly the tiles the compiled kernels execute. The
+    causal/windowed backward additionally bumps `bwd_steps_executed` from
+    inside the scan body via `jax.debug.callback`, giving a runtime-
+    executed corroboration of the static plan.
+
+    Recording happens when the call is traced — call the kernels directly
+    (or with fresh shapes) inside the context rather than through an
+    already-warm jit cache."""
+    global _TILE_COUNTS
+    prev = _TILE_COUNTS
+    _TILE_COUNTS = {}
+    try:
+        yield _TILE_COUNTS
+    finally:
+        _TILE_COUNTS = prev
 
 
 def _auto_block(s: int) -> int:
@@ -74,9 +117,77 @@ def _resolve_block(block, s: int) -> int:
     return _auto_block(s) if block is None else min(block, s)
 
 
+def _tile_in_band(qi, kb, block_q: int, block_k: int, causal, window):
+    """Whether tile (qi, kb) holds any unmasked (row, col) pair.
+
+    THE band predicate: the forward kernel's `pl.when`, both backward
+    paths, the DMA-eliding index maps, and the roofline counter all derive
+    from this one function. Works on Python ints (static planning) and on
+    traced scalars (inside kernels) alike. A K tile is live iff its first
+    column is not strictly past the Q tile's last row, and — with a
+    sliding window — its last column is not entirely older than the
+    oldest position the Q tile's first row can see."""
+    if not causal:
+        return True
+    live = kb * block_k <= (qi + 1) * block_q - 1
+    if window is not None:
+        live = (kb * block_k + block_k - 1 >= qi * block_q - (window - 1)) & live
+    return live
+
+
+def _band_tile_pairs(s: int, block_q: int, block_k: int, causal: bool,
+                     window) -> list:
+    """Statically enumerate the in-band (qi, kb) tile pairs for an S x S
+    attention. Plain-causal yields ~half the grid; a sliding window yields
+    O(window / block_k) + O(1) pairs per Q tile. The causal backward scans
+    exactly this list, so its length IS the executed tile-visit count."""
+    n_q, n_k = s // block_q, s // block_k
+    return [
+        (qi, kb)
+        for qi in range(n_q)
+        for kb in range(n_k)
+        if bool(_tile_in_band(qi, kb, block_q, block_k, causal, window))
+    ]
+
+
+def bwd_tile_plan(s: int, block_q=None, block_k=None, causal: bool = True,
+                  window=None) -> dict:
+    """Public schedule introspection for tools/tests (roofline counter).
+
+    Returns the resolved tile sizes, the full grid size per pass, and the
+    in-band pairs the causal backward will actually scan — computed from
+    the same `_tile_in_band` predicate the kernels branch on."""
+    bq = _resolve_block(block_q, s)
+    bk = _resolve_block(block_k, s)
+    pairs = _band_tile_pairs(s, bq, bk, causal, window)
+    n_q, n_k = s // bq, s // bk
+    per_q = [0] * n_q
+    per_k = [0] * n_k
+    for qi, kb in pairs:
+        per_q[qi] += 1
+        per_k[kb] += 1
+    return {
+        "block_q": bq,
+        "block_k": bk,
+        "grid": n_q * n_k,
+        "visits": len(pairs),
+        "pairs": pairs,
+        "max_visits_per_q_tile": max(per_q) if per_q else 0,
+        "max_visits_per_k_tile": max(per_k) if per_k else 0,
+    }
+
+
+def _apply_cap(z, logit_cap):
+    """tanh softcapping (Gemma-2): c = cap * tanh(z / cap). Returns the
+    capped logits and tanh(z/cap) (needed by the backward chain rule:
+    dc/dz = 1 - tanh^2)."""
+    t = jnp.tanh(z / logit_cap)
+    return logit_cap * t, t
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, causal, scale, window,
+    *, causal, scale, window, logit_cap,
 ):
     # BHSD layout, grid (B, H, Sq/bq, Sk/bk) with the K dimension minor:
     # q_ref [1, 1, bq, D]; k_ref/v_ref [1, 1, bk, D] — only one K/V tile is
@@ -103,6 +214,8 @@ def _fwd_kernel(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
+        if logit_cap is not None:
+            s, _ = _apply_cap(s, logit_cap)
         if causal:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -132,11 +245,7 @@ def _fwd_kernel(
         # An interior/diagonal split (mask only the straddling tiles) was
         # measured 3-4% SLOWER at 512 tiles on v5e — the duplicated step
         # body costs more than the iota/select it saves; keep one body.
-        run = kb * bk <= (qi + 1) * bq - 1
-        if window is not None:
-            run = jnp.logical_and(run,
-                                  kb * bk + bk - 1 >= qi * bq - (window - 1))
-        pl.when(run)(_step)
+        pl.when(_tile_in_band(qi, kb, bq, bk, True, window))(_step)
     else:
         _step()
 
@@ -150,7 +259,7 @@ def _fwd_kernel(
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool, block_q: int, block_k: int, interpret: bool,
-    window=None,
+    window=None, scale=None, logit_cap=None,
 ) -> Tuple[jax.Array, jax.Array]:
     b, s, h, d = q.shape
     if k.shape != v.shape:
@@ -188,9 +297,20 @@ def _flash_forward(
         raise ValueError(
             f"window={window} requires causal=True and window >= 1"
         )
-    scale = 1.0 / (d ** 0.5)
+    if logit_cap is not None and logit_cap <= 0:
+        raise ValueError(f"logit_cap={logit_cap} must be positive")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if _TILE_COUNTS is not None:
+        n_q, n_k = s // block_q, s // block_k
+        _TILE_COUNTS["fwd_grid"] = n_q * n_k
+        _TILE_COUNTS["fwd_visits"] = len(
+            _band_tile_pairs(s, block_q, block_k, causal, window)
+        )
+        _TILE_COUNTS["block_q"] = block_q
+        _TILE_COUNTS["block_k"] = block_k
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                               window=window)
+                               window=window, logit_cap=logit_cap)
     # BSHD -> BHSD so the S/D dims are the TPU-tiled trailing pair
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     from jax.experimental.pallas import tpu as pltpu
@@ -258,16 +378,134 @@ def _flash_forward(
     return jnp.swapaxes(out, 1, 2), lse[..., 0]
 
 
-def _bwd_blockwise(res, g, *, causal: bool, block_k: int, window=None):
-    """Blockwise JAX backward: recompute P tile-by-tile from the saved
-    logsumexp (standard flash-attention backward), O(S) memory."""
+def _bwd_pair_scan(res, g, *, block_q: int, block_k: int, window=None,
+                   scale=None, logit_cap=None):
+    """Causal/windowed backward: lax.scan over the statically enumerated
+    in-band (Q-tile, K-tile) pairs, skipping strictly-future and
+    out-of-band tiles entirely — compute AND the q/k/v/dO tile loads drop
+    to ~half for plain causal and to O(S * window) for windowed, in both
+    the dq and dk/dv accumulations (one scan serves both).
+
+    Handles MHA and GQA uniformly: q is viewed [B,S,Kv,Grp,D] (Grp = 1 for
+    MHA); dK/dV sum over each KV head's query group inside the contraction
+    so the [B,S,H,D] K/V expansion never materializes. The carry holds the
+    full fp32 dq/dk/dv; each step read-modify-writes one tile via
+    dynamic_slice / dynamic_update_slice."""
     q, k, v, out, lse = res
     b, s, h, d = q.shape
-    scale = 1.0 / (d ** 0.5)
+    kv = k.shape[2]
+    grp = h // kv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    pairs = _band_tile_pairs(s, block_q, block_k, True, window)
+
+    qf = q.astype(jnp.float32).reshape(b, s, kv, grp, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32).reshape(b, s, kv, grp, d)
+    # delta[b,c,g,i] = rowsum(dO * O); lse arrives [b,h,s] -> [b,c,g,s]
+    delta = jnp.einsum(
+        "bscgd,bscgd->bcgs", gf,
+        out.astype(jnp.float32).reshape(b, s, kv, grp, d),
+    )
+    lse4 = lse.reshape(b, kv, grp, s)
+
+    counts = _TILE_COUNTS
+    if counts is not None:
+        counts["bwd_grid"] = (s // block_q) * (s // block_k)
+        counts["bwd_dq_visits"] = len(pairs)
+        counts["bwd_dkv_visits"] = len(pairs)
+        counts["bwd_pairs"] = len(pairs)
+
+        def _bump():
+            counts["bwd_steps_executed"] = (
+                counts.get("bwd_steps_executed", 0) + 1
+            )
+
+    def step(carry, pair):
+        dq, dk, dv = carry
+        if counts is not None:
+            jax.debug.callback(_bump)
+        qs = pair[0] * block_q
+        ks = pair[1] * block_k
+        qt = jax.lax.dynamic_slice_in_dim(qf, qs, block_q, axis=1)
+        gt = jax.lax.dynamic_slice_in_dim(gf, qs, block_q, axis=1)
+        lt = jax.lax.dynamic_slice_in_dim(lse4, qs, block_q, axis=3)
+        dt = jax.lax.dynamic_slice_in_dim(delta, qs, block_q, axis=3)
+        kt = jax.lax.dynamic_slice_in_dim(kf, ks, block_k, axis=1)
+        vt = jax.lax.dynamic_slice_in_dim(vf, ks, block_k, axis=1)
+        z = jnp.einsum("bqcgd,bkcd->bcgqk", qt, kt,
+                       preferred_element_type=jnp.float32) * scale
+        if logit_cap is not None:
+            logits, t = _apply_cap(z, logit_cap)
+        else:
+            logits = z
+        rows = qs + jnp.arange(block_q)
+        cols = ks + jnp.arange(block_k)
+        keep = rows[:, None] >= cols[None, :]
+        if window is not None:
+            keep = jnp.logical_and(
+                keep, rows[:, None] - cols[None, :] < window
+            )
+        logits = jnp.where(keep, logits, _NEG)
+        p = jnp.exp(logits - lt[..., None])  # [b,c,g,bq,bk]
+        dv_t = jnp.einsum("bcgqk,bqcgd->bkcd", p, gt)
+        dp = jnp.einsum("bqcgd,bkcd->bcgqk", gt, vt)
+        ds = p * (dp - dt[..., None])
+        if logit_cap is not None:
+            # chain rule through c = cap * tanh(z / cap): dc/dz = 1 - t^2
+            # (masked entries have p = 0, hence ds = 0, regardless of t)
+            ds = ds * (1.0 - t * t)
+        dq_t = jnp.einsum("bcgqk,bkcd->bqcgd", ds, kt) * scale
+        dk_t = jnp.einsum("bcgqk,bqcgd->bkcd", ds, qt) * scale
+        dq = jax.lax.dynamic_update_slice_in_dim(
+            dq, jax.lax.dynamic_slice_in_dim(dq, qs, block_q, axis=1) + dq_t,
+            qs, axis=1,
+        )
+        dk = jax.lax.dynamic_update_slice_in_dim(
+            dk, jax.lax.dynamic_slice_in_dim(dk, ks, block_k, axis=1) + dk_t,
+            ks, axis=1,
+        )
+        dv = jax.lax.dynamic_update_slice_in_dim(
+            dv, jax.lax.dynamic_slice_in_dim(dv, ks, block_k, axis=1) + dv_t,
+            ks, axis=1,
+        )
+        return (dq, dk, dv), None
+
+    carry0 = (
+        jnp.zeros((b, s, kv, grp, d), jnp.float32),
+        jnp.zeros((b, s, kv, d), jnp.float32),
+        jnp.zeros((b, s, kv, d), jnp.float32),
+    )
+    (dq, dk, dv), _ = jax.lax.scan(
+        step, carry0, jnp.asarray(pairs, dtype=jnp.int32)
+    )
+    return (
+        dq.reshape(b, s, h, d).astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+    )
+
+
+def _bwd_blockwise(res, g, *, causal: bool, block_q=None, block_k=None,
+                   window=None, scale=None, logit_cap=None):
+    """Blockwise JAX backward: recompute P tile-by-tile from the saved
+    logsumexp (standard flash-attention backward), O(S) memory. Causal
+    (and windowed) routes to the in-band pair scan, which never visits
+    out-of-band tiles; non-causal keeps the measured full K-tile scan."""
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
     block_k = _resolve_block(block_k, s)
+    if causal:
+        return _bwd_pair_scan(
+            res, g, block_q=_resolve_block(block_q, s), block_k=block_k,
+            window=window, scale=scale, logit_cap=logit_cap,
+        )
     if k.shape[2] != h:
-        return _bwd_blockwise_grouped(res, g, causal=causal,
-                                      block_k=block_k, window=window)
+        return _bwd_blockwise_grouped(res, g, block_k=block_k, scale=scale,
+                                      logit_cap=logit_cap)
 
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
@@ -275,26 +513,23 @@ def _bwd_blockwise(res, g, *, causal: bool, block_k: int, window=None):
     gf = g.astype(jnp.float32)
     # delta[b,h,i] = rowsum(dO * O)
     delta = jnp.einsum("bshd,bshd->bhs", gf, out.astype(jnp.float32))
-    q_pos = jnp.arange(s)
 
     def step(carry, kb):
         dq = carry
         sl = jax.lax.dynamic_slice_in_dim(kf, kb * block_k, block_k, axis=1)
         vl = jax.lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, axis=1)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, sl,
-                            preferred_element_type=jnp.float32) * scale
-        if causal:
-            cols = kb * block_k + jnp.arange(block_k)
-            keep = q_pos[:, None] >= cols[None, :]
-            if window is not None:
-                keep = jnp.logical_and(
-                    keep, q_pos[:, None] - cols[None, :] < window
-                )
-            logits = jnp.where(keep, logits, _NEG)
+        z = jnp.einsum("bqhd,bkhd->bhqk", qf, sl,
+                       preferred_element_type=jnp.float32) * scale
+        if logit_cap is not None:
+            logits, t = _apply_cap(z, logit_cap)
+        else:
+            logits = z
         p = jnp.exp(logits - lse[..., None])  # [b,h,Sq,bk]
         dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
         dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vl)
         ds = p * (dp - delta[..., None])  # [b,h,Sq,bk]
+        if logit_cap is not None:
+            ds = ds * (1.0 - t * t)
         dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, sl) * scale
         dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
         return dq, (dk, dv)
@@ -307,20 +542,22 @@ def _bwd_blockwise(res, g, *, causal: bool, block_k: int, window=None):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _bwd_blockwise_grouped(res, g, *, causal: bool, block_k: int,
-                           window=None):
-    """GQA twin of `_bwd_blockwise`: q [B,S,H,D] against k/v [B,S,Kv,D]
-    with H = Kv * groups. Query heads carry an explicit group axis through
-    the einsums (`c` = kv head, `g` = group member), so dK/dV sum over
-    each KV head's query group inside the contraction and the [B,S,H,D]
-    K/V expansion never materializes — mirroring grouped_attention
-    (ops/attention.py). Kept separate from the MHA recurrence so the
-    hardware-qualified path stays byte-identical."""
+def _bwd_blockwise_grouped(res, g, *, block_k: int, scale=None,
+                           logit_cap=None):
+    """GQA twin of the non-causal `_bwd_blockwise` scan: q [B,S,H,D]
+    against k/v [B,S,Kv,D] with H = Kv * groups. Query heads carry an
+    explicit group axis through the einsums (`c` = kv head, `g` = group
+    member), so dK/dV sum over each KV head's query group inside the
+    contraction and the [B,S,H,D] K/V expansion never materializes —
+    mirroring grouped_attention (ops/attention.py). Causal/windowed GQA
+    goes through `_bwd_pair_scan` instead (same grouped einsums, in-band
+    tiles only)."""
     q, k, v, out, lse = res
     b, s, h, d = q.shape
     kv = k.shape[2]
     grp = h // kv
-    scale = 1.0 / (d ** 0.5)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
     # block_k arrives already resolved by _bwd_blockwise (the only caller)
 
     qf = q.astype(jnp.float32).reshape(b, s, kv, grp, d)
@@ -333,26 +570,23 @@ def _bwd_blockwise_grouped(res, g, *, causal: bool, block_k: int,
         out.astype(jnp.float32).reshape(b, s, kv, grp, d),
     )
     lse5 = lse.reshape(b, kv, grp, s)
-    q_pos = jnp.arange(s)
 
     def step(carry, kb):
         dq = carry
         sl = jax.lax.dynamic_slice_in_dim(kf, kb * block_k, block_k, axis=1)
         vl = jax.lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, axis=1)
-        logits = jnp.einsum("bqcgd,bkcd->bcgqk", qf, sl,
-                            preferred_element_type=jnp.float32) * scale
-        if causal:
-            cols = kb * block_k + jnp.arange(block_k)
-            keep = q_pos[:, None] >= cols[None, :]
-            if window is not None:
-                keep = jnp.logical_and(
-                    keep, q_pos[:, None] - cols[None, :] < window
-                )
-            logits = jnp.where(keep, logits, _NEG)
+        z = jnp.einsum("bqcgd,bkcd->bcgqk", qf, sl,
+                       preferred_element_type=jnp.float32) * scale
+        if logit_cap is not None:
+            logits, t = _apply_cap(z, logit_cap)
+        else:
+            logits = z
         p = jnp.exp(logits - lse5[..., None])  # [b,c,g,Sq,bk]
         dv = jnp.einsum("bcgqk,bqcgd->bkcd", p, gf)
         dp = jnp.einsum("bqcgd,bkcd->bcgqk", gf, vl)
         ds = p * (dp - delta[..., None])
+        if logit_cap is not None:
+            ds = ds * (1.0 - t * t)
         dq = dq + jnp.einsum("bcgqk,bkcd->bqcgd", ds, sl) * scale
         dk = jnp.einsum("bcgqk,bqcgd->bkcd", ds, qf) * scale
         return dq, (dk, dv)
@@ -371,7 +605,7 @@ def _bwd_blockwise_grouped(res, g, *, causal: bool, block_k: int,
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, causal, scale, window,
+    dk_acc, dv_acc, *, causal, scale, window, logit_cap,
 ):
     # grid (B, H, Sk/bk, Sq/bq) with the Q dimension minor: one K/V tile's
     # gradient accumulators live in VMEM scratch while every Q tile streams
@@ -397,10 +631,14 @@ def _dkv_kernel(
         # col 0 carries the value
         lse = lse_ref[0, 0, :, 0:1]      # [bq, 1]
         delta = delta_ref[0, 0, :, 0:1]  # [bq, 1]
-        s = jax.lax.dot_general(
+        z = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
+        if logit_cap is not None:
+            s, t = _apply_cap(z, logit_cap)
+        else:
+            s = z
         if causal:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -414,12 +652,15 @@ def _dkv_kernel(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        # dP = dO V^T ; dS = P * (dP - delta) * scale
+        # dP = dO V^T ; dS = P * (dP - delta) [* (1 - tanh^2) under cap]
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale  # [bq, bk]
+        ds = p * (dp - delta)
+        if logit_cap is not None:
+            ds = ds * (1.0 - t * t)
+        ds = ds * scale  # [bq, bk]
         # dK += dS^T Q
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -429,12 +670,7 @@ def _dkv_kernel(
     if causal:
         # Q tiles strictly above this K tile's first column see none of it;
         # with a window, neither do Q tiles entirely past the band
-        run = (qi + 1) * bq - 1 >= kb * bk
-        if window is not None:
-            run = jnp.logical_and(
-                run, qi * bq <= kb * bk + bk - 1 + (window - 1)
-            )
-        pl.when(run)(_step)
+        pl.when(_tile_in_band(qi, kb, bq, bk, True, window))(_step)
     else:
         _step()
 
@@ -446,7 +682,7 @@ def _dkv_kernel(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, causal, scale, window,
+    *, causal, scale, window, logit_cap,
 ):
     # grid (B, H, Sq/bq, Sk/bk) with K minor: one Q tile's dQ accumulates in
     # VMEM scratch while K/V tiles stream past (same traversal as forward).
@@ -467,10 +703,14 @@ def _dq_kernel(
         do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, 0:1]      # 128-lane broadcast, col 0 (see
         delta = delta_ref[0, 0, :, 0:1]  # _dkv_kernel)
-        s = jax.lax.dot_general(
+        z = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+        if logit_cap is not None:
+            s, t = _apply_cap(z, logit_cap)
+        else:
+            s = z
         if causal:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -483,18 +723,17 @@ def _dq_kernel(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale  # [bq, bk]
+        ds = p * (dp - delta)
+        if logit_cap is not None:
+            ds = ds * (1.0 - t * t)
+        ds = ds * scale  # [bq, bk]
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
     if causal:
-        run = kb * bk <= (qi + 1) * bq - 1
-        if window is not None:
-            run = jnp.logical_and(run,
-                                  kb * bk + bk - 1 >= qi * bq - (window - 1))
-        pl.when(run)(_step)
+        pl.when(_tile_in_band(qi, kb, bq, bk, True, window))(_step)
     else:
         _step()
 
@@ -504,14 +743,22 @@ def _dq_kernel(
 
 
 def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
-                interpret: bool, window=None):
+                interpret: bool, window=None, scale=None, logit_cap=None):
     """FlashAttention-2 backward: dK/dV kernel + dQ kernel, O(S) memory."""
     q, k, v, out, lse = res
     b, s, h, d = q.shape
-    scale = 1.0 / (d ** 0.5)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
     block_q = _resolve_block(block_q, s)
     block_k = _resolve_block(block_k, s)
     from jax.experimental.pallas import tpu as pltpu
+
+    if _TILE_COUNTS is not None:
+        n_q, n_k = s // block_q, s // block_k
+        visits = len(_band_tile_pairs(s, block_q, block_k, causal, window))
+        _TILE_COUNTS["bwd_grid"] = n_q * n_k
+        _TILE_COUNTS["bwd_dq_visits"] = visits
+        _TILE_COUNTS["bwd_dkv_visits"] = visits
 
     # delta[b,h,s] = rowsum(dO * O), fp32 — cheap elementwise, stays in JAX
     delta = jnp.einsum(
@@ -531,14 +778,29 @@ def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
     def col(n, idx):
         return pl.BlockSpec((1, 1, n, lanes), idx)
 
+    num_qi = s // block_q
     if causal:
-        # Q tiles strictly above the K tile's first row are masked off —
-        # prefetch the first contributing Q tile instead of a dead copy
+        # Q tiles strictly above the K tile's first column are masked off —
+        # prefetch the first contributing Q tile instead of a dead copy;
+        # with a window, Q tiles entirely past the band park on the
+        # just-used last in-band tile (fetch elided) the same way the
+        # forward parks post-diagonal K tiles
         def kq_q(bi, hi, kb, qi):
+            run = (qi + 1) * block_q - 1 >= kb * block_k
             first = (kb * block_k) // block_q
-            return (bi, hi,
-                    jax.lax.select((qi + 1) * block_q - 1 >= kb * block_k,
-                                   qi, first), 0)
+            if window is None:
+                return (bi, hi, jax.lax.select(run, qi, first), 0)
+            post = qi * block_q > kb * block_k + block_k - 1 + (window - 1)
+            run = jnp.logical_and(run, jnp.logical_not(post))
+            last = jnp.minimum(
+                (kb * block_k + block_k - 1 + (window - 1)) // block_q,
+                num_qi - 1,
+            )
+            return (
+                bi, hi,
+                jnp.where(run, qi, jnp.where(post, last, first)),
+                0,
+            )
     else:
         def kq_q(bi, hi, kb, qi):
             return (bi, hi, qi, 0)
@@ -546,7 +808,7 @@ def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
     kq_k = lambda bi, hi, kb, qi: (bi, hi, kb, 0)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale,
-                          window=window),
+                          window=window, logit_cap=logit_cap),
         grid=(b, h, s // block_k, s // block_q),
         in_specs=[
             tile(block_q, kq_q),   # q
@@ -570,17 +832,31 @@ def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
 
     qk_q = lambda bi, hi, qi, kb: (bi, hi, qi, 0)
     if causal:
-        # K tiles strictly past the Q tile's last row: prefetch tile 0 (the
-        # next Q tile's first step) instead of a dead copy — mirrors forward
+        # K tiles strictly past the Q tile's last row: prefetch the next
+        # needed tile instead of a dead copy — mirrors the forward's
+        # parking (plain causal: tile 0, the next Q tile's first step;
+        # windowed: pre-band parks on first(qi), post-diagonal parks on
+        # the just-used diagonal tile)
         def qk_k(bi, hi, qi, kb):
-            return (bi, hi,
-                    jax.lax.select(kb * block_k <= (qi + 1) * block_q - 1,
-                                   kb, 0), 0)
+            run = kb * block_k <= (qi + 1) * block_q - 1
+            if window is None:
+                return (bi, hi, jax.lax.select(run, kb, 0), 0)
+            pre_band = (
+                kb * block_k + block_k - 1 < qi * block_q - (window - 1)
+            )
+            run = jnp.logical_and(run, jnp.logical_not(pre_band))
+            first = jnp.maximum((qi * block_q - (window - 1)) // block_k, 0)
+            diag = ((qi + 1) * block_q - 1) // block_k
+            return (
+                bi, hi,
+                jnp.where(run, kb, jnp.where(pre_band, first, diag)),
+                0,
+            )
     else:
         qk_k = lambda bi, hi, qi, kb: (bi, hi, kb, 0)
     (dq,) = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale,
-                          window=window),
+                          window=window, logit_cap=logit_cap),
         grid=(b, h, s // block_q, s // block_k),
         in_specs=[
             tile(block_q, qk_q),
@@ -603,7 +879,7 @@ def _bwd_pallas(res, g, *, causal: bool, block_q: int, block_k: int,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -613,8 +889,10 @@ def flash_attention(
     block_k=None,
     interpret: bool = False,
     window=None,
+    scale=None,
+    logit_cap=None,
 ) -> jax.Array:
-    """softmax(QK^T/sqrt(d))V over [B, S, H, D], O(S) memory.
+    """softmax(cap(QK^T * scale))V over [B, S, H, D], O(S) memory.
 
     GQA: k/v may carry fewer heads [B, S, Kv, D] with H a multiple of Kv —
     the grid stays per-query-head and each q head's K/V DMA folds onto its
@@ -622,19 +900,27 @@ def flash_attention(
 
     window: sliding-window band (requires causal) — position i attends the
     last `window` positions inclusive; out-of-band K tiles are skipped
-    entirely (compute AND DMA), so cost drops to O(S * window)."""
+    entirely (compute AND DMA) in BOTH the forward and the backward, so
+    fwd+bwd cost drops to O(S * window).
+
+    scale: logit multiplier, default 1/sqrt(D).
+    logit_cap: Gemma-2 tanh softcapping — logits become
+    cap * tanh(logits / cap) inside the kernels (forward and backward),
+    before masking."""
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
-                            window)
+                            window, scale, logit_cap)
     return out
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret, window):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window, scale,
+         logit_cap):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
-                              window)
+                              window, scale, logit_cap)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, window, res, g):
+def _bwd(causal, block_q, block_k, interpret, window, scale, logit_cap,
+         res, g):
     import os
 
     # default 'jax' (blockwise): the r04 hardware A/B (tools/flash_ab.py,
@@ -650,9 +936,10 @@ def _bwd(causal, block_q, block_k, interpret, window, res, g):
         # blockwise recurrence, which is also the measured-faster default
         return _bwd_pallas(res, g, causal=causal, block_q=block_q,
                            block_k=block_k, interpret=interpret,
-                           window=window)
-    return _bwd_blockwise(res, g, causal=causal, block_k=block_k,
-                          window=window)
+                           window=window, scale=scale, logit_cap=logit_cap)
+    return _bwd_blockwise(res, g, causal=causal, block_q=block_q,
+                          block_k=block_k, window=window, scale=scale,
+                          logit_cap=logit_cap)
 
 
 flash_attention.defvjp(_fwd, _bwd)

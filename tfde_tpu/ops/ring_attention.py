@@ -45,7 +45,7 @@ _NEG = -1e30  # finite -inf stand-in: keeps exp() NaN-free on fully-masked block
 
 
 def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
-                     window=None):
+                     window=None, scale=None, logit_cap=None):
     """One online-softmax accumulation step against one KV chunk.
 
     GQA: k/v may carry fewer heads [B, Sk, Kv, D] than q (H = Kv * groups)
@@ -53,12 +53,19 @@ def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
     ops/attention.grouped_attention, so the KV shards that rotate around
     the ring stay kv_heads-sized (the ICI transfer shrinks by the group
     factor, on top of the HBM saving). Accumulators stay per-QUERY-head,
-    so the carries and every ring/block caller are unchanged."""
+    so the carries and every ring/block caller are unchanged.
+
+    scale (None = 1/sqrt(d)) and logit_cap (Gemma-2 tanh softcapping,
+    cap * tanh(s / cap) BEFORE masking — same ordering as
+    grouped_attention) apply inside the chunk step, so capped models keep
+    exact numerics across shard boundaries; the backward is plain AD
+    through the recurrence."""
     o, m, l = carry
     b, sq, h, d = q.shape
     kv_heads = k.shape[2]
     sk = k.shape[1]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     if kv_heads != h:
         g = h // kv_heads  # head index = c * g + group member (h-major)
         qg = q.reshape(b, sq, kv_heads, g, d)
@@ -69,6 +76,8 @@ def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                        preferred_element_type=jnp.float32)
     s = s * scale
+    if logit_cap is not None:
+        s = logit_cap * jnp.tanh(s / logit_cap)
     if kv_valid is not None:
         s = jnp.where(kv_valid[:, None, None, :], s, _NEG)
     if causal:
@@ -100,7 +109,8 @@ def _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
 
 
 def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
-                     block_k: int = 1024, window=None):
+                     block_k: int = 1024, window=None, scale=None,
+                     logit_cap=None):
     """Online-softmax accumulation against the current KV shard, blockwise:
     the shard is scanned in `block_k` chunks so per-device score memory is
     O(sq * block_k), never O(sq * sk_shard) — the 'blockwise' half of ring
@@ -111,7 +121,8 @@ def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
     sk = k.shape[1]
     if sk <= block_k or sk % block_k:
         return _chunk_attention(carry, q, k, v, kv_valid, q_pos, k_pos,
-                                causal, window=window)
+                                causal, window=window, scale=scale,
+                                logit_cap=logit_cap)
 
     def chunk(carry, i):
         start = i * block_k
@@ -124,7 +135,8 @@ def _block_attention(carry, q, k, v, kv_valid, q_pos, k_pos, causal,
         kpc = jax.lax.dynamic_slice_in_dim(k_pos, start, block_k, axis=0)
         return (
             _chunk_attention(carry, q, kc, vc, kvc, q_pos, kpc, causal,
-                             window=window),
+                             window=window, scale=scale,
+                             logit_cap=logit_cap),
             None,
         )
 
@@ -143,6 +155,8 @@ def ring_attention_manual(
     block_k: int = 1024,
     vary_axes: tuple = (),
     window=None,
+    scale=None,
+    logit_cap=None,
 ) -> jax.Array:
     """The per-shard ring body, for callers ALREADY inside a manual region
     where `axis` is a manual mesh axis — e.g. a stage of the fully-manual
@@ -187,7 +201,8 @@ def ring_attention_manual(
         def accumulate(c):
             return _block_attention(
                 c, q, k, v, kv_valid, q_pos, k_pos, causal,
-                block_k=block_k, window=window,
+                block_k=block_k, window=window, scale=scale,
+                logit_cap=logit_cap,
             )
 
         if causal:
@@ -240,6 +255,8 @@ def ring_attention(
     axis: str = "seq",
     block_k: int = 1024,
     window: Optional[int] = None,
+    scale: Optional[float] = None,
+    logit_cap: Optional[float] = None,
 ) -> jax.Array:
     """[B, S, H, D] attention with S sharded over `axis` of `mesh`.
 
@@ -299,7 +316,7 @@ def ring_attention(
         return ring_attention_manual(
             q, k, v, kv_valid, causal=causal, axis=axis, ring_size=n,
             block_k=block_k, vary_axes=tuple(mesh.axis_names),
-            window=window,
+            window=window, scale=scale, logit_cap=logit_cap,
         )
 
     if kv_valid is None:

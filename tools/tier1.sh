@@ -36,6 +36,18 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
 rc=${PIPESTATUS[0]}
 passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 echo DOTS_PASSED=$passed
+
+# Roofline tile-visit gate: pins the flash kernels' executed tile schedule
+# (forward pl.when predication + backward in-band pair scan) against the
+# analytic band, so an attention tile-count regression fails tier-1 the
+# same way a collective-count regression does (tools/roofline.py).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python tools/roofline.py --check-tiles; then
+    echo "ROOFLINE_TILE_GATE=fail"
+    [ $rc -eq 0 ] && rc=1
+else
+    echo "ROOFLINE_TILE_GATE=pass"
+fi
 if [ -f /tmp/_t1.passed ]; then
     prev=$(cat /tmp/_t1.passed)
     echo DOTS_DELTA=$((passed - prev))
