@@ -64,6 +64,21 @@ def main(argv=None):
                              "with K draft proposals per round (greedy "
                              "only; demo uses a tiny random draft — point "
                              "real deployments at a distilled draft)")
+    parser.add_argument("--prefix-cache", type=str, default=None,
+                        metavar="SPEC",
+                        help="prefix-KV cache: 'on', 'off', or a byte "
+                             "budget (default: the TFDE_PREFIX_CACHE env "
+                             "knob). Requests sharing a cached prompt "
+                             "prefix prefill only the uncached suffix "
+                             "(inference/prefix_cache.py); greedy outputs "
+                             "are bit-identical either way")
+    parser.add_argument("--serve", type=int, default=None, metavar="PORT",
+                        help="instead of the synthetic one-shot batch: "
+                             "expose this batcher as an HTTP/SSE replica "
+                             "on PORT (POST /generate, GET /healthz; "
+                             "front several with inference.router.Router, "
+                             "which adds the /v1/generate front door — "
+                             "WORKFLOWS.md §13)")
     parser.add_argument("--hf-dir", type=str, default=None,
                         help="load GPT-2 weights converted by "
                              "`python -m tfde_tpu.models.convert`")
@@ -113,7 +128,16 @@ def main(argv=None):
             "--temperature > 0 (at 0.0 decoding is greedy argmax and the "
             "filters would be silent no-ops)"
         )
+    prefix_spec = args.prefix_cache
+    if prefix_spec is not None and prefix_spec.lstrip("-").isdigit():
+        prefix_spec = int(prefix_spec)
     if args.num_draft > 0:
+        if prefix_spec is not None:
+            raise SystemExit(
+                "--prefix-cache serves the plain batcher; the speculative "
+                "batcher recomputes draft K/V per round and does not take "
+                "a prefix cache yet"
+            )
         if sampling_flags or args.repetition_penalty != 1.0:
             raise ValueError(
                 "--num-draft serves the plain greedy verifier; drop "
@@ -148,7 +172,20 @@ def main(argv=None):
             top_p=args.top_p, min_p=args.min_p,
             repetition_penalty=args.repetition_penalty,
             eos_id=args.eos_id, scan_depth=args.scan_depth,
+            prefix_cache=prefix_spec,
         )
+    if args.serve is not None:
+        from tfde_tpu.inference.router import ReplicaServer
+
+        rs = ReplicaServer(srv, port=args.serve).start()
+        log.info("replica serving on %s (POST /generate, GET /healthz); "
+                 "Ctrl-C to stop", rs.url)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            rs.close()
+        return []
     tok = None
     if args.tokenizer:
         # offline by construction, like the conversion CLI: a local
@@ -209,6 +246,8 @@ def main(argv=None):
         # host-overhead accounting: dispatches/syncs per token fall as
         # O(1/scan_depth) in steady state (the fused-scan payoff)
         log.info("serving stats: %s", srv.stats())
+    if getattr(srv, "prefix_cache", None) is not None:
+        log.info("prefix cache: %s", srv.prefix_cache.stats())
     return done
 
 

@@ -358,6 +358,175 @@ _OBS_CHILD = textwrap.dedent(
 )
 
 
+_REPLICA_CHILD = textwrap.dedent(
+    """
+    import os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from tfde_tpu.utils.devices import request_cpu_devices
+    request_cpu_devices(1)
+    import jax.numpy as jnp
+    import numpy as np
+    from tfde_tpu.inference.router import ReplicaServer
+    from tfde_tpu.inference.server import ContinuousBatcher
+    from tfde_tpu.models.gpt import gpt_tiny_test
+
+    rid, port_file, push_url = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    model = gpt_tiny_test()
+    params = model.init(
+        jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    b = ContinuousBatcher(model, params, batch_size=2, max_len=64)
+    rng = np.random.default_rng(rid)
+    for ln in (4, 6):   # warm the compiles before announcing the port
+        b.submit(rng.integers(1, 90, ln), 6)
+    b.run()
+    srv = ReplicaServer(b, replica_id=rid, push_url=push_url,
+                        push_interval=0.3).start()
+    with open(port_file + ".tmp", "w") as f:
+        f.write(str(srv.port))
+    os.replace(port_file + ".tmp", port_file)
+    while True:
+        time.sleep(3600)   # the parent SIGKILLs replica 0, SIGTERMs 1
+    """
+)
+
+
+def test_killed_replica_drains_to_survivor(tmp_path):
+    """The PR's serving acceptance drill, in-suite: two REAL replica
+    processes behind the Router; SIGKILL one mid-service and verify the
+    next sessions re-route to the survivor with solo-correct outputs,
+    the router's flight ring dumps the `replica_down` story, and the
+    chief aggregator's host-up gauge flips when the dead replica's
+    metric pushes go stale."""
+    import glob
+    import signal
+    import time
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.inference.router import Router, request_generate
+    from tfde_tpu.models.gpt import gpt_tiny_test
+    from tfde_tpu.observability import flightrec, metrics
+    from tfde_tpu.observability.aggregate import ClusterAggregator
+    from tfde_tpu.observability.exposition import serve_metrics
+
+    model = gpt_tiny_test()
+    params = model.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+
+    def solo(prompt, n):
+        toks, lengths = generate(
+            model, params,
+            jnp.asarray(np.asarray(prompt)[None, :], jnp.int32),
+            max_new_tokens=n,
+        )
+        return np.asarray(toks)[0, len(prompt) : int(lengths[0])].tolist()
+
+    script = tmp_path / "child_replica.py"
+    script.write_text(_REPLICA_CHILD)
+    router_dir = str(tmp_path / "router")
+    port_files = [str(tmp_path / f"port{i}") for i in range(2)]
+
+    reg = metrics.default_registry()
+    reg.reset("router/")
+    agg = ClusterAggregator(stale_after=3.0)
+    ms = serve_metrics(host="127.0.0.1", aggregator=agg)
+    push = f"http://127.0.0.1:{ms.port}/push"
+
+    procs, router = [], None
+    try:
+        for i in range(2):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)   # children run 1 device, not 8
+            env["PYTHONPATH"] = os.pathsep.join(
+                [os.path.dirname(os.path.dirname(__file__))]
+                + env.get("PYTHONPATH", "").split(os.pathsep)
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(script), str(i), port_files[i],
+                     push],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE, text=True,
+                )
+            )
+        deadline = time.time() + 240
+        while not all(os.path.exists(p) for p in port_files):
+            for p in procs:
+                assert p.poll() is None, p.communicate()[1][-3000:]
+            assert time.time() < deadline, "children never announced ports"
+            time.sleep(0.1)
+        urls = []
+        for pf in port_files:
+            with open(pf) as f:
+                urls.append(f"http://127.0.0.1:{int(f.read())}")
+        router = Router(urls, aggregator=agg, model_dir=router_dir).start()
+
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 90, 5).tolist() for _ in range(4)]
+        # sequential requests tie on outstanding tokens -> replica 0
+        pre = [request_generate(router.url, p, 6) for p in prompts[:2]]
+        assert all(o["replica"] == 0 for o in pre)
+        for o, p in zip(pre, prompts):
+            assert o["tokens"] == solo(p, 6)
+
+        scrape_url = f"http://127.0.0.1:{ms.port}/metrics"
+
+        def scrape():
+            return urllib.request.urlopen(
+                scrape_url, timeout=5).read().decode()
+
+        while ('tfde_cluster_host_up{host="0"} 1' not in scrape()
+               and time.time() < deadline):
+            time.sleep(0.1)
+
+        os.kill(procs[0].pid, signal.SIGKILL)
+        procs[0].wait(timeout=60)
+
+        # queued/new sessions re-route and still decode solo-correct
+        out = request_generate(router.url, prompts[2], 6)
+        assert out["replica"] == 1 and out["tokens"] == solo(prompts[2], 6)
+        assert reg.get("router/reroutes").value >= 1
+        assert reg.get("router/replicas_lost").value >= 1
+        tab = {row["replica"]: row for row in router.table()}
+        assert tab[0]["up"] is False and tab[1]["up"] is True
+        # the survivor keeps serving fresh sessions
+        out = request_generate(router.url, prompts[3], 6)
+        assert out["replica"] == 1 and out["tokens"] == solo(prompts[3], 6)
+
+        # the dead replica can't dump its own ring (SIGKILL) — the
+        # router's ring carries the routing-side story
+        files = glob.glob(os.path.join(router_dir, "debug",
+                                       "flight_*.jsonl"))
+        assert files, "router left no flight dump for the lost replica"
+        kinds = [e["kind"] for e in flightrec.load(sorted(files)[-1])]
+        assert "replica_down" in kinds
+
+        # host-up flips once the dead replica's pushes go stale
+        body = scrape()
+        while ('tfde_cluster_host_up{host="0"} 0' not in body
+               and time.time() < deadline):
+            time.sleep(0.2)
+            body = scrape()
+        assert 'tfde_cluster_host_up{host="0"} 0' in body
+        assert 'tfde_cluster_host_up{host="1"} 1' in body
+    finally:
+        if router is not None:
+            router.close()
+        ms.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+
 def test_killed_worker_leaves_flight_file_and_goes_stale(tmp_path):
     """The PR's cluster acceptance: chief /metrics carries the worker's
     host-labelled series; SIGTERM-killing the worker (a) leaves a parseable

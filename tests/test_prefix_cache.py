@@ -1,0 +1,222 @@
+"""Prefix-KV cache (inference/prefix_cache.py): the token trie must
+return exactly the K/V bytes that were inserted for the longest cached
+prefix, stay inside its byte budget via LRU eviction, and — wired into
+the batcher — leave greedy outputs bit-identical to a cache-off run."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.inference.decode import generate
+from tfde_tpu.inference.prefix_cache import (
+    PrefixCache,
+    is_index_leaf,
+    leaf_name,
+    resolve,
+)
+from tfde_tpu.inference.server import ContinuousBatcher
+from tfde_tpu.models.gpt import gpt_tiny_test
+from tfde_tpu.observability import metrics
+
+
+@pytest.fixture(scope="module")
+def lm():
+    m = gpt_tiny_test()
+    params = m.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+def _solo(model, params, prompt, n, **kw):
+    toks, lengths = generate(
+        model, params, jnp.asarray(prompt[None, :], jnp.int32),
+        max_new_tokens=n, **kw,
+    )
+    p = prompt.size
+    return np.asarray(toks)[0, p : int(lengths[0])]
+
+
+def _fake_cache(rows=2, length=32, d=2):
+    """A stand-in prefill-output pytree: K/V leaves [rows, length, d]
+    whose values encode (row, position) so returned segments are
+    checkable, plus an index leaf the cache must skip."""
+    pos = jnp.arange(length, dtype=jnp.float32)[None, :, None]
+    row = 1000.0 * jnp.arange(rows, dtype=jnp.float32)[:, None, None]
+    k = jnp.broadcast_to(pos + row, (rows, length, d))
+    return {
+        "layer0": {"k": k, "v": k + 0.5},
+        "cache_index": jnp.zeros((rows,), jnp.int32),
+    }
+
+
+# 64 bytes per trie node with the _fake_cache defaults: two [4, 2]
+# float32 segments (k and v)
+_NODE_BYTES = 2 * 4 * 2 * 4
+
+
+def test_insert_and_longest_prefix_match():
+    pc = PrefixCache(block=4)
+    cache = _fake_cache()
+    t = np.arange(10)
+    assert pc.insert(t, cache, row=0) == 2   # 8 of 10 tokens are whole blocks
+
+    pre, kv = pc.lookup(t)
+    assert pre == 8
+    np.testing.assert_array_equal(
+        np.asarray(kv["layer0/k"]), np.asarray(cache["layer0"]["k"][0, :8])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(kv["layer0/v"]), np.asarray(cache["layer0"]["v"][0, :8])
+    )
+    assert "cache_index" not in kv  # index leaves never enter the trie
+
+    # at least one suffix token must remain for the first-token forward:
+    # an exactly-covered prompt only reuses up to the previous block
+    pre, _ = pc.lookup(t[:8])
+    assert pre == 4
+    # partial match stops at the first diverging block
+    pre, _ = pc.lookup(np.concatenate([t[:4], [99, 98, 97, 96, 95]]))
+    assert pre == 4
+    # full miss
+    pre, kv = pc.lookup(np.asarray([77, 78, 79, 80, 81]))
+    assert pre == 0 and kv is None
+
+    st = pc.stats()
+    assert st["segments"] == 2
+    assert st["bytes"] == 2 * _NODE_BYTES
+    assert st["reused_tokens"] == 8 + 4 + 4
+
+
+def test_lru_eviction_respects_byte_budget():
+    cache = _fake_cache()
+    pc = PrefixCache(byte_budget=2 * _NODE_BYTES, block=4)
+    a = np.arange(9)          # two blocks -> fills the budget
+    assert pc.insert(a, cache, row=0) == 2
+    assert pc.resident_bytes == 2 * _NODE_BYTES
+
+    b = np.asarray([50, 51, 52, 53, 54])   # one block -> forces eviction
+    assert pc.insert(b, cache, row=1) == 1
+    assert pc.resident_bytes <= 2 * _NODE_BYTES
+    assert pc.stats()["evictions"] == 1
+    # the LRU childless victim was a's DEEPEST block; its first block
+    # stays reachable, and b is resident
+    pre, _ = pc.lookup(a)
+    assert pre == 4
+    pre, kv = pc.lookup(b)
+    assert pre == 4
+    np.testing.assert_array_equal(
+        np.asarray(kv["layer0/k"]), np.asarray(cache["layer0"]["k"][1, :4])
+    )
+
+
+def test_insert_refuses_rather_than_overruns():
+    """Blocks of ONE insert protect each other (op stamps), so an insert
+    bigger than the whole budget stores what fits and refuses the rest —
+    resident bytes never exceed the budget."""
+    cache = _fake_cache()
+    pc = PrefixCache(byte_budget=_NODE_BYTES, block=4)
+    stored = pc.insert(np.arange(13), cache, row=0)   # wants 3 blocks
+    assert stored == 1
+    assert pc.resident_bytes <= _NODE_BYTES
+    pre, _ = pc.lookup(np.arange(13))
+    assert pre == 4
+
+
+def test_gauges_exported():
+    reg = metrics.default_registry()
+    reg.reset("serving/prefix")
+    pc = PrefixCache(block=4)
+    cache = _fake_cache()
+    pc.insert(np.arange(9), cache, row=0)
+    pc.lookup(np.arange(9))                      # hit
+    pc.lookup(np.asarray([90, 91, 92, 93, 94]))  # miss
+    st = pc.stats()
+    assert reg.get("serving/prefix_hits").value == st["hits"] == 1
+    assert reg.get("serving/prefix_misses").value == st["misses"] == 1
+    assert reg.get("serving/prefix_bytes").value == st["bytes"]
+    assert reg.get("serving/prefix_reused_tokens").value == 8
+    assert reg.get("serving/prefix_bytes_saved").value > 0
+
+
+def test_leaf_name_and_index_filter():
+    cache = _fake_cache()
+    paths = {
+        leaf_name(p): is_index_leaf(p)
+        for p, _ in jax.tree_util.tree_leaves_with_path(cache)
+    }
+    assert paths == {"layer0/k": False, "layer0/v": False,
+                     "cache_index": True}
+
+
+def test_resolve_env_knob(monkeypatch):
+    monkeypatch.setenv("TFDE_PREFIX_CACHE", "off")
+    assert resolve(None) is None
+    monkeypatch.setenv("TFDE_PREFIX_CACHE", "on")
+    assert isinstance(resolve(None), PrefixCache)
+    monkeypatch.setenv("TFDE_PREFIX_CACHE", "1048576")
+    pc = resolve(None)
+    assert pc.byte_budget == 1048576
+    monkeypatch.delenv("TFDE_PREFIX_CACHE")
+    assert resolve(None) is None
+    assert resolve(False) is None
+    assert resolve(True) is not None
+    assert resolve(pc) is pc
+    assert resolve(2048).byte_budget == 2048
+    with pytest.raises(ValueError):
+        resolve("bogus")
+
+
+def test_batcher_prefix_parity_greedy(lm, rng):
+    """The admission fast path end to end: request 1 seeds the trie cold;
+    later requests sharing the system prompt admit warm (suffix-only
+    prefill onto scattered prefix K/V) and must match their solo runs
+    bit for bit."""
+    model, params = lm
+    sysp = rng.integers(1, 90, 12).astype(np.int64)
+    prompts = [
+        np.concatenate([sysp, rng.integers(1, 90, k).astype(np.int64)])
+        for k in (3, 5, 2, 6)
+    ]
+    pc = PrefixCache(block=4)
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+                            prefix_cache=pc)
+    assert srv.prefix_cache is pc
+    done = {}
+    r0 = srv.submit(prompts[0], 8)
+    done.update(srv.run())                     # cold: seeds the trie
+    rids = [srv.submit(p, 8) for p in prompts[1:]]
+    done.update(srv.run())                     # warm waves
+    st = pc.stats()
+    assert st["hits"] >= len(rids)
+    assert st["reused_tokens"] >= 12 * len(rids) - pc.block * len(rids)
+    for rid, p in zip([r0] + rids, prompts):
+        np.testing.assert_array_equal(
+            done[rid], _solo(model, params, p, 8),
+            err_msg=f"prompt {p.tolist()}",
+        )
+
+
+def test_batcher_prefix_parity_repetition_penalty(lm, rng):
+    """The warm path must also reconstruct the penalty presence mask from
+    the FULL prompt (cached prefix included), not just the suffix it
+    prefills."""
+    model, params = lm
+    sysp = rng.integers(1, 90, 10).astype(np.int64)
+    prompts = [
+        np.concatenate([sysp, rng.integers(1, 90, k).astype(np.int64)])
+        for k in (3, 4)
+    ]
+    pc = PrefixCache(block=4)
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+                            repetition_penalty=1.3, prefix_cache=pc)
+    done = {}
+    r0 = srv.submit(prompts[0], 6)
+    done.update(srv.run())
+    r1 = srv.submit(prompts[1], 6)
+    done.update(srv.run())
+    assert pc.stats()["hits"] >= 1
+    for rid, p in zip([r0, r1], prompts):
+        np.testing.assert_array_equal(
+            done[rid],
+            _solo(model, params, p, 6, repetition_penalty=1.3),
+        )

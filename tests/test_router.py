@@ -1,0 +1,183 @@
+"""Multi-replica serving router (inference/router.py): SSE round trips
+must match solo decoding bit for bit, placement must follow least
+outstanding tokens, dead replicas must be marked down and their traffic
+rerouted, /drain must stop new placement, the prefill tier must prime
+remotely, and aggregator push-staleness must count as a down signal."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.inference.decode import generate
+from tfde_tpu.inference.router import ReplicaServer, Router, request_generate
+from tfde_tpu.inference.server import ContinuousBatcher
+from tfde_tpu.models.gpt import gpt_tiny_test
+from tfde_tpu.observability import metrics
+from tfde_tpu.observability.aggregate import ClusterAggregator
+
+
+@pytest.fixture(scope="module")
+def lm():
+    m = gpt_tiny_test()
+    params = m.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+def _solo(model, params, prompt, n):
+    prompt = np.asarray(prompt, np.int64)
+    toks, lengths = generate(
+        model, params, jnp.asarray(prompt[None, :], jnp.int32),
+        max_new_tokens=n,
+    )
+    return np.asarray(toks)[0, prompt.size : int(lengths[0])].tolist()
+
+
+def _mk_replica(model, params, idx, role="both", batch=2):
+    b = ContinuousBatcher(model, params, batch_size=batch, max_len=64,
+                          role=role)
+    return ReplicaServer(b, replica_id=idx).start()
+
+
+@pytest.fixture()
+def pair(lm):
+    """Two live replicas + a router over them, torn down per test (tests
+    kill/drain replicas, so state must not leak across tests)."""
+    model, params = lm
+    r0 = _mk_replica(model, params, 0)
+    r1 = _mk_replica(model, params, 1)
+    router = Router([r0.url, r1.url]).start()
+    yield model, params, r0, r1, router
+    for s in (router, r0, r1):
+        try:
+            s.close()
+        except OSError:
+            pass  # a test may have closed it already (dead-replica drill)
+
+
+def test_sse_round_trip_matches_solo(pair, rng):
+    model, params, _r0, _r1, router = pair
+    p = rng.integers(1, 90, 6).tolist()
+    out = request_generate(router.url, p, 8)
+    assert out["tokens"] == _solo(model, params, p, 8)
+    assert out["replica"] in (0, 1)
+    assert out["ttft_s"] is not None and out["ttft_s"] > 0
+    # progress streaming: first-token event, at least one middle chunk,
+    # and the final done event
+    assert out["events"] >= 3
+
+
+def test_least_outstanding_tokens_placement(pair, rng):
+    """Holding replica 0's step lock stalls its decode while it still
+    accepts the submit, so its outstanding-token estimate stays high;
+    a second concurrent request must be placed on replica 1."""
+    model, params, r0, _r1, router = pair
+    long_p = rng.integers(1, 90, 4).tolist()
+    short_p = rng.integers(1, 90, 6).tolist()
+    res = {}
+    with r0.lock:
+        t = threading.Thread(
+            target=lambda: res.update(a=request_generate(router.url,
+                                                         long_p, 40))
+        )
+        t.start()
+        deadline = time.monotonic() + 10
+        while (not any(r.outstanding for r in router._reps)
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert any(r.outstanding for r in router._reps)
+        out2 = request_generate(router.url, short_p, 6)
+    t.join(timeout=60)
+    assert res["a"]["replica"] == 0 and out2["replica"] == 1
+    # the stalled stream still finishes correctly once the lock drops
+    assert res["a"]["tokens"] == _solo(model, params, long_p, 40)
+    assert out2["tokens"] == _solo(model, params, short_p, 6)
+
+
+def test_dead_replica_reroutes_and_marks_down(pair, rng):
+    model, params, r0, _r1, router = pair
+    reg = metrics.default_registry()
+    reg.reset("router/")
+    # kill replica 0's front door out from under the router
+    r0._httpd.shutdown()
+    r0._httpd.server_close()
+    outs = [
+        request_generate(router.url, rng.integers(1, 90, 5).tolist(), 6)
+        for _ in range(3)
+    ]
+    assert all(o["replica"] == 1 for o in outs)
+    for o in outs:
+        # rerouted sessions still decode correctly on the survivor
+        assert len(o["tokens"]) > 0
+    tab = {row["replica"]: row for row in router.table()}
+    assert tab[0]["up"] is False and tab[1]["up"] is True
+    assert reg.get("router/replicas_lost").value >= 1
+    assert reg.get("router/replica0/up").value == 0
+    assert reg.get("router/requests").value == 3
+
+
+def test_drain_stops_new_placement(pair, rng):
+    model, params, _r0, _r1, router = pair
+    req = urllib.request.Request(
+        router.url + "/drain",
+        data=json.dumps({"replica": 0}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    body = json.loads(urllib.request.urlopen(req, timeout=5).read())
+    assert body == {"drained": 0}
+    outs = [
+        request_generate(router.url, rng.integers(1, 90, 5).tolist(), 6)
+        for _ in range(3)
+    ]
+    assert all(o["replica"] == 1 for o in outs)
+    tab = {row["replica"]: row for row in router.table()}
+    # drained is not down: the replica stays up, just unplaced
+    assert tab[0]["drained"] is True and tab[0]["up"] is True
+
+
+def test_prefill_tier_disaggregated_parity(lm, rng):
+    """A prefill-role replica primes the prompt; the decode replica
+    scatters the shipped K/V and streams — outputs must match solo."""
+    model, params = lm
+    pre_b = ContinuousBatcher(model, params, batch_size=1, max_len=64,
+                              role="prefill")
+    pre = ReplicaServer(pre_b, replica_id=0).start()
+    dec = _mk_replica(model, params, 1)
+    router = Router([dec.url], prefill_replicas=[pre.url]).start()
+    try:
+        for k in (7, 5):
+            p = rng.integers(1, 90, k).tolist()
+            out = request_generate(router.url, p, 8)
+            assert out["tokens"] == _solo(model, params, p, 8)
+        assert pre_b._dispatches > 0
+    finally:
+        for s in (router, pre, dec):
+            s.close()
+
+
+def test_stale_push_marks_down_never_pushed_stays_up(lm, rng):
+    """Aggregator staleness is a down signal — but only for replicas that
+    HAVE pushed and then went silent. A replica that never pushed (e.g.
+    push wiring disabled) must stay routable."""
+    model, params = lm
+    agg = ClusterAggregator(stale_after=0.2)
+    agg.ingest({"host": 0, "metrics": {}})
+    r0 = _mk_replica(model, params, 0)
+    r1 = _mk_replica(model, params, 1)
+    router = Router([r0.url, r1.url], aggregator=agg).start()
+    try:
+        time.sleep(0.3)  # host 0's one push goes stale
+        out = request_generate(router.url, rng.integers(1, 90, 5).tolist(), 6)
+        assert out["replica"] == 1
+        tab = {row["replica"]: row for row in router.table()}
+        assert tab[0]["up"] is False
+        assert tab[0]["push_age_s"] is not None
+        assert tab[1]["up"] is True and tab[1]["push_age_s"] is None
+    finally:
+        for s in (router, r0, r1):
+            s.close()

@@ -405,6 +405,85 @@ def test_steady_state_host_cost_bound(lm, rng, monkeypatch):
     assert after["syncs_per_token"] < 1.0
 
 
+def test_prefill_buffers_are_donated(lm):
+    """The admission prefills must alias the freshly-allocated row cache
+    into their output (donate_argnums) so a wave's scratch K/V is not
+    double-resident. Pin the `tf.aliasing_output` markers in the lowered
+    StableHLO for BOTH the cold path (`_prefill_rows`) and the warm
+    suffix path (`_prefill_suffix`) — a dropped donation shows up here
+    before it shows up as an HBM regression."""
+    import tfde_tpu.inference.server as server_mod
+    from tfde_tpu.inference.prefix_cache import is_index_leaf, leaf_name
+
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64)
+    tpl = srv._row_template(1)
+    low = server_mod._prefill_rows.lower(
+        srv._decode_model, tpl, params, jnp.zeros((1, 8), jnp.int32),
+        jnp.zeros((1,), jnp.int32), None, None, temperature=0.0,
+        top_k=None, top_p=None, min_p=None, repetition_penalty=1.0,
+    )
+    assert low.as_text().count("tf.aliasing_output") >= 2
+
+    tpl = srv._row_template(1)
+    prefix_kv = {
+        leaf_name(p): jnp.zeros((1, 4) + leaf.shape[2:], leaf.dtype)
+        for p, leaf in jax.tree_util.tree_leaves_with_path(tpl)
+        if not is_index_leaf(p)
+    }
+    low = server_mod._prefill_suffix.lower(
+        srv._decode_model, tpl, params, prefix_kv,
+        jnp.zeros((1, 4), jnp.int32), jnp.zeros((1,), jnp.int32),
+        None, None, None, temperature=0.0, top_k=None, top_p=None,
+        min_p=None, repetition_penalty=1.0,
+    )
+    assert low.as_text().count("tf.aliasing_output") >= 2
+
+
+def test_role_split_primed_handoff_parity(lm, rng):
+    """Disaggregated prefill: a prefill-role batcher primes prompts, a
+    decode-role batcher scatters the shipped K/V and streams — primed
+    requests must match solo bit for bit, and may mix in one wave with
+    plainly-submitted ones."""
+    model, params = lm
+    prompts = [rng.integers(1, 90, k).astype(np.int64) for k in (3, 7, 5, 4)]
+    pre = ContinuousBatcher(model, params, batch_size=1, max_len=64,
+                            role="prefill")
+    dec = ContinuousBatcher(model, params, batch_size=4, max_len=64,
+                            role="decode")
+    primed = [pre.prime(p, 8) for p in prompts[:3]]
+    rids = [dec.submit_primed(pr) for pr in primed]
+    rid_plain = dec.submit(prompts[3], 8)
+    done = dict(dec.run())
+    for rid, p in zip(rids + [rid_plain], prompts):
+        np.testing.assert_array_equal(done[rid], _solo(model, params, p, 8))
+    # role guards: each half of the split rejects the other's entry point
+    with pytest.raises(RuntimeError):
+        pre.submit(prompts[0], 4)
+    with pytest.raises(RuntimeError):
+        dec.prime(prompts[0], 4)
+
+
+def test_progress_streaming_matches_final_output(lm, rng):
+    """take_progress chunks, concatenated, must equal the request's final
+    output — the SSE streaming surface (router.py) rides on this."""
+    model, params = lm
+    p = rng.integers(1, 90, 5).astype(np.int64)
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64)
+    srv.enable_progress()
+    rid = srv.submit(p, 6)
+    got, done = [], False
+    while not srv.idle:
+        srv.step()
+        if not done:
+            toks, done = srv.take_progress(rid)
+            got.extend(int(t) for t in toks)
+    assert done
+    np.testing.assert_array_equal(
+        np.asarray(got, np.int32), _solo(model, params, p, 6)
+    )
+
+
 def test_batcher_repetition_penalty_no_repeats(rng):
     """repetition_penalty at extreme strength: every token a request emits
     is distinct from its prompt and its own prior output, across

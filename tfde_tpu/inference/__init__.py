@@ -11,11 +11,18 @@ from tfde_tpu.inference.decode import (
 )
 from tfde_tpu.inference.speculative import generate_speculative
 
-__all__ = ["ContinuousBatcher", "SpeculativeContinuousBatcher",
+__all__ = ["ContinuousBatcher", "PrefixCache", "PrimedRequest",
+           "ReplicaServer", "Router", "SpeculativeContinuousBatcher",
            "beam_search", "generate",
            "generate_ragged", "generate_speculative", "init_cache",
            "sample_logits"]
+from tfde_tpu.inference.prefix_cache import PrefixCache  # noqa: F401
+from tfde_tpu.inference.router import (  # noqa: F401
+    ReplicaServer,
+    Router,
+)
 from tfde_tpu.inference.server import (  # noqa: F401
     ContinuousBatcher,
+    PrimedRequest,
     SpeculativeContinuousBatcher,
 )

@@ -1,0 +1,317 @@
+"""Prefix-KV cache: shared prompt prefixes prefill once per replica.
+
+Serving traffic is dominated by shared prefixes — a system prompt, a
+few-shot preamble, a conversation re-sent turn by turn. The continuous
+batcher (inference/server.py) pays a full prefill per admission anyway,
+because each row's K/V is recomputed from token ids. This module keeps
+the K/V itself: a token TRIE over BLOCK-sized prompt chunks, each node
+holding the device-resident K/V segment for its block. On admission the
+batcher walks the trie for the longest cached prefix, scatters those
+segments into the fresh row cache, and prefills only the uncached
+suffix (`_prefill_suffix` in server.py) — so an N-request wave sharing
+a 512-token system prompt prefills those 512 tokens once, ever.
+
+Design points:
+
+- BLOCK granularity (vLLM-style, default 16 tokens): a prefix is usable
+  only in whole blocks, so the trie keys are hashable token tuples and
+  the warm-admission program compiles O(max_len / block) variants of the
+  prefix length L, not one per token count.
+- Segments are stored per (leaf, block) as device arrays shaped
+  [block, ...] — exactly the row slice `leaf[row, b*block:(b+1)*block]`
+  of a prefill's output cache, so a warm row is bit-identical to a cold
+  one (tests/test_prefix_cache.py pins greedy parity cache-on vs -off).
+- LRU byte budget: eviction removes least-recently-used LEAF nodes only
+  (childless — interior nodes stay while any extension is resident, so
+  every stored path remains walkable from the root). Nodes touched by
+  the in-progress lookup/insert are protected, so an insert can never
+  evict its own prefix out from under itself; when nothing evictable
+  remains, the insert is refused rather than the budget overrun.
+- One cache binds to ONE (model, params) pair: segments are raw K/V
+  activations. Swap params, build a new cache.
+
+Gauges (`serving/prefix_*`): hits, misses, hit_rate, reused_tokens,
+bytes (resident), bytes_saved (K/V bytes served from cache instead of
+recomputed), segments, evictions — the serving runbook's first stop
+(WORKFLOWS.md §13).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfde_tpu.observability import metrics
+
+DEFAULT_BYTE_BUDGET = 256 * 1024 * 1024
+DEFAULT_BLOCK = 16
+
+#: cache-collection leaves that are bookkeeping, not K/V — never cached
+INDEX_LEAVES = ("cache_index", "position_index")
+
+
+def leaf_name(path) -> str:
+    """Stable string key for a cache-pytree leaf path — the segment-dict
+    key shared between this module and server.py's warm-admission and
+    primed-handoff programs."""
+    return "/".join(str(getattr(k, "key", k)) for k in path)
+
+
+def is_index_leaf(path) -> bool:
+    return str(getattr(path[-1], "key", path[-1])) in INDEX_LEAVES
+
+
+class _Node:
+    """One block of one cached prefix path."""
+
+    __slots__ = ("key", "parent", "children", "seg", "nbytes",
+                 "last_used", "op")
+
+    def __init__(self, key, parent):
+        self.key = key              # tuple of `block` token ids
+        self.parent = parent
+        self.children: dict = {}
+        self.seg: Optional[dict] = None   # leaf-name -> [block, ...] array
+        self.nbytes = 0
+        self.last_used = 0
+        self.op = 0                 # protection stamp (current operation)
+
+
+class PrefixCache:
+    """Token-trie prefix-KV store with an LRU byte budget.
+
+    Constructed standalone and handed to `ContinuousBatcher(...,
+    prefix_cache=...)`, or resolved from the ``TFDE_PREFIX_CACHE``
+    environment knob (see `resolve`).
+    """
+
+    def __init__(self, byte_budget: int = DEFAULT_BYTE_BUDGET,
+                 block: int = DEFAULT_BLOCK,
+                 registry: Optional[metrics.Registry] = None):
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        if byte_budget < 1:
+            raise ValueError(
+                f"byte_budget must be >= 1, got {byte_budget}"
+            )
+        self._root = _Node(None, None)
+        self._block = int(block)
+        self._budget = int(byte_budget)
+        self._bytes = 0
+        self._segments = 0
+        self._clock = 0      # LRU timestamps (monotonic counter)
+        self._op = 0         # current-operation stamp: eviction protection
+        self._hits = 0
+        self._misses = 0
+        self._reused_tokens = 0
+        self._bytes_saved = 0
+        self._evictions = 0
+        self._reg = registry or metrics.default_registry()
+
+    # -- public -------------------------------------------------------------
+    @property
+    def block(self) -> int:
+        return self._block
+
+    @property
+    def byte_budget(self) -> int:
+        return self._budget
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    @property
+    def segments(self) -> int:
+        return self._segments
+
+    def stats(self) -> dict:
+        total = self._hits + self._misses
+        return {
+            "hits": self._hits,
+            "misses": self._misses,
+            "hit_rate": self._hits / total if total else 0.0,
+            "reused_tokens": self._reused_tokens,
+            "bytes": self._bytes,
+            "bytes_saved": self._bytes_saved,
+            "segments": self._segments,
+            "evictions": self._evictions,
+        }
+
+    def lookup(self, tokens):
+        """Longest cached prefix usable for prompt `tokens`.
+
+        Returns ``(L, kv)``: L tokens of prefix (a block multiple,
+        clamped so at least one suffix token remains to prefill — the
+        first-token logits must come from a real forward) and
+        ``kv`` = {leaf-name: [L, ...] device array}, or ``(0, None)``
+        on a miss. Touches the matched path for LRU."""
+        tokens = np.asarray(tokens).reshape(-1)
+        p = int(tokens.size)
+        self._op += 1
+        usable = max((p - 1) // self._block, 0)
+        node, segs = self._root, []
+        while len(segs) < usable:
+            b = len(segs)
+            key = tuple(
+                int(t) for t in tokens[b * self._block:(b + 1) * self._block]
+            )
+            child = node.children.get(key)
+            if child is None:
+                break
+            segs.append(child)
+            node = child
+        if not segs:
+            self._misses += 1
+            self._publish()
+            return 0, None
+        for s in segs:
+            self._clock += 1
+            s.last_used = self._clock
+            s.op = self._op
+        n = len(segs)
+        kv = {
+            name: (jnp.concatenate([s.seg[name] for s in segs], axis=0)
+                   if n > 1 else segs[0].seg[name])
+            for name in segs[0].seg
+        }
+        self._hits += 1
+        self._reused_tokens += n * self._block
+        self._bytes_saved += sum(s.nbytes for s in segs)
+        self._publish()
+        return n * self._block, kv
+
+    def insert(self, tokens, row_cache, row: int) -> int:
+        """Store the complete blocks of `tokens`' K/V from row `row` of a
+        prefill-output cache. Returns the number of NEW blocks stored
+        (already-resident blocks are just LRU-touched). Refuses blocks
+        that cannot fit after eviction — never overruns the budget."""
+        tokens = np.asarray(tokens).reshape(-1)
+        nb = int(tokens.size) // self._block
+        if nb == 0:
+            return 0
+        self._op += 1
+        sliced = None   # lazily sliced only if a new node is needed
+        node, created = self._root, 0
+        for b in range(nb):
+            key = tuple(
+                int(t) for t in tokens[b * self._block:(b + 1) * self._block]
+            )
+            child = node.children.get(key)
+            if child is None:
+                if sliced is None:
+                    sliced = self._slice_blocks(row_cache, row, nb)
+                seg = {name: blocks[b] for name, blocks in sliced.items()}
+                nbytes = sum(int(a.nbytes) for a in seg.values())
+                if (self._bytes + nbytes > self._budget
+                        and not self._evict(
+                            self._bytes + nbytes - self._budget)):
+                    break
+                child = _Node(key, node)
+                child.seg = seg
+                child.nbytes = nbytes
+                node.children[key] = child
+                self._bytes += nbytes
+                self._segments += 1
+                created += 1
+            self._clock += 1
+            child.last_used = self._clock
+            child.op = self._op
+            node = child
+        self._publish()
+        return created
+
+    # -- internals ----------------------------------------------------------
+    def _slice_blocks(self, row_cache, row: int, nb: int) -> dict:
+        """Per K/V leaf: row `row`'s first nb*block positions reshaped to
+        [nb, block, ...] (one device op per leaf; per-block views are
+        cheap slices of it)."""
+        out = {}
+        for path, leaf in jax.tree_util.tree_leaves_with_path(row_cache):
+            if is_index_leaf(path):
+                continue
+            blocks = leaf[row, :nb * self._block]
+            out[leaf_name(path)] = blocks.reshape(
+                (nb, self._block) + tuple(leaf.shape[2:])
+            )
+        return out
+
+    def _evict(self, need: int) -> bool:
+        """Free >= `need` bytes by removing LRU leaf segments (childless
+        nodes — interior blocks stay reachable-from-root while any
+        extension lives). Nodes stamped by the current operation are
+        protected. Returns False if the bytes cannot be freed. The scan
+        is O(resident segments) per victim — fine at the segment counts
+        a byte budget implies; swap in a heap if profiles ever say
+        otherwise."""
+        freed = 0
+        while freed < need:
+            victim, stack = None, [self._root]
+            while stack:
+                nxt = stack.pop()
+                for child in nxt.children.values():
+                    if child.children:
+                        stack.append(child)
+                    elif child.op != self._op and (
+                            victim is None
+                            or child.last_used < victim.last_used):
+                        victim = child
+            if victim is None:
+                return False
+            del victim.parent.children[victim.key]
+            victim.seg = None
+            freed += victim.nbytes
+            self._bytes -= victim.nbytes
+            self._segments -= 1
+            self._evictions += 1
+        return True
+
+    def _publish(self) -> None:
+        g = self._reg.gauge
+        total = self._hits + self._misses
+        g("serving/prefix_hits").set(self._hits)
+        g("serving/prefix_misses").set(self._misses)
+        g("serving/prefix_hit_rate").set(
+            self._hits / total if total else 0.0
+        )
+        g("serving/prefix_reused_tokens").set(self._reused_tokens)
+        g("serving/prefix_bytes").set(self._bytes)
+        g("serving/prefix_bytes_saved").set(self._bytes_saved)
+        g("serving/prefix_segments").set(self._segments)
+        g("serving/prefix_evictions").set(self._evictions)
+
+
+def resolve(spec) -> Optional[PrefixCache]:
+    """Normalize the batcher's `prefix_cache=` knob.
+
+    None (default) defers to the ``TFDE_PREFIX_CACHE`` environment
+    variable: ``on``/``1`` enables with the default budget, an integer
+    enables with that byte budget, anything else (including unset) is
+    off — so `tools/tier1.sh` can sweep the whole suite warm without a
+    single call-site change. Explicit values: False/``off`` disables,
+    True/``on`` enables default budget, an int is a byte budget, and a
+    `PrefixCache` instance is used as-is (shared caches are the
+    caller's responsibility — one per model+params)."""
+    if spec is None:
+        spec = os.environ.get("TFDE_PREFIX_CACHE", "off").strip().lower()
+        if spec in ("", "off", "0", "false", "no"):
+            return None
+        if spec in ("on", "1", "true", "yes"):
+            return PrefixCache()
+        try:
+            return PrefixCache(byte_budget=int(spec))
+        except ValueError:
+            return None
+    if isinstance(spec, PrefixCache):
+        return spec
+    if spec in (False, 0, "off"):
+        return None
+    if spec in (True, "on"):
+        return PrefixCache()
+    if isinstance(spec, int):
+        return PrefixCache(byte_budget=spec)
+    raise ValueError(f"unrecognized prefix_cache spec: {spec!r}")

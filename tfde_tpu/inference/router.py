@@ -1,0 +1,624 @@
+"""Multi-replica serving front door: HTTP/SSE routing above the batcher.
+
+One `ContinuousBatcher` is one model replica on one mesh. This module
+is the cluster layer that turns N of them into a service:
+
+- `ReplicaServer` wraps one batcher in a stdlib HTTP endpoint: POST
+  /generate streams tokens as Server-Sent Events as the batcher's step
+  loop produces them (a background thread drives `step()`; request
+  handlers only `submit()` and poll `take_progress()`), plus /prime and
+  /generate_primed for the prefill/decode role split, /load for the
+  router's placement signal, and /healthz. It optionally pushes its
+  serving gauges to the chief (`observability/aggregate.py`
+  MetricsPusher), so the whole fleet shows up host-labelled in one
+  scrape, and arms the flight recorder for post-mortems.
+
+- `Router` is the front door: POST /v1/generate picks the live replica
+  with the fewest outstanding tokens (its own in-flight ledger, plus
+  the chief aggregator's host-up/staleness signals when attached) and
+  relays the replica's SSE stream. A replica that dies mid-request is
+  marked down, recorded + dumped in the flight ring (`replica_down` —
+  a SIGKILL'd replica cannot dump its own), and reported to
+  `resilience/health.note_replica_down`; requests that had not yet
+  streamed a token RE-ROUTE to a survivor transparently, requests
+  mid-stream surface a retriable SSE error event. POST /drain marks a
+  replica down intentionally (no new placements; in-flight sessions
+  finish) — the runbook's graceful-drain knob (WORKFLOWS.md §13).
+  When prefill-role replicas are attached, long prompts are primed
+  there first and the K/V handed to a decode replica, falling back to
+  a plain submit if the prefill tier is down.
+
+Everything is stdlib (http.server / urllib): no new dependencies, and
+the wire format is JSON + SSE so `curl` is a debugging tool.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from tfde_tpu.observability import flightrec, metrics
+
+log = logging.getLogger(__name__)
+
+#: connection-level failures that mean "the replica is gone", as opposed
+#: to an HTTP error meaning "the request was bad"
+_DEAD = (urllib.error.URLError, ConnectionError, socket.timeout,
+         TimeoutError, EOFError)
+
+
+# -- primed-request wire format ----------------------------------------------
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 et al. (ships with jax)
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def primed_to_json(primed) -> dict:
+    """PrimedRequest -> JSON-safe dict (K/V as base64 raw bytes)."""
+    return {
+        "prompt": np.asarray(primed.prompt).tolist(),
+        "first_token": int(primed.first_token),
+        "max_new_tokens": int(primed.max_new_tokens),
+        "kv": {
+            name: {
+                "shape": list(a.shape),
+                "dtype": str(a.dtype),
+                "data": base64.b64encode(
+                    np.ascontiguousarray(a).tobytes()
+                ).decode("ascii"),
+            }
+            for name, a in primed.kv.items()
+        },
+    }
+
+
+def primed_from_json(payload: dict):
+    from tfde_tpu.inference.server import PrimedRequest
+
+    kv = {
+        name: np.frombuffer(
+            base64.b64decode(e["data"]), dtype=_np_dtype(e["dtype"])
+        ).reshape(e["shape"])
+        for name, e in payload["kv"].items()
+    }
+    return PrimedRequest(
+        prompt=np.asarray(payload["prompt"], np.int32),
+        first_token=int(payload["first_token"]),
+        max_new_tokens=int(payload["max_new_tokens"]),
+        kv=kv,
+    )
+
+
+# -- SSE helpers -------------------------------------------------------------
+def _sse_write(wfile, obj: dict) -> None:
+    wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+    wfile.flush()
+
+
+def sse_events(fp):
+    """Yield parsed `data:` events from a byte stream until EOF."""
+    for raw in fp:
+        line = raw.strip()
+        if line.startswith(b"data: "):
+            yield json.loads(line[6:])
+
+
+def _post_json(url: str, payload: dict, timeout: float):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+# -- replica-side server -----------------------------------------------------
+class ReplicaServer:
+    """One batcher replica behind HTTP/SSE (see the module docstring).
+
+    The batcher is driven by an internal step-loop thread; HTTP handlers
+    hold `lock` only to submit and to drain `take_progress`, so a long
+    decode scan never blocks accepting work for the next one.
+    `replica_id` doubles as the metrics `host` label when `push_url`
+    (the chief/router's /push endpoint) is given — keep it equal to the
+    replica's index in the router's replica list.
+    """
+
+    def __init__(self, batcher, port: int = 0, host: str = "127.0.0.1",
+                 replica_id: int = 0, push_url: Optional[str] = None,
+                 push_interval: float = 2.0,
+                 model_dir: Optional[str] = None,
+                 poll_interval: float = 0.002):
+        self.batcher = batcher
+        batcher.enable_progress()
+        self.replica_id = int(replica_id)
+        self.lock = threading.RLock()
+        self._poll = float(poll_interval)
+        self._stop = threading.Event()
+        if model_dir is not None:
+            flightrec.arm(model_dir)
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"  # close-delimited SSE streams
+
+            def log_message(self, *a):  # quiet; metrics carry the signal
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = b"ok\n"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/load":
+                    srv._send_json(self, 200, srv.load())
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    srv._send_json(self, 400, {"error": "bad json"})
+                    return
+                try:
+                    if self.path == "/generate":
+                        srv._handle_generate(self, body, primed=False)
+                    elif self.path == "/generate_primed":
+                        srv._handle_generate(self, body, primed=True)
+                    elif self.path == "/prime":
+                        srv._handle_prime(self, body)
+                    else:
+                        self.send_error(404)
+                except (ValueError, RuntimeError) as e:
+                    srv._send_json(self, 400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"tfde-replica-{replica_id}-http",
+        )
+        self._loop_thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"tfde-replica-{replica_id}-step",
+        )
+        self._pusher = None
+        if push_url is not None:
+            from tfde_tpu.observability.aggregate import MetricsPusher
+
+            self._pusher = MetricsPusher(
+                push_url, interval=push_interval, host=self.replica_id,
+            )
+
+    def start(self) -> "ReplicaServer":
+        self._http_thread.start()
+        self._loop_thread.start()
+        log.info("replica %d serving on %s", self.replica_id, self.url)
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._pusher is not None:
+            self._pusher.close()
+
+    def load(self) -> dict:
+        b = self.batcher
+        return {
+            "replica": self.replica_id,
+            "role": b.role,
+            "outstanding_tokens": b.outstanding_tokens,
+            "queue_depth": len(b._queue),
+            "free_rows": b.free_rows,
+        }
+
+    # -- internals ----------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self.lock:
+                idle = self.batcher.idle
+                if not idle:
+                    self.batcher.step()
+            if idle:
+                time.sleep(self._poll)
+
+    @staticmethod
+    def _send_json(handler, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _handle_prime(self, handler, body: dict) -> None:
+        with self.lock:
+            primed = self.batcher.prime(
+                body["prompt"], int(body["max_new_tokens"])
+            )
+        self._send_json(handler, 200, primed_to_json(primed))
+
+    def _handle_generate(self, handler, body: dict, primed: bool) -> None:
+        with self.lock:
+            if primed:
+                rid = self.batcher.submit_primed(primed_from_json(body))
+            else:
+                rid = self.batcher.submit(
+                    body["prompt"], int(body["max_new_tokens"])
+                )
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.end_headers()
+        _sse_write(handler.wfile, {"rid": rid, "replica": self.replica_id})
+        sent = 0
+        while True:
+            with self.lock:
+                toks, done = self.batcher.take_progress(rid)
+            for t in toks:
+                _sse_write(handler.wfile, {"token": int(t)})
+                sent += 1
+            if done:
+                _sse_write(handler.wfile, {"done": True, "n": sent})
+                return
+            time.sleep(self._poll)
+
+
+# -- router ------------------------------------------------------------------
+class _Replica:
+    __slots__ = ("url", "idx", "up", "outstanding", "served", "drained")
+
+    def __init__(self, url: str, idx: int):
+        self.url = url.rstrip("/")
+        self.idx = idx
+        self.up = True
+        self.drained = False
+        self.outstanding = 0   # router-side in-flight token estimate
+        self.served = 0
+
+
+class Router:
+    """Least-outstanding-tokens front door over replica endpoints (see
+    the module docstring).
+
+    replicas: decode-capable replica base URLs; index order must match
+    each `ReplicaServer.replica_id` so the chief aggregator's
+    host-labelled gauges line up with the routing table.
+    prefill_replicas: optional prefill-role tier for the role split;
+    prompts of at least `prefill_min_tokens` are primed there first.
+    aggregator: a `ClusterAggregator` receiving replica pushes — adds
+    push-staleness (host-up flip) as a down signal on top of the
+    router's own connection-failure detection.
+    """
+
+    def __init__(self, replicas, prefill_replicas=(), port: int = 0,
+                 host: str = "127.0.0.1", aggregator=None,
+                 model_dir: Optional[str] = None,
+                 prefill_min_tokens: int = 0,
+                 request_timeout: float = 120.0):
+        if not replicas:
+            raise ValueError("need at least one replica URL")
+        self._reps = [_Replica(u, i) for i, u in enumerate(replicas)]
+        self._pre = [_Replica(u, i) for i, u in enumerate(prefill_replicas)]
+        self._agg = aggregator
+        self._pmin = int(prefill_min_tokens)
+        self._timeout = float(request_timeout)
+        self._lock = threading.Lock()
+        self._reg = metrics.default_registry()
+        if model_dir is not None:
+            flightrec.arm(model_dir)
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = b"ok\n"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/replicas":
+                    ReplicaServer._send_json(self, 200,
+                                             {"replicas": router.table()})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    ReplicaServer._send_json(self, 400,
+                                             {"error": "bad json"})
+                    return
+                if self.path == "/v1/generate":
+                    router._serve_generate(self, body)
+                elif self.path == "/drain":
+                    idx = int(body["replica"])
+                    router.drain(idx)
+                    ReplicaServer._send_json(
+                        self, 200, {"drained": idx}
+                    )
+                else:
+                    self.send_error(404)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="tfde-router-http",
+        )
+
+    def start(self) -> "Router":
+        self._http_thread.start()
+        log.info("router serving on %s over %d replica(s)",
+                 self.url, len(self._reps))
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- placement ----------------------------------------------------------
+    def _refresh_liveness(self) -> None:
+        """Fold the chief aggregator's staleness view into the routing
+        table: a replica whose metric pushes went stale is down even if
+        the router has not yet hit a connection error on it."""
+        if self._agg is None:
+            return
+        hosts = self._agg.hosts()
+        for rep in self._reps:
+            info = hosts.get(rep.idx)
+            if info is not None and info["age"] > self._agg.stale_after:
+                self._mark_down(rep, f"stale push ({info['age']:.1f}s)")
+
+    def _pick(self, pool, exclude=()):
+        self._refresh_liveness()
+        with self._lock:
+            cands = [r for r in pool
+                     if r.up and not r.drained and r.idx not in exclude]
+            if not cands:
+                raise LookupError("no live replicas")
+            return min(cands, key=lambda r: r.outstanding)
+
+    def _mark_down(self, rep: _Replica, reason: str) -> None:
+        with self._lock:
+            if not rep.up:
+                return
+            rep.up = False
+        log.warning("replica %d (%s) down: %s", rep.idx, rep.url, reason)
+        self._reg.counter("router/replicas_lost").incr()
+        self._reg.gauge(f"router/replica{rep.idx}/up").set(0)
+        from tfde_tpu.resilience.health import note_replica_down
+
+        note_replica_down(rep.idx, reason)
+        # the dead replica can't dump its own flight ring (SIGKILL);
+        # the router's ring carries the routing-side story for it
+        flightrec.record("replica_down", replica=rep.idx, reason=reason)
+        flightrec.dump("replica_down")
+
+    def drain(self, idx: int) -> None:
+        """Stop placing new sessions on replica `idx`; in-flight streams
+        finish on their own. The graceful half of replica removal."""
+        for rep in self._reps:
+            if rep.idx == idx:
+                rep.drained = True
+                self._reg.gauge(f"router/replica{idx}/drained").set(1)
+                flightrec.record("replica_drain", replica=idx)
+
+    def table(self) -> list:
+        """Live routing table (the obs_dump --router surface)."""
+        ages = self._agg.hosts() if self._agg is not None else {}
+        rows = []
+        for rep in self._reps:
+            info = ages.get(rep.idx, {})
+            rows.append({
+                "replica": rep.idx,
+                "url": rep.url,
+                "up": rep.up,
+                "drained": rep.drained,
+                "outstanding_tokens": rep.outstanding,
+                "served": rep.served,
+                "push_age_s": info.get("age"),
+            })
+        return rows
+
+    def _publish(self) -> None:
+        for rep in self._reps:
+            g = self._reg.gauge
+            g(f"router/replica{rep.idx}/up").set(int(rep.up))
+            g(f"router/replica{rep.idx}/outstanding_tokens").set(
+                rep.outstanding
+            )
+            g(f"router/replica{rep.idx}/served").set(rep.served)
+
+    # -- request path --------------------------------------------------------
+    def _maybe_prime(self, body: dict):
+        """Run the prefill on the prefill tier when configured; returns
+        the primed JSON payload or None (fall back to a plain submit)."""
+        if not self._pre or len(body["prompt"]) < self._pmin:
+            return None
+        exclude: list = []
+        while True:
+            try:
+                rep = self._pick(self._pre, exclude)
+            except LookupError:
+                return None  # prefill tier down: decode replicas prefill
+            try:
+                rep.outstanding += len(body["prompt"])
+                try:
+                    with _post_json(
+                        rep.url + "/prime",
+                        {"prompt": body["prompt"],
+                         "max_new_tokens": body["max_new_tokens"]},
+                        self._timeout,
+                    ) as resp:
+                        out = json.loads(resp.read())
+                finally:
+                    rep.outstanding -= len(body["prompt"])
+                rep.served += 1
+                return out
+            except urllib.error.HTTPError:
+                return None   # request-specific: let the decode tier try
+            except _DEAD as e:
+                self._mark_down(rep, f"prime: {e}")
+                exclude.append(rep.idx)
+
+    def _serve_generate(self, handler, body: dict) -> None:
+        """Route one session; re-route on replica death until first
+        token, retriable SSE error after."""
+        try:
+            budget = int(body["max_new_tokens"])
+            prompt = list(body["prompt"])
+        except (KeyError, TypeError, ValueError):
+            ReplicaServer._send_json(
+                handler, 400, {"error": "need prompt + max_new_tokens"}
+            )
+            return
+        stream = bool(body.get("stream", False))
+        self._reg.counter("router/requests").incr()
+        primed_payload = self._maybe_prime(body)
+        headers_sent = False
+        exclude: list = []
+        while True:
+            try:
+                rep = self._pick(self._reps, exclude)
+            except LookupError:
+                if headers_sent:
+                    _sse_write(handler.wfile,
+                               {"error": "no live replicas",
+                                "retriable": True})
+                else:
+                    ReplicaServer._send_json(
+                        handler, 503, {"error": "no live replicas"}
+                    )
+                return
+            if exclude:
+                self._reg.counter("router/reroutes").incr()
+            rep.outstanding += budget
+            tokens: list = []
+            relayed = 0
+            finished = False
+            try:
+                if primed_payload is not None:
+                    req = _post_json(rep.url + "/generate_primed",
+                                     primed_payload, self._timeout)
+                else:
+                    req = _post_json(
+                        rep.url + "/generate",
+                        {"prompt": prompt, "max_new_tokens": budget},
+                        self._timeout,
+                    )
+                with req as resp:
+                    if stream and not headers_sent:
+                        handler.send_response(200)
+                        handler.send_header("Content-Type",
+                                            "text/event-stream")
+                        handler.end_headers()
+                        headers_sent = True
+                    for ev in sse_events(resp):
+                        if "token" in ev:
+                            tokens.append(ev["token"])
+                            if stream:
+                                _sse_write(handler.wfile,
+                                           {"token": ev["token"]})
+                                relayed += 1
+                        elif ev.get("done"):
+                            finished = True
+                            break
+                if not finished:
+                    # close-delimited stream ended without `done`: the
+                    # replica died mid-decode
+                    raise ConnectionError("stream ended before done")
+            except urllib.error.HTTPError as e:
+                # request-level rejection (validation): the replica is
+                # fine — forward the error, do NOT mark down
+                detail = e.read().decode(errors="replace")
+                ReplicaServer._send_json(handler, e.code,
+                                         {"error": detail})
+                return
+            except _DEAD as e:
+                self._mark_down(rep, str(e))
+                exclude.append(rep.idx)
+                if stream and relayed:
+                    # tokens already left the building: the client must
+                    # retry itself (same prompt re-runs from scratch)
+                    _sse_write(handler.wfile,
+                               {"error": "replica_died",
+                                "retriable": True, "relayed": relayed})
+                    return
+                continue   # nothing delivered yet: transparent re-route
+            finally:
+                rep.outstanding -= budget
+                self._publish()
+            rep.served += 1
+            self._publish()
+            if stream:
+                _sse_write(handler.wfile,
+                           {"done": True, "tokens": tokens,
+                            "replica": rep.idx})
+            else:
+                ReplicaServer._send_json(
+                    handler, 200,
+                    {"tokens": tokens, "replica": rep.idx},
+                )
+            return
+
+
+# -- blocking client (tests / bench / examples) ------------------------------
+def request_generate(router_url: str, prompt, max_new_tokens: int,
+                     stream: bool = False, timeout: float = 120.0) -> dict:
+    """POST one generation to a Router (or directly to a ReplicaServer's
+    /generate). Returns {"tokens": [...], "replica": idx|None,
+    "ttft_s": seconds-to-first-token, "events": n}. Raises the
+    underlying urllib error on transport failure and RuntimeError on an
+    in-stream retriable error."""
+    url = router_url.rstrip("/")
+    path = "/v1/generate" if "/generate" not in url else ""
+    t0 = time.perf_counter()
+    payload = {"prompt": list(np.asarray(prompt).tolist()),
+               "max_new_tokens": int(max_new_tokens), "stream": True}
+    tokens: list = []
+    ttft = None
+    replica = None
+    n_events = 0
+    with _post_json(url + path, payload, timeout) as resp:
+        for ev in sse_events(resp):
+            n_events += 1
+            if "token" in ev:
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                tokens.append(ev["token"])
+            elif "error" in ev:
+                raise RuntimeError(ev["error"])
+            elif ev.get("done"):
+                replica = ev.get("replica")
+                break
+    return {"tokens": tokens, "replica": replica, "ttft_s": ttft,
+            "events": n_events}

@@ -60,6 +60,7 @@ K/V unreachable.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import functools
 import time
 from typing import Optional
@@ -73,6 +74,11 @@ from tfde_tpu.inference.decode import (
     init_cache,
     sample_logits,
     validate_budget,
+)
+from tfde_tpu.inference.prefix_cache import (
+    is_index_leaf,
+    leaf_name,
+    resolve as _resolve_prefix,
 )
 from tfde_tpu.inference.speculative import _set_index_counters
 from tfde_tpu.observability import metrics
@@ -168,6 +174,7 @@ def _decode_scan(model, cache, params, tok, idx, budget, done, seen, rng,
     jax.jit,
     static_argnames=("model", "temperature", "top_k", "top_p", "min_p",
                      "repetition_penalty"),
+    donate_argnums=(1,),
 )
 def _prefill_rows(model, row_cache, params, prompts, last, valid, rng,
                   temperature, top_k, top_p, min_p, repetition_penalty):
@@ -182,6 +189,12 @@ def _prefill_rows(model, row_cache, params, prompts, last, valid, rng,
     size); the admission ladder pads the wave to a power of two by
     REPEATING a real row (identical content, so the duplicate scatter
     writes are idempotent) to bound compile count.
+
+    `row_cache` is DONATED: the mutated cache aliases the input buffers
+    instead of paying a device-side copy of every K/V leaf per admission
+    wave (tests/test_server.py pins the aliasing in the lowered HLO), so
+    callers must hand in a FRESH zero tree each wave — `_row_template`
+    materializes one from cached shapes.
 
     Returns (filled row cache, first tokens [R], seen rows [R, V] or
     None). Pad correctness rides the per-row index machinery: pad K/V
@@ -225,6 +238,97 @@ def _scatter_rows(cache, rows_cache, rows):
         return big.at[rows].set(small.astype(big.dtype))
 
     return jax.tree_util.tree_map_with_path(merge, cache, rows_cache)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "temperature", "top_k", "top_p", "min_p",
+                     "repetition_penalty"),
+    donate_argnums=(1,),
+)
+def _prefill_suffix(model, row_cache, params, prefix_kv, suffixes, last,
+                    fullp, valid, rng, temperature, top_k, top_p, min_p,
+                    repetition_penalty):
+    """Warm admission: land a cached prefix and prefill only the suffix,
+    in ONE program.
+
+    prefix_kv: {leaf-name: [R, L, ...]} — L cached prefix tokens of K/V
+    per row (prefix_cache.py trie segments, stacked per wave). They are
+    written at positions [:L], the index counters are set to L (the
+    speculative-decoding arbitrary-start contract), and the model then
+    consumes `suffixes` [R, Sbucket] as a normal feed starting at
+    position L — bit-identical to having prefilled the whole prompt
+    (tests/test_prefix_cache.py pins it). `last` [R] is the suffix-local
+    last position; `fullp`/`valid` [R, Fbucket] carry the FULL padded
+    prompt for the repetition-penalty presence mask (None when the
+    penalty is off). `row_cache` is donated, as in `_prefill_rows`.
+
+    Returns (filled row cache, first tokens [R], seen rows or None)."""
+    some = next(iter(prefix_kv.values()))
+    pre_len = some.shape[1]
+
+    def put(path, big):
+        if is_index_leaf(path):
+            return big
+        seg = prefix_kv[leaf_name(path)]
+        return big.at[:, :pre_len].set(seg.astype(big.dtype))
+
+    row_cache = jax.tree_util.tree_map_with_path(put, row_cache)
+    row_cache = _set_index_counters(row_cache, jnp.int32(pre_len))
+    logits, mutated = model.apply(
+        {"params": params, "cache": row_cache}, suffixes, train=False,
+        mutable=["cache"],
+    )
+    r = suffixes.shape[0]
+    ar = jnp.arange(r)
+    logits = logits[ar, last].astype(jnp.float32)
+    row_seen = None
+    if repetition_penalty != 1.0:
+        hits = jnp.zeros((r, model.vocab_size), jnp.int32)
+        hits = hits.at[ar[:, None], fullp].add(valid.astype(jnp.int32))
+        row_seen = hits > 0
+    tok = sample_logits(
+        logits, rng, temperature=temperature, top_k=top_k, top_p=top_p,
+        min_p=min_p, repetition_penalty=repetition_penalty, seen=row_seen,
+    )
+    if row_seen is not None:
+        row_seen = row_seen.at[ar, tok].set(True)
+    return mutated["cache"], tok, row_seen
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_primed_rows(cache, kv, rows):
+    """Land primed rows — prompts whose prefill ran on ANOTHER replica
+    (the prefill/decode role split) — into batch rows `rows` in one
+    donated update. kv: {leaf-name: [R, Pbucket, ...]} right-padded
+    primed K/V; positions past each row's true prompt length carry
+    zeros, which land beyond the committed count and stay unreachable
+    (the stale-K/V invariant). Index counters pass through, exactly as
+    in `_scatter_rows`."""
+
+    def merge(path, big):
+        if is_index_leaf(path):
+            return big
+        seg = kv[leaf_name(path)]
+        return big.at[rows, :seg.shape[1]].set(seg.astype(big.dtype))
+
+    return jax.tree_util.tree_map_with_path(merge, cache)
+
+
+@dataclasses.dataclass
+class PrimedRequest:
+    """A prefill-role replica's hand-off unit: everything a decode
+    replica needs to admit the request without running the prompt
+    forward itself. `kv` holds HOST arrays ({leaf-name: [P, ...]}), so
+    the object is process-portable — inference/router.py ships it as
+    JSON between replica processes. Greedy decoding of a primed request
+    is bit-identical to a locally-admitted one; at temperature > 0 the
+    first token was drawn from the PREFILL replica's key stream."""
+
+    prompt: np.ndarray          # [P] int32 token ids
+    first_token: int            # sampled at prefill time (pending, unfed)
+    max_new_tokens: int
+    kv: dict                    # leaf-name -> np.ndarray [P, ...]
 
 
 def _normalize_buckets(buckets, max_len: int) -> tuple:
@@ -295,9 +399,14 @@ class _BatcherBase:
     _metrics_prefix = "serving/batcher"
 
     def __init__(self, model, params, batch_size: int, max_len: int,
-                 eos_id, pad_id: int, rng, prompt_buckets):
+                 eos_id, pad_id: int, rng, prompt_buckets,
+                 role: str = "both"):
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', got {role!r}"
+            )
         self._buckets = _normalize_buckets(prompt_buckets, max_len)
         self._model = model
         self._params = params
@@ -306,12 +415,15 @@ class _BatcherBase:
         self._eos = eos_id
         self._pad = pad_id
         self._rng = rng if rng is not None else jax.random.key(0)
+        self._role = role
 
         self._req = [None] * batch_size          # request id or None
         self._out = [[] for _ in range(batch_size)]
         self._budget = np.zeros(batch_size, np.int64)
         self._committed = np.zeros(batch_size, np.int64)
         self._tok = np.full(batch_size, pad_id, np.int64)
+        # queue items: (rid, prompt [P] np.int64, budget, primed|None) —
+        # `primed` set only for submit_primed() entries (K/V in hand)
         self._queue: collections.deque = collections.deque()
         self._submitted_at: dict = {}   # rid -> submit wall time (TTFT)
         self._next_id = 0
@@ -319,6 +431,14 @@ class _BatcherBase:
         self._generated = 0      # every delivered token (incl. prefill 1st)
         self._dispatches = 0     # jitted-program / eager-op invocations
         self._syncs = 0          # blocking device->host fetches
+        # per-request incremental delivery (router/SSE): off by default —
+        # run()/step() consumers read completions, not partials, and an
+        # unread stream entry would leak
+        self._track_progress = False
+        self._stream: dict = {}  # rid -> {"tokens", "taken", "done"}
+
+    #: subclasses that implement `_primed_wave` + `prime` flip this
+    _accepts_primed = False
 
     # -- public -------------------------------------------------------------
     @property
@@ -329,8 +449,70 @@ class _BatcherBase:
     def free_rows(self) -> int:
         return sum(r is None for r in self._req)
 
+    @property
+    def role(self) -> str:
+        return self._role
+
+    @property
+    def outstanding_tokens(self) -> int:
+        """Remaining output-token budget across active rows plus the
+        queue — the router's least-loaded placement signal (exported as
+        a serving gauge via `_publish_stats`)."""
+        active = sum(
+            int(self._budget[r]) for r in range(self._b)
+            if self._req[r] is not None
+        )
+        return active + sum(int(b) for _rid, _p, b, _pr in self._queue)
+
     def submit(self, prompt, max_new_tokens: int) -> int:
         """Queue a request; returns its id. prompt: 1-D int token ids."""
+        if self._role == "prefill":
+            raise RuntimeError(
+                "prefill-only replica: use prime() and hand the result to "
+                "a decode replica's submit_primed()"
+            )
+        prompt = self._check_request(prompt, max_new_tokens)
+        rid = self._enqueue(prompt, int(max_new_tokens), None)
+        return rid
+
+    def submit_primed(self, primed: PrimedRequest) -> int:
+        """Queue a request whose prefill already ran on a prefill-role
+        replica (`prime()`); only the K/V scatter and decode happen
+        here. Returns the local request id."""
+        if not self._accepts_primed:
+            raise RuntimeError(
+                f"{type(self).__name__} does not accept primed requests"
+            )
+        if self._role == "prefill":
+            raise RuntimeError("prefill-only replica cannot decode")
+        prompt = self._check_request(primed.prompt, primed.max_new_tokens)
+        return self._enqueue(prompt, int(primed.max_new_tokens), primed)
+
+    def enable_progress(self) -> None:
+        """Track per-request incremental tokens for `take_progress` (the
+        router's SSE feed). Applies to requests submitted after the
+        call."""
+        self._track_progress = True
+
+    def take_progress(self, rid: int):
+        """(new tokens since the last take, done flag) for an in-flight
+        request. Requires `enable_progress()` before submit. A finished
+        request's entry is dropped by the take that drains it."""
+        ent = self._stream[rid]
+        toks = ent["tokens"][ent["taken"]:]
+        ent["taken"] += len(toks)
+        if ent["done"] and ent["taken"] == len(ent["tokens"]):
+            del self._stream[rid]
+        return toks, ent["done"]
+
+    def run(self) -> list:
+        """Step until idle; returns every completion in finish order."""
+        done = []
+        while not self.idle:
+            done.extend(self.step())
+        return done
+
+    def _check_request(self, prompt, max_new_tokens: int) -> np.ndarray:
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
@@ -345,18 +527,16 @@ class _BatcherBase:
                 f"{self._max_len}"
             )
         self._validate_submit(prompt, max_new_tokens)
+        return prompt
+
+    def _enqueue(self, prompt: np.ndarray, budget: int, primed) -> int:
         rid = self._next_id
         self._next_id += 1
-        self._queue.append((rid, prompt, int(max_new_tokens)))
+        self._queue.append((rid, prompt, budget, primed))
         self._submitted_at[rid] = time.perf_counter()
+        if self._track_progress:
+            self._stream[rid] = {"tokens": [], "taken": 0, "done": False}
         return rid
-
-    def run(self) -> list:
-        """Step until idle; returns every completion in finish order."""
-        done = []
-        while not self.idle:
-            done.extend(self.step())
-        return done
 
     def serve_metrics(self, port: int = 0, aggregator=None):
         """Start a /metrics endpoint next to this batcher (exposition.py);
@@ -375,6 +555,9 @@ class _BatcherBase:
             reg.gauge(f"{self._metrics_prefix}/{k}").set(v)
         reg.gauge(f"{self._metrics_prefix}/queue_depth").set(len(self._queue))
         reg.gauge(f"{self._metrics_prefix}/free_rows").set(self.free_rows)
+        reg.gauge(f"{self._metrics_prefix}/outstanding_tokens").set(
+            self.outstanding_tokens
+        )
 
     # -- hooks --------------------------------------------------------------
     def _validate_submit(self, prompt: np.ndarray,
@@ -397,7 +580,12 @@ class _BatcherBase:
         self._budget[r] -= 1
         self._tok[r] = t
         self._generated += 1
+        ent = self._stream.get(self._req[r]) if self._track_progress else None
+        if ent is not None:
+            ent["tokens"].append(int(t))
         if self._budget[r] <= 0 or (self._eos is not None and t == self._eos):
+            if ent is not None:
+                ent["done"] = True
             done = (self._req[r], np.asarray(self._out[r], np.int32))
             self._req[r] = None
             self._out[r] = []
@@ -406,15 +594,65 @@ class _BatcherBase:
             return [done]
         return []
 
+    def _plan_wave(self, wave) -> list:
+        """Partition one admission wave into prefill groups:
+        [(kind, key, items)] where each item is (rid, prompt, budget,
+        primed, extra). Base kinds: 'cold' (full prefill) grouped by
+        prompt bucket, and 'primed' (K/V in hand — scatter only) also by
+        bucket. `ContinuousBatcher` adds 'warm' prefix-cache groups, with
+        the matched K/V as `extra`."""
+        cold: dict = collections.OrderedDict()
+        primed: dict = collections.OrderedDict()
+        for rid, prompt, budget, pr in wave:
+            bucket = next(b for b in self._buckets if b >= prompt.size)
+            dst = primed if pr is not None else cold
+            dst.setdefault(bucket, []).append(
+                (rid, prompt, budget, pr, None)
+            )
+        plans = [("cold", b, g) for b, g in cold.items()]
+        plans += [("primed", b, g) for b, g in primed.items()]
+        return plans
+
+    def _admit_group(self, kind: str, key, group, rows) -> np.ndarray:
+        if kind == "cold":
+            return self._cold_wave(key, group, rows)
+        if kind == "primed":
+            return self._primed_wave(key, group, rows)
+        raise ValueError(f"unknown admission kind {kind!r}")
+
+    def _cold_wave(self, bucket: int, group, rows) -> np.ndarray:
+        n = len(group)
+        rp = _pad_wave(n, self._b)
+        prompts = np.full((rp, bucket), self._pad, np.int32)
+        last = np.zeros(rp, np.int32)
+        plens = np.zeros(rp, np.int32)
+        rows_pad = np.asarray(rows + [rows[0]] * (rp - n), np.int32)
+        for i in range(rp):
+            # wave padding repeats row 0's request verbatim: the
+            # duplicate prefill K/V is bit-identical (prefill is
+            # row-independent and deterministic), so the duplicate
+            # cache-scatter writes never race on ordering
+            _rid, prompt, _budget, _pr, _x = group[i if i < n else 0]
+            prompts[i, :prompt.size] = prompt
+            last[i] = prompt.size - 1
+            plens[i] = prompt.size
+        return self._prefill_wave(prompts, last, rows_pad, plens, n)
+
+    def _primed_wave(self, bucket: int, group, rows) -> np.ndarray:
+        raise NotImplementedError(
+            "primed admission requires a subclass with _accepts_primed"
+        )
+
     def _admit(self) -> list:
-        """Fill free rows from the queue, a BUCKET WAVE at a time: every
-        freed row whose next request shares a prompt bucket prefills in
-        one [R, Pbucket] call and lands with one multi-row scatter. The
-        prefill samples each row's first token in-program (generate's
-        prefill contract), so every active row uniformly holds one
-        pending token afterwards. A request finishing on its first token
-        (budget 1 / instant EOS) frees its row for the next queued
-        request within the same call."""
+        """Fill free rows from the queue, a GROUP WAVE at a time: every
+        freed row whose next request shares an admission group (cold
+        prompt bucket / warm prefix length / primed bucket) prefills in
+        one call and lands with one multi-row scatter. The prefill
+        samples each row's first token in-program (generate's prefill
+        contract), so every active row uniformly holds one pending token
+        afterwards. A request finishing on its first token (budget 1 /
+        instant EOS) frees its row for the next queued request within
+        the same call."""
         finished = []
         reg = metrics.default_registry()
         while self._queue and self.free_rows:
@@ -422,44 +660,26 @@ class _BatcherBase:
             wave = []
             while self._queue and len(wave) < len(free):
                 wave.append(self._queue.popleft())
-            by_bucket: dict = collections.OrderedDict()
-            for item in wave:
-                _rid, prompt, _budget = item
-                bucket = next(b for b in self._buckets if b >= prompt.size)
-                by_bucket.setdefault(bucket, []).append(item)
             taken = 0
-            for bucket, group in by_bucket.items():
+            for kind, key, group in self._plan_wave(wave):
                 n = len(group)
                 rows = free[taken:taken + n]
                 taken += n
-                rp = _pad_wave(n, self._b)
-                prompts = np.full((rp, bucket), self._pad, np.int32)
-                last = np.zeros(rp, np.int32)
-                plens = np.zeros(rp, np.int32)
-                rows_pad = np.asarray(
-                    rows + [rows[0]] * (rp - n), np.int32
-                )
-                for i in range(rp):
-                    # wave padding repeats row 0's request verbatim: the
-                    # duplicate prefill K/V is bit-identical (prefill is
-                    # row-independent and deterministic), so the duplicate
-                    # cache-scatter writes never race on ordering
-                    _rid, prompt, _budget = group[i if i < n else 0]
-                    prompts[i, :prompt.size] = prompt
-                    last[i] = prompt.size - 1
-                    plens[i] = prompt.size
+                t_wave = time.perf_counter()
                 with span("serving/prefill"):
-                    toks = self._prefill_wave(prompts, last, rows_pad,
-                                              plens, n)
+                    toks = self._admit_group(kind, key, group, rows)
                 # admission waves in the flight ring: one event per wave
                 # (not per request), enough to reconstruct the admit/queue
                 # rhythm in a serving post-mortem
                 from tfde_tpu.observability import flightrec
 
-                flightrec.record("admit", rows=n, bucket=int(bucket),
-                                 queue_depth=len(self._queue))
+                flightrec.record(
+                    "admit", rows=n, group=kind,
+                    key=list(key) if isinstance(key, tuple) else int(key),
+                    queue_depth=len(self._queue),
+                )
                 now = time.perf_counter()
-                for i, (rid, prompt, budget) in enumerate(group):
+                for i, (rid, prompt, budget, _pr, _x) in enumerate(group):
                     r = rows[i]
                     self._req[r] = rid
                     self._out[r] = []
@@ -467,6 +687,12 @@ class _BatcherBase:
                     self._committed[r] = prompt.size
                     t0 = self._submitted_at.pop(rid, None)
                     if t0 is not None:
+                        # the TTFT decomposition the bench reports:
+                        # queue_wait (submit -> wave start) + prefill
+                        # (the serving/prefill span) = first token
+                        reg.histogram("serving/queue_wait_ms").observe(
+                            (t_wave - t0) * 1e3
+                        )
                         reg.histogram("serving/ttft_ms").observe(
                             (now - t0) * 1e3
                         )
@@ -488,6 +714,15 @@ class ContinuousBatcher(_BatcherBase):
     ticks per host round-trip (see the module docstring; 1 restores the
     one-tick-per-step behavior). The sampling config is fixed per
     batcher, as for `generate`.
+
+    prefix_cache: a `prefix_cache.PrefixCache`, True/int (default
+    budget / byte budget), or None to defer to ``TFDE_PREFIX_CACHE`` —
+    admissions whose prompt prefix is cached skip straight to suffix
+    prefill (`_warm_wave`), bit-identical under greedy decoding.
+    role: 'both' (default), 'prefill' (serve `prime()` only — the
+    hand-off producer of the prefill/decode split), or 'decode'
+    (refuses `prime()`; accepts `submit_primed()` hand-offs alongside
+    plain submits). inference/router.py wires these across processes.
 
     Usage::
 
@@ -521,6 +756,8 @@ class ContinuousBatcher(_BatcherBase):
         rng: Optional[jax.Array] = None,
         prompt_buckets: Optional[tuple] = None,
         scan_depth: int = 4,
+        prefix_cache=None,
+        role: str = "both",
     ):
         if repetition_penalty <= 0.0:
             raise ValueError(
@@ -530,7 +767,7 @@ class ContinuousBatcher(_BatcherBase):
         if scan_depth < 1:
             raise ValueError(f"scan_depth must be >= 1, got {scan_depth}")
         super().__init__(model, params, batch_size, max_len, eos_id,
-                         pad_id, rng, prompt_buckets)
+                         pad_id, rng, prompt_buckets, role=role)
         self._decode_model = _decode_clone(model)
         self._sampling = dict(
             temperature=float(temperature),
@@ -550,14 +787,38 @@ class ContinuousBatcher(_BatcherBase):
 
         # index leaves become [B] vectors ONCE, so the scan carry shape is
         # stable from the first tick (the per-row decode-attention branch)
+        raw = init_cache(model, batch_size, self._max_len)
         self._cache = _set_index_counters(
-            init_cache(model, batch_size, self._max_len),
-            np.zeros(batch_size, np.int32),
+            raw, np.zeros(batch_size, np.int32)
         )
-        # zero row-cache templates per admission wave size, built lazily:
-        # _prefill_rows does not donate its cache argument, so each
-        # template survives reuse
-        self._row_templates: dict = {}
+        # row-cache SHAPES for every admission-wave width on the pad
+        # ladder, derived AT CONSTRUCTION: init_cache is a full flax
+        # eval_shape trace (~50ms) — paid lazily it lands in the first
+        # wave's TTFT. One extra rp=1 trace identifies the batch-carrying
+        # leaves (their shapes differ from the batch cache's); the other
+        # widths are pure shape substitution. _prefill_rows /
+        # _prefill_suffix DONATE their cache argument (no device-side K/V
+        # copy per wave), so each wave materializes fresh zeros into the
+        # donated slot instead of reusing a live template.
+        self._row_shapes: dict = {}
+        one = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            init_cache(model, 1, self._max_len),
+        )
+        rp = 1
+        while True:
+            self._row_shapes[rp] = jax.tree.map(
+                lambda s1, ab, _rp=rp: s1 if s1.shape == ab.shape
+                else jax.ShapeDtypeStruct(
+                    (_rp,) + s1.shape[1:], s1.dtype
+                ),
+                one, raw,
+            )
+            if rp >= batch_size:
+                break
+            rp = min(rp * 2, batch_size)
+        # prefix-KV cache (prefix_cache.py): None = every admission cold
+        self._prefix = _resolve_prefix(prefix_cache)
         # device-resident loop state (tok/idx/budget/done); rebuilt from
         # host bookkeeping whenever admission desyncs it
         self._dev = None
@@ -674,10 +935,17 @@ class ContinuousBatcher(_BatcherBase):
         self._dispatches += 1  # the four small host->device transfers
 
     def _row_template(self, rp: int):
-        if rp not in self._row_templates:
-            self._row_templates[rp] = init_cache(self._model, rp,
-                                                 self._max_len)
-        return self._row_templates[rp]
+        """FRESH zero row cache for a donated prefill call, materialized
+        from shapes cached per wave size (the donation consumed the last
+        one — reusing it would hand jit a deleted buffer)."""
+        if rp not in self._row_shapes:
+            self._row_shapes[rp] = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                init_cache(self._model, rp, self._max_len),
+            )
+        self._dispatches += 1
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            self._row_shapes[rp])
 
     def _prefill_wave(self, prompts, last, rows, plens, n) -> np.ndarray:
         rp, bucket = prompts.shape
@@ -695,6 +963,13 @@ class ContinuousBatcher(_BatcherBase):
             **self._sampling,
         )
         self._dispatches += 1
+        if self._prefix is not None:
+            # cold admissions SEED the prefix cache: store each real
+            # row's complete prompt blocks before the scatter consumes
+            # our interest in row_cache (slices are fresh buffers, so
+            # the donated-output aliasing never bites)
+            for i in range(n):
+                self._prefix.insert(prompts[i, :plens[i]], row_cache, i)
         rows_dev = jnp.asarray(rows)
         self._cache = _scatter_rows(self._cache, row_cache, rows_dev)
         self._dispatches += 1
@@ -713,6 +988,187 @@ class ContinuousBatcher(_BatcherBase):
         tok_np = _fetch(tok)
         self._syncs += 1
         return tok_np
+
+    # -- prefix cache (warm admission) ---------------------------------------
+    _accepts_primed = True
+
+    @property
+    def prefix_cache(self):
+        return self._prefix
+
+    def _plan_wave(self, wave) -> list:
+        if self._prefix is None:
+            return super()._plan_wave(wave)
+        cold: dict = collections.OrderedDict()
+        warm: dict = collections.OrderedDict()
+        primed: dict = collections.OrderedDict()
+        for rid, prompt, budget, pr in wave:
+            bucket = next(b for b in self._buckets if b >= prompt.size)
+            if pr is not None:
+                primed.setdefault(bucket, []).append(
+                    (rid, prompt, budget, pr, None)
+                )
+                continue
+            pre_len, kv = self._prefix.lookup(prompt)
+            if pre_len:
+                sbucket = next(
+                    b for b in self._buckets if b >= prompt.size - pre_len
+                )
+                # the full-prompt bucket only shapes the program when the
+                # repetition penalty needs the whole prompt's presence
+                # mask; keying on it otherwise would split waves for no
+                # compile reason
+                fbucket = bucket if self._seen is not None else 0
+                warm.setdefault((pre_len, sbucket, fbucket), []).append(
+                    (rid, prompt, budget, None, kv)
+                )
+            else:
+                cold.setdefault(bucket, []).append(
+                    (rid, prompt, budget, None, None)
+                )
+        plans = [("cold", b, g) for b, g in cold.items()]
+        plans += [("warm", k, g) for k, g in warm.items()]
+        plans += [("primed", b, g) for b, g in primed.items()]
+        return plans
+
+    def _admit_group(self, kind: str, key, group, rows) -> np.ndarray:
+        if kind == "warm":
+            return self._warm_wave(key, group, rows)
+        return super()._admit_group(kind, key, group, rows)
+
+    def _warm_wave(self, key, group, rows) -> np.ndarray:
+        """Admit rows whose prompt prefix is cached: land the prefix K/V
+        and prefill ONLY the suffix, one donated program per (prefix
+        length, suffix bucket) group — the shared-system-prompt fast
+        path the prefix cache exists for."""
+        pre_len, sbucket, fbucket = key
+        n = len(group)
+        rp = _pad_wave(n, self._b)
+        suffixes = np.full((rp, sbucket), self._pad, np.int32)
+        last = np.zeros(rp, np.int32)
+        fullp = plens = None
+        if self._seen is not None:
+            fullp = np.full((rp, fbucket), self._pad, np.int32)
+            plens = np.zeros(rp, np.int32)
+        kv_rows = []
+        for i in range(rp):
+            _rid, prompt, _budget, _pr, kv = group[i if i < n else 0]
+            suffix = prompt[pre_len:]
+            suffixes[i, :suffix.size] = suffix
+            last[i] = suffix.size - 1
+            if fullp is not None:
+                fullp[i, :prompt.size] = prompt
+                plens[i] = prompt.size
+            kv_rows.append(kv)
+        kv_stack = {
+            name: jnp.stack([k[name] for k in kv_rows])
+            for name in kv_rows[0]
+        }
+        valid = None
+        if fullp is not None:
+            valid = jnp.asarray(np.arange(fbucket)[None, :] < plens[:, None])
+            fullp = jnp.asarray(fullp)
+        rng = None
+        if self._sampling["temperature"] != 0.0:
+            self._rng, rng = jax.random.split(self._rng)
+        row_cache, tok, row_seen = _prefill_suffix(
+            self._decode_model, self._row_template(rp), self._params,
+            kv_stack, jnp.asarray(suffixes), jnp.asarray(last), fullp,
+            valid, rng, **self._sampling,
+        )
+        self._dispatches += 2  # the per-wave kv stack + the fused prefill
+        rows_pad = np.asarray(rows + [rows[0]] * (rp - n), np.int32)
+        rows_dev = jnp.asarray(rows_pad)
+        self._cache = _scatter_rows(self._cache, row_cache, rows_dev)
+        self._dispatches += 1
+        if row_seen is not None:
+            if rp > n:
+                sel = np.arange(rp)
+                sel[n:] = 0
+                row_seen = row_seen[jnp.asarray(sel)]
+            self._seen = self._seen.at[rows_dev].set(row_seen)
+            self._dispatches += 1
+        tok_np = _fetch(tok)
+        self._syncs += 1
+        return tok_np
+
+    # -- prefill/decode role split -------------------------------------------
+    def prime(self, prompt, max_new_tokens: int) -> PrimedRequest:
+        """Run ONLY the prefill for one request and return the hand-off
+        payload (host K/V + pending first token) for a decode replica's
+        `submit_primed()` — the prefill half of the role split. Touches
+        no decode row and no queue, so a prefill-role replica can serve
+        long-prompt admissions without ever stalling a decode scan."""
+        if self._role == "decode":
+            raise RuntimeError("decode-only replica cannot prime")
+        prompt = self._check_request(prompt, max_new_tokens)
+        bucket = next(b for b in self._buckets if b >= prompt.size)
+        prompts = np.full((1, bucket), self._pad, np.int32)
+        prompts[0, :prompt.size] = prompt
+        last = np.asarray([prompt.size - 1], np.int32)
+        valid = None
+        if self._seen is not None:
+            valid = jnp.asarray(np.arange(bucket)[None, :] < prompt.size)
+        rng = None
+        if self._sampling["temperature"] != 0.0:
+            self._rng, rng = jax.random.split(self._rng)
+        row_cache, tok, _ = _prefill_rows(
+            self._decode_model, self._row_template(1), self._params,
+            jnp.asarray(prompts), jnp.asarray(last), valid, rng,
+            **self._sampling,
+        )
+        self._dispatches += 1
+        if self._prefix is not None:
+            self._prefix.insert(prompts[0, :prompt.size], row_cache, 0)
+        kv = {}
+        for path, leaf in jax.tree_util.tree_leaves_with_path(row_cache):
+            if is_index_leaf(path):
+                continue
+            kv[leaf_name(path)] = leaf[0, :prompt.size]
+        kv_np, tok_np = _fetch((kv, tok))
+        self._syncs += 1
+        return PrimedRequest(
+            prompt=prompt.astype(np.int32),
+            first_token=int(tok_np[0]),
+            max_new_tokens=int(max_new_tokens),
+            kv=kv_np,
+        )
+
+    def _primed_wave(self, bucket: int, group, rows) -> np.ndarray:
+        """Admit rows primed on another replica: stack the shipped host
+        K/V, one donated multi-row scatter, zero model flops here — the
+        decode scan never waits behind a long-prompt prefill."""
+        n = len(group)
+        rp = _pad_wave(n, self._b)
+        rows_pad = np.asarray(rows + [rows[0]] * (rp - n), np.int32)
+        sample = group[0][3].kv
+        stacked = {
+            name: np.zeros((rp, bucket) + arr.shape[1:], arr.dtype)
+            for name, arr in sample.items()
+        }
+        toks = np.zeros(rp, np.int64)
+        seen_rows = (
+            np.zeros((rp, self._vocab), bool)
+            if self._seen is not None else None
+        )
+        for i in range(rp):
+            _rid, prompt, _budget, pr, _x = group[i if i < n else 0]
+            for name, arr in pr.kv.items():
+                stacked[name][i, :arr.shape[0]] = arr
+            toks[i] = pr.first_token
+            if seen_rows is not None:
+                # rebuild the presence mask from ids — cheaper to recompute
+                # than to ship a [vocab] row across processes
+                seen_rows[i, prompt] = True
+                seen_rows[i, pr.first_token] = True
+        kv_dev = {name: jnp.asarray(b) for name, b in stacked.items()}
+        rows_dev = jnp.asarray(rows_pad)
+        self._cache = _scatter_primed_rows(self._cache, kv_dev, rows_dev)
+        self._dispatches += 1
+        if seen_rows is not None:
+            self._seen = self._seen.at[rows_dev].set(jnp.asarray(seen_rows))
+            self._dispatches += 1
+        return toks  # first tokens are host-known: no sync on this path
 
 
 class SpeculativeContinuousBatcher(_BatcherBase):
@@ -806,10 +1262,17 @@ class SpeculativeContinuousBatcher(_BatcherBase):
         super()._validate_submit(prompt, max_new_tokens)
         validate_budget(self._draft, int(prompt.size), max_new_tokens)
 
-    def _template(self, cache_dict, model, rp: int):
-        if rp not in cache_dict:
-            cache_dict[rp] = init_cache(model, rp, self._cache_len)
-        return cache_dict[rp]
+    def _template(self, shapes: dict, model, rp: int):
+        """Fresh zero rows for the donated prefill, from shapes cached
+        per wave size (see ContinuousBatcher._row_template)."""
+        if rp not in shapes:
+            shapes[rp] = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                init_cache(model, rp, self._cache_len),
+            )
+        self._dispatches += 1
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            shapes[rp])
 
     def _prefill_wave(self, prompts, last, rows, plens, n) -> np.ndarray:
         rp = prompts.shape[0]

@@ -75,6 +75,19 @@ def note_straggler(host: int, ratio: float) -> None:
     flightrec.record("straggler", host=int(host), ratio=float(ratio))
 
 
+def note_replica_down(replica: int, reason: str) -> None:
+    """Router-side sink for a lost SERVING replica (inference/router.py):
+    a connection failure or stale metric pushes took it out of rotation.
+    Mirrors the training-side host sinks so the same dashboards and
+    flight-ring reads cover serving incidents."""
+    counters.incr("resilience/replicas_lost")
+    metrics.gauge("resilience/last_replica_lost").set(replica)
+    from tfde_tpu.observability import flightrec
+
+    flightrec.record("replica_lost", replica=int(replica),
+                     reason=str(reason))
+
+
 def note_stale_host(host: int, age_seconds: float) -> None:
     """Chief-side sink for the dead-host detector: a host stopped pushing
     snapshots. Liveness itself is per-host (the scheduler's job); this is

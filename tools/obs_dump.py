@@ -14,6 +14,11 @@ surfaces, composable in one invocation:
   chief ``/metrics`` and print the per-host table (up/stale, snapshot
   age, steps/sec, push counts) plus the cluster rollups (min/median/max
   step time, straggler) the aggregator exported.
+- ``python tools/obs_dump.py --router http://router:8000`` — hit a LIVE
+  serving Router's ``/replicas`` and print the routing table: per
+  replica up/drained, outstanding tokens (the placement signal), served
+  sessions, and metric-push age (the serving-cluster runbook surface,
+  WORKFLOWS.md §13).
 - ``--tail N`` — how many trailing flight events to print (default 10).
 
 Reads only; stdlib only — safe to run against a production model_dir.
@@ -133,20 +138,44 @@ def dump_live(url: str) -> None:
               "aggregator on this endpoint)")
 
 
+def dump_router(url: str) -> None:
+    target = url.rstrip("/")
+    if not target.endswith("/replicas"):
+        target += "/replicas"
+    body = json.loads(urllib.request.urlopen(target, timeout=5).read())
+    rows = body.get("replicas", [])
+    print(f"== router: {target} ({len(rows)} replicas)")
+    print(f"  {'replica':>7} {'up':>3} {'drained':>7} {'outstanding':>11} "
+          f"{'served':>7} {'push_age_s':>10}  url")
+    for r in rows:
+        age = r.get("push_age_s")
+        print(f"  {r.get('replica', '?'):>7} "
+              f"{int(bool(r.get('up'))):>3} "
+              f"{int(bool(r.get('drained'))):>7} "
+              f"{r.get('outstanding_tokens', 0):>11} "
+              f"{r.get('served', 0):>7} "
+              f"{(f'{age:.1f}' if age is not None else '-'):>10}  "
+              f"{r.get('url', '?')}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("model_dir", nargs="?",
                     help="run directory holding debug/ and metrics/")
     ap.add_argument("--url", help="live chief to scrape, e.g. "
                                   "http://chief:9090")
+    ap.add_argument("--router", help="live serving Router to query, e.g. "
+                                     "http://router:8000")
     ap.add_argument("--tail", type=int, default=10,
                     help="trailing flight events to print (default 10)")
     args = ap.parse_args(argv)
-    if not args.model_dir and not args.url:
-        ap.error("give a model_dir, --url, or both")
+    if not args.model_dir and not args.url and not args.router:
+        ap.error("give a model_dir, --url, --router, or a combination")
 
     if args.url:
         dump_live(args.url)
+    if args.router:
+        dump_router(args.router)
     if args.model_dir:
         flights = sorted(glob.glob(
             os.path.join(args.model_dir, "debug", "flight_*.jsonl")))

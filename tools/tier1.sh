@@ -17,6 +17,15 @@
 #                                   optimizers warn-fallback to
 #                                   replicated, and parity-pinning tests
 #                                   request 'replicated' explicitly)
+#        TFDE_PREFIX_CACHE=on tools/tier1.sh
+#                                  (re-run with the serving prefix-KV
+#                                   cache enabled by default on every
+#                                   ContinuousBatcher —
+#                                   inference/prefix_cache.py; greedy
+#                                   outputs are pinned bit-identical, so
+#                                   the whole suite doubles as the
+#                                   cache-on parity sweep. Also accepts
+#                                   an integer byte budget.)
 #
 # Also prints DOTS_DELTA (this run's DOTS_PASSED minus the previous
 # run's, from /tmp/_t1.passed) so a regression is visible at a glance
@@ -28,6 +37,7 @@ rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     TFDE_GRAD_TRANSPORT="${TFDE_GRAD_TRANSPORT:-fp32}" \
     TFDE_OPT_SHARDING="${TFDE_OPT_SHARDING:-replicated}" \
+    TFDE_PREFIX_CACHE="${TFDE_PREFIX_CACHE:-off}" \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     --durations=10 \
