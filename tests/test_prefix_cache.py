@@ -196,6 +196,89 @@ def test_batcher_prefix_parity_greedy(lm, rng):
         )
 
 
+def test_plan_clamps_warm_suffix_bucket(lm):
+    """A warm admission feeds the suffix at cache position pre_len, so
+    its bucket must satisfy pre_len + sbucket <= max_len — otherwise the
+    donated suffix prefill's clamped cache write would silently
+    overwrite the scattered prefix K/V. The planner shortens the used
+    prefix (whole blocks) until a bucket fits, or falls back to cold."""
+    model, params = lm
+    t = np.arange(1, 65, dtype=np.int64)
+
+    # buckets (8, 48, 64): the matched 24-token prefix leaves no legal
+    # bucket for its 30-token suffix (48 > 64 - 24), but a 16-token
+    # prefix fits (16 + 48 = 64) — shrink, don't go cold
+    pc = PrefixCache(block=8)
+    pc.insert(t[:32], _fake_cache(length=32), row=0)
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+                            prompt_buckets=(8, 48, 64), prefix_cache=pc)
+    prompt = np.concatenate([t[:24], t[:30] + 100])
+    [(kind, key, group)] = srv._plan_wave([(0, prompt, 4, None)])
+    assert kind == "warm"
+    pre_len, sbucket, _f = key
+    assert (pre_len, sbucket) == (16, 48)
+    kv = group[0][4]
+    assert all(a.shape[0] == 16 for a in kv.values())  # sliced to fit
+
+    # pow-2 buckets, long suffix: NO nonzero prefix admits a legal
+    # bucket (suffix 33 rounds to 64 > 64 - 16) -> cold admission
+    pc2 = PrefixCache(block=16)
+    pc2.insert(t[:32], _fake_cache(length=32), row=0)
+    srv2 = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+                             prefix_cache=pc2)
+    prompt2 = np.concatenate([t[:16], t[:33] + 100])
+    [(kind2, key2, _g2)] = srv2._plan_wave([(0, prompt2, 3, None)])
+    assert kind2 == "cold" and key2 == 64
+
+
+def test_batcher_prefix_parity_long_suffix(lm, rng):
+    """End to end in the overflow regime: a prefix hit whose suffix
+    bucket would not fit past the prefix must still decode bit-identical
+    to solo (the planner demotes it to cold instead of corrupting the
+    row)."""
+    model, params = lm
+    sysp = rng.integers(1, 90, 32).astype(np.int64)
+    pc = PrefixCache(block=16)
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+                            prefix_cache=pc)
+    done = {}
+    r0 = srv.submit(sysp, 6)            # cold: seeds both prefix blocks
+    done.update(srv.run())
+    long_tail = np.concatenate(
+        [sysp[:16], rng.integers(1, 90, 33).astype(np.int64)]
+    )                                   # 49 tokens: suffix 33 rounds to 64
+    r1 = srv.submit(long_tail, 3)
+    done.update(srv.run())
+    for rid, p, n in ((r0, sysp, 6), (r1, long_tail, 3)):
+        np.testing.assert_array_equal(
+            done[rid], _solo(model, params, p, n)
+        )
+
+
+def test_batcher_prefix_parity_shrunk_prefix(lm, rng):
+    """End to end through the shrink branch: the planner drops trailing
+    prefix blocks until the suffix bucket fits, and the warm wave with
+    the SLICED prefix K/V still matches solo bit for bit."""
+    model, params = lm
+    pc = PrefixCache(block=8)
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+                            prompt_buckets=(8, 48, 64), prefix_cache=pc)
+    base = rng.integers(1, 90, 32).astype(np.int64)
+    done = {}
+    r0 = srv.submit(base, 8)
+    done.update(srv.run())
+    p1 = np.concatenate(
+        [base[:24], rng.integers(1, 90, 30).astype(np.int64)]
+    )                                   # pre_len 24 -> shrunk to 16
+    r1 = srv.submit(p1, 8)
+    done.update(srv.run())
+    assert pc.stats()["hits"] >= 1
+    for rid, p, n in ((r0, base, 8), (r1, p1, 8)):
+        np.testing.assert_array_equal(
+            done[rid], _solo(model, params, p, n)
+        )
+
+
 def test_batcher_prefix_parity_repetition_penalty(lm, rng):
     """The warm path must also reconstruct the penalty presence mask from
     the FULL prompt (cached prefix included), not just the suffix it

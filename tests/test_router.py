@@ -129,7 +129,7 @@ def test_drain_stops_new_placement(pair, rng):
         headers={"Content-Type": "application/json"},
     )
     body = json.loads(urllib.request.urlopen(req, timeout=5).read())
-    assert body == {"drained": 0}
+    assert body == {"drained": 0, "tier": "decode"}
     outs = [
         request_generate(router.url, rng.integers(1, 90, 5).tolist(), 6)
         for _ in range(3)
@@ -138,6 +138,63 @@ def test_drain_stops_new_placement(pair, rng):
     tab = {row["replica"]: row for row in router.table()}
     # drained is not down: the replica stays up, just unplaced
     assert tab[0]["drained"] is True and tab[0]["up"] is True
+
+
+def test_drain_validation(pair):
+    """/drain must 400 on a missing or garbage index and 404 on an
+    unknown one — a silent 200 used to hide typos in the runbook's
+    drain procedure."""
+    _model, _params, _r0, _r1, router = pair
+
+    def post(payload):
+        req = urllib.request.Request(
+            router.url + "/drain", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            return urllib.request.urlopen(req, timeout=5).status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    assert post({}) == 400
+    assert post({"replica": "zero"}) == 400
+    assert post({"replica": 0, "tier": "bogus"}) == 400
+    assert post({"replica": 7}) == 404
+    assert post({"replica": 7, "tier": "prefill"}) == 404
+    assert post({"replica": 1}) == 200
+    tab = {row["replica"]: row for row in router.table()}
+    assert tab[1]["drained"] is True and tab[0]["drained"] is False
+
+
+def test_client_disconnect_cancels_request(lm, rng):
+    """Dropping the SSE connection mid-stream must cancel the request on
+    the replica — otherwise the batcher decodes the abandoned work to
+    completion and its progress entry leaks forever."""
+    model, params = lm
+    rep = _mk_replica(model, params, 0, batch=1)
+    b = rep.batcher
+    try:
+        payload = json.dumps({
+            "prompt": rng.integers(1, 90, 5).tolist(),
+            "max_new_tokens": 50,
+        }).encode()
+        req = urllib.request.Request(
+            rep.url + "/generate", data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = urllib.request.urlopen(req, timeout=10)
+        resp.readline()          # first event arrived: request in flight
+        with rep.lock:           # stall decode so tokens remain pending
+            resp.close()         # client walks away mid-stream
+            time.sleep(0.05)     # let the reset land before writes resume
+        deadline = time.monotonic() + 60
+        while ((not b.idle or b._stream)
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert b.idle
+        assert not b._stream
+    finally:
+        rep.close()
 
 
 def test_prefill_tier_disaggregated_parity(lm, rng):

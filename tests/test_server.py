@@ -504,3 +504,27 @@ def test_batcher_repetition_penalty_no_repeats(rng):
         for t in toks:
             assert t not in emitted, (rid, t, emitted)
             emitted.append(int(t))
+
+
+def test_cancel_frees_row_and_queue(lm, rng):
+    """cancel() abandons a request whose consumer is gone (router client
+    disconnect): queued entries drop, active rows free so the decode
+    scan stops spending ticks on them, and the progress entry never
+    leaks. The recycled row must then serve fresh work bit-identically."""
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=1, max_len=64)
+    srv.enable_progress()
+    p = rng.integers(1, 90, 5).astype(np.int64)
+    active = srv.submit(p, 40)
+    queued = srv.submit(p, 6)
+    srv.step()                       # admits `active`; `queued` waits
+    assert srv.free_rows == 0 and len(srv._queue) == 1
+    assert srv.cancel(queued)
+    assert queued not in srv._stream and len(srv._queue) == 0
+    assert srv.cancel(active)
+    assert active not in srv._stream
+    assert srv.free_rows == 1 and srv.idle
+    assert not srv.cancel(active)    # already gone
+    rid = srv.submit(p, 6)
+    done = dict(srv.run())
+    np.testing.assert_array_equal(done[rid], _solo(model, params, p, 6))

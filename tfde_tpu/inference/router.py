@@ -266,21 +266,29 @@ class ReplicaServer:
                 rid = self.batcher.submit(
                     body["prompt"], int(body["max_new_tokens"])
                 )
-        handler.send_response(200)
-        handler.send_header("Content-Type", "text/event-stream")
-        handler.end_headers()
-        _sse_write(handler.wfile, {"rid": rid, "replica": self.replica_id})
-        sent = 0
-        while True:
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "text/event-stream")
+            handler.end_headers()
+            _sse_write(handler.wfile,
+                       {"rid": rid, "replica": self.replica_id})
+            sent = 0
+            while True:
+                with self.lock:
+                    toks, done = self.batcher.take_progress(rid)
+                for t in toks:
+                    _sse_write(handler.wfile, {"token": int(t)})
+                    sent += 1
+                if done:
+                    _sse_write(handler.wfile, {"done": True, "n": sent})
+                    return
+                time.sleep(self._poll)
+        except (BrokenPipeError, ConnectionResetError):
+            # the consumer is gone (router timeout / client disconnect):
+            # without the cancel the request would decode to completion
+            # on abandoned work and its progress entry would leak forever
             with self.lock:
-                toks, done = self.batcher.take_progress(rid)
-            for t in toks:
-                _sse_write(handler.wfile, {"token": int(t)})
-                sent += 1
-            if done:
-                _sse_write(handler.wfile, {"done": True, "n": sent})
-                return
-            time.sleep(self._poll)
+                self.batcher.cancel(rid)
 
 
 # -- router ------------------------------------------------------------------
@@ -358,11 +366,27 @@ class Router:
                 if self.path == "/v1/generate":
                     router._serve_generate(self, body)
                 elif self.path == "/drain":
-                    idx = int(body["replica"])
-                    router.drain(idx)
-                    ReplicaServer._send_json(
-                        self, 200, {"drained": idx}
-                    )
+                    try:
+                        idx = int(body["replica"])
+                        tier = str(body.get("tier", "decode"))
+                        if tier not in ("decode", "prefill"):
+                            raise ValueError(f"unknown tier {tier!r}")
+                    except (KeyError, TypeError, ValueError) as e:
+                        ReplicaServer._send_json(
+                            self, 400,
+                            {"error": f"need integer 'replica' "
+                                      f"(+ optional tier): {e}"},
+                        )
+                        return
+                    if router.drain(idx, tier):
+                        ReplicaServer._send_json(
+                            self, 200, {"drained": idx, "tier": tier}
+                        )
+                    else:
+                        ReplicaServer._send_json(
+                            self, 404,
+                            {"error": f"unknown {tier} replica {idx}"},
+                        )
                 else:
                     self.send_error(404)
 
@@ -407,6 +431,16 @@ class Router:
                 raise LookupError("no live replicas")
             return min(cands, key=lambda r: r.outstanding)
 
+    def _account(self, rep: _Replica, outstanding: int = 0,
+                 served: int = 0) -> None:
+        """Handler threads run concurrently while `_pick` reads the
+        counters under the lock — every read-modify-write must be atomic
+        or a lost update skews least-outstanding placement for the rest
+        of the process lifetime."""
+        with self._lock:
+            rep.outstanding += outstanding
+            rep.served += served
+
     def _mark_down(self, rep: _Replica, reason: str) -> None:
         with self._lock:
             if not rep.up:
@@ -423,14 +457,23 @@ class Router:
         flightrec.record("replica_down", replica=rep.idx, reason=reason)
         flightrec.dump("replica_down")
 
-    def drain(self, idx: int) -> None:
-        """Stop placing new sessions on replica `idx`; in-flight streams
-        finish on their own. The graceful half of replica removal."""
-        for rep in self._reps:
+    def drain(self, idx: int, tier: str = "decode") -> bool:
+        """Stop placing new sessions on replica `idx` of `tier`
+        ('decode' or 'prefill'); in-flight streams finish on their own.
+        The graceful half of replica removal. Returns whether the index
+        named a known replica."""
+        if tier not in ("decode", "prefill"):
+            raise ValueError(f"unknown drain tier {tier!r}")
+        pool = self._pre if tier == "prefill" else self._reps
+        label = "prefill" if tier == "prefill" else "replica"
+        for rep in pool:
             if rep.idx == idx:
-                rep.drained = True
-                self._reg.gauge(f"router/replica{idx}/drained").set(1)
-                flightrec.record("replica_drain", replica=idx)
+                with self._lock:
+                    rep.drained = True
+                self._reg.gauge(f"router/{label}{idx}/drained").set(1)
+                flightrec.record("replica_drain", replica=idx, tier=tier)
+                return True
+        return False
 
     def table(self) -> list:
         """Live routing table (the obs_dump --router surface)."""
@@ -471,7 +514,7 @@ class Router:
             except LookupError:
                 return None  # prefill tier down: decode replicas prefill
             try:
-                rep.outstanding += len(body["prompt"])
+                self._account(rep, outstanding=len(body["prompt"]))
                 try:
                     with _post_json(
                         rep.url + "/prime",
@@ -481,8 +524,8 @@ class Router:
                     ) as resp:
                         out = json.loads(resp.read())
                 finally:
-                    rep.outstanding -= len(body["prompt"])
-                rep.served += 1
+                    self._account(rep, outstanding=-len(body["prompt"]))
+                self._account(rep, served=1)
                 return out
             except urllib.error.HTTPError:
                 return None   # request-specific: let the decode tier try
@@ -521,7 +564,7 @@ class Router:
                 return
             if exclude:
                 self._reg.counter("router/reroutes").incr()
-            rep.outstanding += budget
+            self._account(rep, outstanding=budget)
             tokens: list = []
             relayed = 0
             finished = False
@@ -558,10 +601,17 @@ class Router:
                     raise ConnectionError("stream ended before done")
             except urllib.error.HTTPError as e:
                 # request-level rejection (validation): the replica is
-                # fine — forward the error, do NOT mark down
+                # fine — forward the error, do NOT mark down. Once SSE
+                # headers (and possibly body bytes) went out, a second
+                # send_response would corrupt the stream — report
+                # in-band instead
                 detail = e.read().decode(errors="replace")
-                ReplicaServer._send_json(handler, e.code,
-                                         {"error": detail})
+                if headers_sent:
+                    _sse_write(handler.wfile,
+                               {"error": detail, "retriable": False})
+                else:
+                    ReplicaServer._send_json(handler, e.code,
+                                             {"error": detail})
                 return
             except _DEAD as e:
                 self._mark_down(rep, str(e))
@@ -575,9 +625,9 @@ class Router:
                     return
                 continue   # nothing delivered yet: transparent re-route
             finally:
-                rep.outstanding -= budget
+                self._account(rep, outstanding=-budget)
                 self._publish()
-            rep.served += 1
+            self._account(rep, served=1)
             self._publish()
             if stream:
                 _sse_write(handler.wfile,

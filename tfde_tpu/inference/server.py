@@ -512,6 +512,31 @@ class _BatcherBase:
             done.extend(self.step())
         return done
 
+    def cancel(self, rid: int) -> bool:
+        """Abandon a request whose consumer is gone (router client
+        disconnect): drop it from the queue, or free its row so the
+        decode scan stops spending ticks on it. Partial output is
+        discarded. Returns whether the request was found in flight."""
+        self._stream.pop(rid, None)
+        self._submitted_at.pop(rid, None)
+        for i, item in enumerate(self._queue):
+            if item[0] == rid:
+                del self._queue[i]
+                return True
+        for r in range(self._b):
+            if self._req[r] == rid:
+                self._req[r] = None
+                self._out[r] = []
+                self._budget[r] = 0
+                self._committed[r] = 0
+                self._tok[r] = self._pad
+                # the device loop state still thinks the row is live;
+                # force a rebuild so its done flag flips before the next
+                # scan
+                self._mark_dirty()
+                return True
+        return False
+
     def _check_request(self, prompt, max_new_tokens: int) -> np.ndarray:
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         if prompt.size < 1:
@@ -1010,10 +1035,26 @@ class ContinuousBatcher(_BatcherBase):
                 )
                 continue
             pre_len, kv = self._prefix.lookup(prompt)
-            if pre_len:
+            # the suffix feeds at cache position pre_len, so its bucket
+            # must ALSO fit the row: pre_len + sbucket <= max_len, or the
+            # transformer's clamped dynamic_update_slice would silently
+            # overwrite the scattered prefix K/V. Shorten the used prefix
+            # (whole blocks) until a bucket fits; pre_len 0 is a cold
+            # admission, whose full-prompt bucket always fits.
+            matched, sbucket = pre_len, None
+            while pre_len:
+                suffix = prompt.size - pre_len
                 sbucket = next(
-                    b for b in self._buckets if b >= prompt.size - pre_len
+                    (b for b in self._buckets
+                     if b >= suffix and pre_len + b <= self._max_len),
+                    None,
                 )
+                if sbucket is not None:
+                    break
+                pre_len -= self._prefix.block
+            if pre_len:
+                if pre_len < matched:
+                    kv = {name: a[:pre_len] for name, a in kv.items()}
                 # the full-prompt bucket only shapes the program when the
                 # repetition penalty needs the whole prompt's presence
                 # mask; keying on it otherwise would split waves for no
@@ -1042,6 +1083,10 @@ class ContinuousBatcher(_BatcherBase):
         length, suffix bucket) group — the shared-system-prompt fast
         path the prefix cache exists for."""
         pre_len, sbucket, fbucket = key
+        # _plan_wave guarantees the suffix bucket fits the row past the
+        # scattered prefix; a violation here would clamp the cache write
+        # and corrupt the prefix K/V silently
+        assert pre_len + sbucket <= self._max_len, (pre_len, sbucket)
         n = len(group)
         rp = _pad_wave(n, self._b)
         suffixes = np.full((rp, sbucket), self._pad, np.int32)
