@@ -1574,6 +1574,53 @@ def _bench_serve(clock: _Clock, smoke: bool) -> dict:
         st["bytes_saved"] / 2**20, 2
     )
     out["serve_prefix_parity_ok"] = warm_toks == ref_toks
+
+    # ---- tracing A/B (observability/trace.py): same stream, ring on ----
+    # The zero-cost-when-off claim needs a number: re-run the serving
+    # stream with every request carrying a trace id and the process ring
+    # recording queue/prefill/decode-round/done events, and report the
+    # throughput give-up. Ring appends are nanoseconds but the wall clock
+    # is not: interleaved best-of-N per side (drift hits both alike; the
+    # per-round spread on a tiny CPU smoke run is ~15%, far above the
+    # effect being measured — 8 rounds converge it, 3 suffice on the
+    # longer full-config walls), clamped at 0. Compiles are already
+    # warm — the A/B times scheduling, not XLA.
+    from tfde_tpu.observability import trace as reqtrace
+
+    def stream_tps(traced: bool) -> float:
+        b = ContinuousBatcher(model, params, batch_size=batch,
+                              max_len=max_len, scan_depth=depth)
+        srng = np.random.default_rng(0)
+        for i in range(n_req):
+            b.submit(
+                srng.integers(0, model.vocab_size, lens[i % len(lens)]),
+                new, trace=reqtrace.new_id() if traced else None,
+            )
+        ts = _time.perf_counter()
+        fin = b.run()
+        return (sum(len(t) for _, t in fin)
+                / max(_time.perf_counter() - ts, 1e-9))
+
+    trace_was_on = reqtrace.active()
+    if not trace_was_on:
+        reqtrace.enable()
+    try:
+        plain_tps, traced_tps = 0.0, 0.0
+        for _ in range(8 if smoke else 3):
+            plain_tps = max(plain_tps, stream_tps(False))
+            traced_tps = max(traced_tps, stream_tps(True))
+        out["serve_trace_overhead_pct"] = round(
+            max(0.0, 1.0 - traced_tps / max(plain_tps, 1e-9)) * 100, 2
+        )
+        # exemplar linking: the trace ids a p99 hunt would start from
+        ex = reqtrace.exemplars("serving/ttft_ms")
+        if ex:
+            out["serve_ttft_p99_exemplar_traces"] = [
+                r["trace"] for r in ex[:3]
+            ]
+    finally:
+        if not trace_was_on:
+            reqtrace.disable()
     return out
 
 
@@ -1583,8 +1630,10 @@ def serve_replica_child_mode() -> None:
     atomically renamed port file. argv:
     ``--serve-replica-child <replica_id> <port_file> <push_url|->``.
     Compiles are warmed before the port is announced, so the parent's
-    Poisson load never times a child's XLA. Runs until the parent kills
-    it — SIGTERM at teardown, SIGKILL in the drill."""
+    Poisson load never times a child's XLA. Request tracing follows the
+    inherited ``TFDE_TRACE`` env (the parent spawns recording and
+    non-recording twins for the overhead A/B). Runs until the parent
+    kills it — SIGTERM at teardown, SIGKILL in the drill."""
     i = sys.argv.index("--serve-replica-child")
     rid = int(sys.argv[i + 1])
     port_file = sys.argv[i + 2]
@@ -1649,6 +1698,7 @@ def _bench_serve_cluster(smoke: bool) -> dict:
 
     from tfde_tpu.inference.router import Router, request_generate
     from tfde_tpu.observability import metrics as _metrics
+    from tfde_tpu.observability import trace as reqtrace
     from tfde_tpu.observability.aggregate import ClusterAggregator
     from tfde_tpu.observability.exposition import serve_metrics
 
@@ -1658,6 +1708,11 @@ def _bench_serve_cluster(smoke: bool) -> dict:
     reg = _metrics.default_registry()
     tmp = tempfile.mkdtemp(prefix="tfde_serve_cluster_")
     procs, routers, ms = [], [], None
+    # the parent holds the routers, so its ring carries the router half of
+    # every stitched waterfall below
+    trace_was_on = reqtrace.active()
+    if not trace_was_on:
+        reqtrace.enable()
     try:
         agg = ClusterAggregator(stale_after=2.0)
         ms = serve_metrics(host="127.0.0.1", aggregator=agg)
@@ -1665,12 +1720,19 @@ def _bench_serve_cluster(smoke: bool) -> dict:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"   # replicas never contend for the TPU
         env.pop("XLA_FLAGS", None)
-        port_files = [os.path.join(tmp, f"port{i}") for i in range(2)]
-        for i in range(2):
+        # children 0/1 are the cluster (rings recording — the drill below
+        # wants the survivor's half of a stitched waterfall); child 2 is a
+        # tracing-OFF twin of child 0 for the overhead A/B, kept out of
+        # the routers' tables and the aggregator
+        port_files = [os.path.join(tmp, f"port{i}") for i in range(3)]
+        for i in range(3):
+            cenv = dict(env)
+            cenv["TFDE_TRACE"] = "on" if i < 2 else "off"
             procs.append(subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__),
-                 "--serve-replica-child", str(i), port_files[i], push],
-                env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+                 "--serve-replica-child", str(i), port_files[i],
+                 push if i < 2 else "-"],
+                env=cenv, cwd=os.path.dirname(os.path.abspath(__file__)),
                 stdout=open(os.path.join(tmp, f"child{i}.out"), "w"),
                 stderr=subprocess.STDOUT,
             ))
@@ -1735,11 +1797,22 @@ def _bench_serve_cluster(smoke: bool) -> dict:
         r1 = Router([urls[0]]).start()
         routers.append(r1)
         single, wall = run_load(r1.url, seed=1)
-        out["serve_cluster_single_tokens_per_sec"] = round(
-            tps(single, wall), 1
+        single_tps = tps(single, wall)
+        out["serve_cluster_single_tokens_per_sec"] = round(single_tps, 1)
+
+        # tracing overhead at cluster scale: the identical load against
+        # the tracing-OFF twin replica (child 2). The router side records
+        # in both runs (same parent process), so the delta isolates the
+        # replica-side ring cost on the serving path.
+        r0 = Router([urls[2]]).start()
+        routers.append(r0)
+        untraced, wall = run_load(r0.url, seed=1)
+        out["serve_cluster_trace_overhead_pct"] = round(
+            max(0.0, 1.0 - single_tps / max(tps(untraced, wall), 1e-9))
+            * 100, 2
         )
 
-        r2 = Router(urls).start()
+        r2 = Router(urls[:2]).start()
         routers.append(r2)
         pair, wall = run_load(r2.url, seed=1)
         pair_tps = tps(pair, wall)
@@ -1763,7 +1836,7 @@ def _bench_serve_cluster(smoke: bool) -> dict:
         reg.reset("router/")
         router_dir = os.path.join(tmp, "router")
         os.makedirs(router_dir, exist_ok=True)
-        rk = Router(urls, aggregator=agg, model_dir=router_dir).start()
+        rk = Router(urls[:2], aggregator=agg, model_dir=router_dir).start()
         routers.append(rk)
         killed, wall = run_load(
             rk.url, seed=2, kill_at=max(1, n_req // 3),
@@ -1787,6 +1860,44 @@ def _bench_serve_cluster(smoke: bool) -> dict:
         out["serve_cluster_kill_flight_dump"] = bool(
             _find_flight_dumps(router_dir)
         )
+        # the acceptance waterfall: find a completed request the drill
+        # re-routed and fetch its stitched trace from the router — the
+        # router's attempts (replica 0, then the reroute to 1) and the
+        # survivor's serve/* events must land in ONE trace. The dead
+        # replica's ring died with it (SIGKILL), which is exactly the
+        # post-mortem shape: attempts tell the routing story, the
+        # survivor tells the serving story.
+        stitched_ok = False
+        for r in done:
+            tid = r.get("trace")
+            if not tid:
+                continue
+            try:
+                with urllib.request.urlopen(
+                    rk.url + f"/trace/{tid}", timeout=5.0
+                ) as resp:
+                    tr = json.loads(resp.read())
+            except Exception:
+                continue
+            evs = tr.get("events", [])
+            attempts = {e.get("replica") for e in evs
+                        if e.get("name") == "router/attempt"}
+            if {0, 1} <= attempts:
+                out["serve_cluster_trace_stitched_procs"] = tr.get(
+                    "procs", []
+                )
+                out["serve_cluster_trace_events"] = len(evs)
+                stitched_ok = any(
+                    str(e.get("name", "")).startswith("serve/")
+                    for e in evs
+                )
+                break
+        out["serve_cluster_trace_rerouted_ok"] = stitched_ok
+        ex = reqtrace.exemplars("router/ttft_ms")
+        if ex:
+            out["serve_cluster_ttft_exemplar_traces"] = [
+                r["trace"] for r in ex[:3]
+            ]
         # the dead replica stops pushing; after stale_after the chief
         # scrape must report it down
         time.sleep(agg.stale_after + 0.5)
@@ -1799,6 +1910,8 @@ def _bench_serve_cluster(smoke: bool) -> dict:
         )
         return out
     finally:
+        if not trace_was_on:
+            reqtrace.disable()
         for r in routers:
             try:
                 r.close()

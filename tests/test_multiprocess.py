@@ -398,7 +398,11 @@ def test_killed_replica_drains_to_survivor(tmp_path):
     next sessions re-route to the survivor with solo-correct outputs,
     the router's flight ring dumps the `replica_down` story, and the
     chief aggregator's host-up gauge flips when the dead replica's
-    metric pushes go stale."""
+    metric pushes go stale. Tracing rides along (children spawn with
+    TFDE_TRACE=on): the re-routed request's stitched waterfall must show
+    BOTH replicas in the routing story and the survivor's serve events,
+    and the replica_down flight record must cross-reference the traces
+    stranded on the dead replica."""
     import glob
     import signal
     import time
@@ -412,6 +416,7 @@ def test_killed_replica_drains_to_survivor(tmp_path):
     from tfde_tpu.inference.router import Router, request_generate
     from tfde_tpu.models.gpt import gpt_tiny_test
     from tfde_tpu.observability import flightrec, metrics
+    from tfde_tpu.observability import trace as reqtrace
     from tfde_tpu.observability.aggregate import ClusterAggregator
     from tfde_tpu.observability.exposition import serve_metrics
 
@@ -440,11 +445,16 @@ def test_killed_replica_drains_to_survivor(tmp_path):
     push = f"http://127.0.0.1:{ms.port}/push"
 
     procs, router = [], None
+    # the parent's ring carries the router half of the stitched waterfall
+    trace_was_on = reqtrace.active()
+    if not trace_was_on:
+        reqtrace.enable()
     try:
         for i in range(2):
             env = dict(os.environ)
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("XLA_FLAGS", None)   # children run 1 device, not 8
+            env["TFDE_TRACE"] = "on"     # replicas record their rings
             env["PYTHONPATH"] = os.pathsep.join(
                 [os.path.dirname(os.path.dirname(__file__))]
                 + env.get("PYTHONPATH", "").split(os.pathsep)
@@ -493,6 +503,8 @@ def test_killed_replica_drains_to_survivor(tmp_path):
         # queued/new sessions re-route and still decode solo-correct
         out = request_generate(router.url, prompts[2], 6)
         assert out["replica"] == 1 and out["tokens"] == solo(prompts[2], 6)
+        rerouted_tid = out["trace"]
+        assert rerouted_tid, "router did not return a trace id"
         assert reg.get("router/reroutes").value >= 1
         assert reg.get("router/replicas_lost").value >= 1
         tab = {row["replica"]: row for row in router.table()}
@@ -506,8 +518,36 @@ def test_killed_replica_drains_to_survivor(tmp_path):
         files = glob.glob(os.path.join(router_dir, "debug",
                                        "flight_*.jsonl"))
         assert files, "router left no flight dump for the lost replica"
-        kinds = [e["kind"] for e in flightrec.load(sorted(files)[-1])]
+        flight = flightrec.load(sorted(files)[-1])
+        kinds = [e["kind"] for e in flight]
         assert "replica_down" in kinds
+        # the post-mortem cross-reference: the down record names the
+        # traces that were in flight on the dead replica
+        down = next(e for e in flight if e["kind"] == "replica_down")
+        assert rerouted_tid in down.get("traces", [])
+
+        # the re-routed request's stitched waterfall: ONE trace holding
+        # the router's both attempts (0, then the reroute to 1) and the
+        # survivor's serving events — the dead replica's ring died with
+        # it, which is exactly the post-mortem shape
+        body = json.loads(urllib.request.urlopen(
+            router.url + f"/trace/{rerouted_tid}", timeout=5).read())
+        evs = body["events"]
+        assert "router" in body["procs"]
+        assert "replica1" in body["procs"]
+        attempts = [e["replica"] for e in evs
+                    if e["name"] == "router/attempt"]
+        assert 0 in attempts and 1 in attempts
+        names = [e["name"] for e in evs]
+        assert "serve/queued" in names        # survivor admitted it
+        assert "serve/first_token" in names
+        assert "serve/stream_out" in names
+        assert "router/done" in names
+        # SLO layer rode the same requests: /replicas embeds the summary
+        rep_body = json.loads(urllib.request.urlopen(
+            router.url + "/replicas", timeout=5).read())
+        assert rep_body["slo"]["ttft_requests"] >= 3
+        assert rep_body["slo"]["ttft_attainment"] is not None
 
         # host-up flips once the dead replica's pushes go stale
         body = scrape()
@@ -518,6 +558,8 @@ def test_killed_replica_drains_to_survivor(tmp_path):
         assert 'tfde_cluster_host_up{host="0"} 0' in body
         assert 'tfde_cluster_host_up{host="1"} 1' in body
     finally:
+        if not trace_was_on:
+            reqtrace.disable()
         if router is not None:
             router.close()
         ms.close()

@@ -1,0 +1,264 @@
+"""Request tracing + SLO layer (observability/trace.py, slo.py): ring
+bounds and the zero-cost-off contract, cross-process stitching, exemplar
+selection, Chrome export schema, burn-rate arithmetic — and one
+in-process batcher run proving the serving path actually annotates."""
+
+import json
+import threading
+
+import pytest
+
+from tfde_tpu.observability import trace
+from tfde_tpu.observability import metrics
+from tfde_tpu.observability.slo import SLOTracker
+
+
+@pytest.fixture(autouse=True)
+def _trace_state():
+    """Tracing is process-global; every test starts off and leaves off
+    (matching the suite's TFDE_TRACE=off default) with a clean ring."""
+    was_on = trace.active()
+    trace.disable()
+    yield
+    trace.disable()
+    if was_on:  # a TFDE_TRACE=on parity sweep gets its ring back
+        trace.enable()
+
+
+# -- ring semantics + the off contract ----------------------------------------
+def test_off_by_default_records_nothing():
+    assert not trace.active()
+    trace.event("serve/queued", trace="t1", depth=3)
+    with trace.span("serve/prefill", trace="t1"):
+        pass
+    trace.note_exemplar("serving/ttft_ms", 12.0, "t1")
+    assert trace.events() == []
+    assert trace.exemplars() == {}
+    assert trace.dump("off") is None  # not armed, not active
+
+
+def test_ring_bounds_evict_oldest():
+    trace.enable(capacity=4)
+    for i in range(7):
+        trace.event("e", trace="t", i=i)
+    evs = trace.events()
+    assert len(evs) == 4
+    assert [e["i"] for e in evs] == [3, 4, 5, 6]
+
+
+def test_reenable_rerings_keeping_newest():
+    trace.enable(capacity=8)
+    for i in range(6):
+        trace.event("e", i=i)
+    trace.enable(capacity=2)  # shrink: newest two survive
+    assert [e["i"] for e in trace.events()] == [4, 5]
+
+
+def test_env_capacity_spellings(monkeypatch):
+    for spec, want in [("off", None), ("0", None), ("", None),
+                       ("on", trace.DEFAULT_CAPACITY),
+                       ("1", trace.DEFAULT_CAPACITY),
+                       ("4096", 4096)]:
+        monkeypatch.setenv("TFDE_TRACE", spec)
+        assert trace._env_capacity() == want, spec
+    monkeypatch.setenv("TFDE_TRACE", "sideways")  # warn, fail on
+    assert trace._env_capacity() == trace.DEFAULT_CAPACITY
+
+
+def test_event_filter_by_trace_and_traces():
+    trace.enable()
+    trace.event("a", trace="t1")
+    trace.event("b", trace="t2")
+    trace.event("wave", traces=["t1", "t2"], rows=2)
+    names = [e["name"] for e in trace.events("t1")]
+    assert names == ["a", "wave"]
+    assert [e["name"] for e in trace.events("t2")] == ["b", "wave"]
+    assert len(trace.events()) == 3
+
+
+def test_span_records_start_timestamp():
+    """A duration recorded at block exit is timestamped at block START —
+    the waterfall property (events sort by when they began)."""
+    trace.enable()
+    import time as _t
+    before = _t.time()
+    with trace.span("slow", trace="t"):
+        _t.sleep(0.02)
+    (ev,) = trace.events("t")
+    assert ev["dur"] >= 0.02
+    assert before <= ev["ts"] <= before + 0.01  # start, not end
+
+
+def test_bind_attaches_thread_local_trace():
+    trace.enable()
+    with trace.bind("t9"):
+        assert trace.current() == "t9"
+        trace.event("implicit")  # no explicit trace kwarg
+    assert trace.current() is None
+    assert [e["name"] for e in trace.events("t9")] == ["implicit"]
+    # other threads never see the binding
+    seen = {}
+    with trace.bind("t9"):
+        th = threading.Thread(
+            target=lambda: seen.setdefault("cur", trace.current()))
+        th.start()
+        th.join()
+    assert seen["cur"] is None
+
+
+# -- exemplars ----------------------------------------------------------------
+def test_exemplars_keep_slowest():
+    trace.enable()
+    for i in range(12):
+        trace.note_exemplar("serving/ttft_ms", float(i), f"id{i}")
+    rows = trace.exemplars("serving/ttft_ms")
+    assert len(rows) == trace.EXEMPLAR_KEEP
+    assert [r["value"] for r in rows] == [11.0, 10.0, 9.0, 8.0,
+                                          7.0, 6.0, 5.0, 4.0]
+    assert rows[0]["trace"] == "id11"  # slowest first: the p99 hunt entry
+    assert "serving/ttft_ms" in trace.exemplars()
+
+
+# -- dump / load / stitch -----------------------------------------------------
+def test_dump_load_roundtrip(tmp_path):
+    trace.enable()
+    trace.arm(str(tmp_path))
+    trace.event("serve/queued", trace="t1", depth=1)
+    trace.event("serve/done", trace="t1", tokens=4)
+    path = trace.dump("test")
+    assert path is not None and path.endswith(".jsonl")
+    with open(path, "a") as f:
+        f.write("{truncated crash li")  # load() must tolerate this
+    evs = trace.load(path)
+    assert [e["name"] for e in evs] == ["serve/queued", "serve/done"]
+    assert evs[1]["tokens"] == 4
+
+
+def test_stitch_dedupes_and_orders_across_procs():
+    router = [{"ts": 2.0, "name": "router/done", "proc": "router"},
+              {"ts": 0.0, "name": "router/request", "proc": "router"}]
+    replica = [{"ts": 1.0, "name": "serve/queued", "proc": "replica0"},
+               # the router's local ring seen AGAIN over HTTP (in-process
+               # dev / single-host): must collapse to one copy
+               {"ts": 0.0, "name": "router/request", "proc": "router"}]
+    out = trace.stitch([router, replica])
+    assert [e["name"] for e in out] == [
+        "router/request", "serve/queued", "router/done"]
+
+
+# -- Chrome trace-event export ------------------------------------------------
+def test_to_chrome_schema():
+    evs = [
+        {"ts": 10.0, "dur": 0.5, "name": "serve/prefill_cold",
+         "proc": "replica0", "pid": 123, "trace": "t1", "rows": 2},
+        {"ts": 10.2, "name": "serve/first_token", "proc": "replica0",
+         "pid": 123, "trace": "t1"},
+        {"ts": 9.9, "name": "router/request", "proc": "router",
+         "pid": 7, "trace": "t1"},
+    ]
+    doc = trace.to_chrome(evs)
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    body = json.loads(json.dumps(doc))  # must be pure-JSON serializable
+    metas = [e for e in body["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in metas} == {"router", "replica0"}
+    slices = [e for e in body["traceEvents"] if e["ph"] == "X"]
+    (sl,) = slices
+    assert sl["dur"] == pytest.approx(0.5e6)      # us
+    assert sl["ts"] == pytest.approx(10.0 * 1e6)  # epoch us
+    assert sl["args"]["rows"] == 2 and sl["args"]["trace"] == "t1"
+    instants = [e for e in body["traceEvents"] if e["ph"] == "i"]
+    assert len(instants) == 2 and all(e["s"] == "p" for e in instants)
+    # one pid row per process, shared by its events
+    assert sl["pid"] == next(m["pid"] for m in metas
+                             if m["args"]["name"] == "replica0")
+
+
+# -- SLO tracker --------------------------------------------------------------
+def test_slo_attainment_and_burn_rate_arithmetic():
+    t = {"now": 1000.0}
+    reg = metrics.Registry()
+    s = SLOTracker(ttft_target_ms=100.0, tpot_target_ms=50.0,
+                   objective=0.99, windows=(60.0,), registry=reg,
+                   clock=lambda: t["now"])
+    assert s.attainment("ttft") is None        # no samples yet
+    assert s.burn_rate("ttft", 60.0) is None
+    s.record(ttft_ms=80.0, tpot_ms=40.0)       # both ok
+    s.record(ttft_ms=150.0)                    # ttft miss, no tpot sample
+    assert s.attainment("ttft") == pytest.approx(0.5)
+    assert s.attainment("tpot") == pytest.approx(1.0)
+    # burn = (1 - 0.5) / (1 - 0.99) = 50x budget
+    assert s.burn_rate("ttft", 60.0) == pytest.approx(50.0)
+    assert s.burn_rate("tpot", 60.0) == pytest.approx(0.0)
+    # the miss ages out of the window; lifetime attainment keeps it
+    t["now"] += 120.0
+    s.record(ttft_ms=10.0)
+    assert s.attainment("ttft", window=60.0) == pytest.approx(1.0)
+    assert s.attainment("ttft") == pytest.approx(2.0 / 3.0)
+    assert s.burn_rate("ttft", 60.0) == pytest.approx(0.0)
+
+
+def test_slo_summary_and_gauges():
+    reg = metrics.Registry()
+    s = SLOTracker(ttft_target_ms=100.0, tpot_target_ms=50.0,
+                   objective=0.9, windows=(300.0, 3600.0), registry=reg)
+    s.record(ttft_ms=500.0, tpot_ms=10.0)
+    out = s.summary()
+    assert out["objective"] == pytest.approx(0.9)
+    assert out["ttft_requests"] == 1 and out["tpot_requests"] == 1
+    assert out["ttft_attainment"] == pytest.approx(0.0)
+    assert out["ttft_burn_rate"]["300s"] == pytest.approx(10.0)
+    assert out["windows_s"] == [300.0, 3600.0]
+    json.dumps(out)  # the /replicas embed must be JSON-clean
+    snap = reg.snapshot()
+    assert snap["slo/ttft_attainment"]["value"] == pytest.approx(0.0)
+    assert snap["slo/ttft_burn_rate_300s"]["value"] == pytest.approx(10.0)
+    assert snap["slo/objective"]["value"] == pytest.approx(0.9)
+
+
+def test_slo_objective_clamped_off_the_pole():
+    s = SLOTracker(objective=1.0, registry=metrics.Registry())
+    assert s.objective <= 0.9999
+    s.record(ttft_ms=1e9)
+    assert s.burn_rate("ttft", s.windows[0]) is not None  # no div-by-zero
+
+
+# -- the serving path annotates -----------------------------------------------
+def test_batcher_emits_request_waterfall():
+    """An in-process ContinuousBatcher run with trace ids: the ring must
+    tell the request's whole story — queue, prefill wave, first token,
+    decode rounds, done — and feed the TTFT exemplar store."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfde_tpu.inference.server import ContinuousBatcher
+    from tfde_tpu.models.gpt import gpt_tiny_test
+
+    model = gpt_tiny_test()
+    params = model.init(jax.random.key(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    trace.enable()
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48)
+    rng = np.random.default_rng(0)
+    tid = trace.new_id()
+    srv.submit(rng.integers(0, 97, 4), 6, trace=tid)
+    srv.submit(rng.integers(0, 97, 3), 4)  # untraced neighbour: no events
+    done = srv.run()
+    assert len(done) == 2
+    names = [e["name"] for e in trace.events(tid)]
+    assert names[0] == "serve/queued"
+    assert any(n.startswith("serve/prefill_") for n in names)
+    assert "serve/first_token" in names
+    assert "serve/decode_round" in names
+    # done lands during the last round's token replay; that round's own
+    # decode_round event (recorded at round exit) may trail it
+    assert "serve/done" in names
+    assert names.index("serve/done") > names.index("serve/first_token")
+    # the untraced neighbour must not have minted its own id: every
+    # request-tagged ring event points at the one traced request
+    # (untagged phase spans — e.g. serving/prefill — are fine)
+    for e in trace.events():
+        assert e.get("trace") in (None, tid)
+        assert set(e.get("traces", ())) <= {tid}
+    ex = trace.exemplars("serving/ttft_ms")
+    assert [r["trace"] for r in ex] == [tid]

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tfde_tpu.observability import metrics
+from tfde_tpu.observability import trace as _trace
 
 DEFAULT_BYTE_BUDGET = 256 * 1024 * 1024
 DEFAULT_BLOCK = 16
@@ -142,14 +143,16 @@ class PrefixCache:
             "evictions": self._evictions,
         }
 
-    def lookup(self, tokens):
+    def lookup(self, tokens, trace: Optional[str] = None):
         """Longest cached prefix usable for prompt `tokens`.
 
         Returns ``(L, kv)``: L tokens of prefix (a block multiple,
         clamped so at least one suffix token remains to prefill — the
         first-token logits must come from a real forward) and
         ``kv`` = {leaf-name: [L, ...] device array}, or ``(0, None)``
-        on a miss. Touches the matched path for LRU."""
+        on a miss. Touches the matched path for LRU. `trace`: request
+        trace id — the hit/miss + reused-token outcome lands on that
+        request's distributed-trace timeline."""
         tokens = np.asarray(tokens).reshape(-1)
         p = int(tokens.size)
         self._op += 1
@@ -168,6 +171,9 @@ class PrefixCache:
         if not segs:
             self._misses += 1
             self._publish()
+            if trace is not None:
+                _trace.event("serve/prefix_lookup", trace=trace,
+                             hit=False, reused_tokens=0)
             return 0, None
         for s in segs:
             self._clock += 1
@@ -183,6 +189,10 @@ class PrefixCache:
         self._reused_tokens += n * self._block
         self._bytes_saved += sum(s.nbytes for s in segs)
         self._publish()
+        if trace is not None:
+            _trace.event("serve/prefix_lookup", trace=trace, hit=True,
+                         reused_tokens=n * self._block,
+                         prompt_tokens=p)
         return n * self._block, kv
 
     def insert(self, tokens, row_cache, row: int) -> int:

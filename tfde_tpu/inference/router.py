@@ -48,6 +48,8 @@ from typing import Optional
 import numpy as np
 
 from tfde_tpu.observability import flightrec, metrics
+from tfde_tpu.observability import trace as _trace
+from tfde_tpu.observability.slo import SLOTracker
 
 log = logging.getLogger(__name__)
 
@@ -117,10 +119,13 @@ def sse_events(fp):
             yield json.loads(line[6:])
 
 
-def _post_json(url: str, payload: dict, timeout: float):
+def _post_json(url: str, payload: dict, timeout: float, headers=None):
+    hdrs = {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
     req = urllib.request.Request(
-        url, data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"}, method="POST",
+        url, data=json.dumps(payload).encode(), headers=hdrs,
+        method="POST",
     )
     return urllib.request.urlopen(req, timeout=timeout)
 
@@ -150,6 +155,10 @@ class ReplicaServer:
         self._stop = threading.Event()
         if model_dir is not None:
             flightrec.arm(model_dir)
+            _trace.arm(model_dir)
+        # label this process's trace events (a lone replica per process
+        # in the cluster deployment — the stitched waterfall's row name)
+        _trace.set_process(f"replica{self.replica_id}")
         srv = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -167,6 +176,14 @@ class ReplicaServer:
                     self.wfile.write(body)
                 elif self.path == "/load":
                     srv._send_json(self, 200, srv.load())
+                elif self.path.startswith("/trace/"):
+                    # this process's ring slice for one trace id — the
+                    # chief collector stitches these across replicas
+                    tid = self.path[len("/trace/"):]
+                    srv._send_json(self, 200, {
+                        "proc": _trace.process(), "trace": tid,
+                        "events": _trace.events(tid),
+                    })
                 else:
                     self.send_error(404)
 
@@ -221,6 +238,7 @@ class ReplicaServer:
         self._httpd.server_close()
         if self._pusher is not None:
             self._pusher.close()
+        _trace.dump("replica_close")
 
     def load(self) -> dict:
         b = self.batcher
@@ -243,35 +261,45 @@ class ReplicaServer:
                 time.sleep(self._poll)
 
     @staticmethod
-    def _send_json(handler, code: int, obj: dict) -> None:
+    def _send_json(handler, code: int, obj: dict, headers=None) -> None:
         body = json.dumps(obj).encode()
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
         handler.end_headers()
         handler.wfile.write(body)
 
     def _handle_prime(self, handler, body: dict) -> None:
+        tid = handler.headers.get(_trace.HEADER)
         with self.lock:
             primed = self.batcher.prime(
-                body["prompt"], int(body["max_new_tokens"])
+                body["prompt"], int(body["max_new_tokens"]), trace=tid
             )
         self._send_json(handler, 200, primed_to_json(primed))
 
     def _handle_generate(self, handler, body: dict, primed: bool) -> None:
+        tid = handler.headers.get(_trace.HEADER)
+        t_req = time.perf_counter()
         with self.lock:
             if primed:
-                rid = self.batcher.submit_primed(primed_from_json(body))
+                rid = self.batcher.submit_primed(primed_from_json(body),
+                                                 trace=tid)
             else:
                 rid = self.batcher.submit(
-                    body["prompt"], int(body["max_new_tokens"])
+                    body["prompt"], int(body["max_new_tokens"]), trace=tid
                 )
         try:
             handler.send_response(200)
             handler.send_header("Content-Type", "text/event-stream")
+            if tid:
+                handler.send_header(_trace.HEADER, tid)
             handler.end_headers()
-            _sse_write(handler.wfile,
-                       {"rid": rid, "replica": self.replica_id})
+            first = {"rid": rid, "replica": self.replica_id}
+            if tid:
+                first["trace"] = tid
+            _sse_write(handler.wfile, first)
             sent = 0
             while True:
                 with self.lock:
@@ -281,6 +309,12 @@ class ReplicaServer:
                     sent += 1
                 if done:
                     _sse_write(handler.wfile, {"done": True, "n": sent})
+                    if tid is not None and _trace.active():
+                        # the replica-side bracket: submit -> last SSE
+                        # byte flushed (decode AND relay)
+                        _trace.event("serve/stream_out", trace=tid,
+                                     rid=rid, tokens=sent,
+                                     dur=time.perf_counter() - t_req)
                     return
                 time.sleep(self._poll)
         except (BrokenPipeError, ConnectionResetError):
@@ -316,13 +350,27 @@ class Router:
     aggregator: a `ClusterAggregator` receiving replica pushes — adds
     push-staleness (host-up flip) as a down signal on top of the
     router's own connection-failure detection.
+    slo: an `SLOTracker` (one is built from the TFDE_SLO_* environment
+    when omitted) fed the CLIENT-observed TTFT/TPOT of every routed
+    session — queueing, placement, re-routes and the primed hand-off
+    included; its gauges ride /metrics and its summary the /replicas
+    table.
+
+    Every /v1/generate session gets a trace id (X-Tfde-Trace — the
+    incoming header is honored so callers can bring their own),
+    propagated to the replicas and returned to the client in the
+    response header, the SSE `meta` event, and the final payload. The
+    id is cheap to mint; actual event RECORDING stays off unless the
+    trace ring is enabled (TFDE_TRACE). GET /trace/<id> answers the
+    stitched cross-process waterfall.
     """
 
     def __init__(self, replicas, prefill_replicas=(), port: int = 0,
                  host: str = "127.0.0.1", aggregator=None,
                  model_dir: Optional[str] = None,
                  prefill_min_tokens: int = 0,
-                 request_timeout: float = 120.0):
+                 request_timeout: float = 120.0,
+                 slo: Optional[SLOTracker] = None):
         if not replicas:
             raise ValueError("need at least one replica URL")
         self._reps = [_Replica(u, i) for i, u in enumerate(replicas)]
@@ -332,8 +380,15 @@ class Router:
         self._timeout = float(request_timeout)
         self._lock = threading.Lock()
         self._reg = metrics.default_registry()
+        self._slo = slo if slo is not None else SLOTracker()
+        # trace id -> replica idx currently relaying it; read by
+        # _mark_down so a replica_down flight breadcrumb names the
+        # in-flight traces it stranded
+        self._inflight: dict = {}
         if model_dir is not None:
             flightrec.arm(model_dir)
+            _trace.arm(model_dir)
+        _trace.set_process("router")
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -350,8 +405,15 @@ class Router:
                     self.end_headers()
                     self.wfile.write(body)
                 elif self.path == "/replicas":
+                    ReplicaServer._send_json(
+                        self, 200,
+                        {"replicas": router.table(),
+                         "slo": router.slo.summary()},
+                    )
+                elif self.path.startswith("/trace/"):
+                    tid = self.path[len("/trace/"):]
                     ReplicaServer._send_json(self, 200,
-                                             {"replicas": router.table()})
+                                             router.trace(tid))
                 else:
                     self.send_error(404)
 
@@ -408,6 +470,21 @@ class Router:
     def close(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        _trace.dump("router_close")
+
+    @property
+    def slo(self) -> SLOTracker:
+        return self._slo
+
+    def trace(self, trace_id: str) -> dict:
+        """Stitch one request's waterfall across this router and every
+        replica (live ones answer /trace/<id>; dead ones contribute
+        nothing) — the chief-side collector entry point."""
+        from tfde_tpu.observability.aggregate import collect_trace
+
+        urls = [r.url for r in self._reps] + [r.url for r in self._pre]
+        return collect_trace(trace_id, urls,
+                             local_events=_trace.events(trace_id))
 
     # -- placement ----------------------------------------------------------
     def _refresh_liveness(self) -> None:
@@ -446,6 +523,11 @@ class Router:
             if not rep.up:
                 return
             rep.up = False
+            # the traces this death strands — the flight dump's
+            # cross-reference into the request-trace timeline
+            stranded = sorted(
+                t for t, idx in self._inflight.items() if idx == rep.idx
+            )
         log.warning("replica %d (%s) down: %s", rep.idx, rep.url, reason)
         self._reg.counter("router/replicas_lost").incr()
         self._reg.gauge(f"router/replica{rep.idx}/up").set(0)
@@ -454,7 +536,8 @@ class Router:
         note_replica_down(rep.idx, reason)
         # the dead replica can't dump its own flight ring (SIGKILL);
         # the router's ring carries the routing-side story for it
-        flightrec.record("replica_down", replica=rep.idx, reason=reason)
+        flightrec.record("replica_down", replica=rep.idx, reason=reason,
+                         traces=stranded)
         flightrec.dump("replica_down")
 
     def drain(self, idx: int, tier: str = "decode") -> bool:
@@ -502,7 +585,7 @@ class Router:
             g(f"router/replica{rep.idx}/served").set(rep.served)
 
     # -- request path --------------------------------------------------------
-    def _maybe_prime(self, body: dict):
+    def _maybe_prime(self, body: dict, tid: Optional[str] = None):
         """Run the prefill on the prefill tier when configured; returns
         the primed JSON payload or None (fall back to a plain submit)."""
         if not self._pre or len(body["prompt"]) < self._pmin:
@@ -516,13 +599,21 @@ class Router:
             try:
                 self._account(rep, outstanding=len(body["prompt"]))
                 try:
+                    t0 = time.perf_counter()
                     with _post_json(
                         rep.url + "/prime",
                         {"prompt": body["prompt"],
                          "max_new_tokens": body["max_new_tokens"]},
                         self._timeout,
+                        headers={_trace.HEADER: tid} if tid else None,
                     ) as resp:
                         out = json.loads(resp.read())
+                    if _trace.active() and tid is not None:
+                        # the router-observed prime round trip: the
+                        # prefill replica's own serve/prime nests inside
+                        _trace.event("router/prime", trace=tid,
+                                     prefill_replica=rep.idx,
+                                     dur=time.perf_counter() - t0)
                 finally:
                     self._account(rep, outstanding=-len(body["prompt"]))
                 self._account(rep, served=1)
@@ -545,8 +636,16 @@ class Router:
             )
             return
         stream = bool(body.get("stream", False))
+        # every session has a trace id (honor the caller's, else mint):
+        # propagation + echo-back are unconditional and cheap; span
+        # RECORDING stays behind the TFDE_TRACE ring flag
+        tid = handler.headers.get(_trace.HEADER) or _trace.new_id()
+        t_req = time.perf_counter()
         self._reg.counter("router/requests").incr()
-        primed_payload = self._maybe_prime(body)
+        if _trace.active():
+            _trace.event("router/request", trace=tid,
+                         prompt_tokens=len(prompt), budget=budget)
+        primed_payload = self._maybe_prime(body, tid)
         headers_sent = False
         exclude: list = []
         while True:
@@ -559,34 +658,51 @@ class Router:
                                 "retriable": True})
                 else:
                     ReplicaServer._send_json(
-                        handler, 503, {"error": "no live replicas"}
+                        handler, 503, {"error": "no live replicas"},
+                        headers={_trace.HEADER: tid},
                     )
                 return
             if exclude:
                 self._reg.counter("router/reroutes").incr()
+            if _trace.active():
+                # one event per placement attempt: a re-routed request's
+                # waterfall shows the dead replica AND the survivor
+                _trace.event("router/attempt", trace=tid, replica=rep.idx,
+                             rerouted=bool(exclude),
+                             primed=primed_payload is not None)
             self._account(rep, outstanding=budget)
+            with self._lock:
+                self._inflight[tid] = rep.idx
             tokens: list = []
             relayed = 0
+            t_first = None
             finished = False
             try:
                 if primed_payload is not None:
                     req = _post_json(rep.url + "/generate_primed",
-                                     primed_payload, self._timeout)
+                                     primed_payload, self._timeout,
+                                     headers={_trace.HEADER: tid})
                 else:
                     req = _post_json(
                         rep.url + "/generate",
                         {"prompt": prompt, "max_new_tokens": budget},
                         self._timeout,
+                        headers={_trace.HEADER: tid},
                     )
                 with req as resp:
                     if stream and not headers_sent:
                         handler.send_response(200)
                         handler.send_header("Content-Type",
                                             "text/event-stream")
+                        handler.send_header(_trace.HEADER, tid)
                         handler.end_headers()
                         headers_sent = True
+                        _sse_write(handler.wfile,
+                                   {"meta": {"trace": tid}})
                     for ev in sse_events(resp):
                         if "token" in ev:
+                            if t_first is None:
+                                t_first = time.perf_counter()
                             tokens.append(ev["token"])
                             if stream:
                                 _sse_write(handler.wfile,
@@ -611,7 +727,8 @@ class Router:
                                {"error": detail, "retriable": False})
                 else:
                     ReplicaServer._send_json(handler, e.code,
-                                             {"error": detail})
+                                             {"error": detail},
+                                             headers={_trace.HEADER: tid})
                 return
             except _DEAD as e:
                 self._mark_down(rep, str(e))
@@ -625,18 +742,36 @@ class Router:
                     return
                 continue   # nothing delivered yet: transparent re-route
             finally:
+                with self._lock:
+                    self._inflight.pop(tid, None)
                 self._account(rep, outstanding=-budget)
                 self._publish()
             self._account(rep, served=1)
             self._publish()
+            # client-observed SLO accounting: TTFT spans queueing,
+            # placement, any re-routes and the primed hand-off; TPOT is
+            # the steady-state inter-token rate after the first
+            t_done = time.perf_counter()
+            n = len(tokens)
+            if t_first is not None:
+                ttft_ms = (t_first - t_req) * 1e3
+                tpot_ms = ((t_done - t_first) * 1e3 / (n - 1)
+                           if n > 1 else None)
+                self._slo.record(ttft_ms=ttft_ms, tpot_ms=tpot_ms)
+                _trace.note_exemplar("router/ttft_ms", ttft_ms, tid)
+            if _trace.active():
+                _trace.event("router/done", trace=tid, replica=rep.idx,
+                             tokens=n, rerouted=bool(exclude),
+                             dur=t_done - t_req)
             if stream:
                 _sse_write(handler.wfile,
                            {"done": True, "tokens": tokens,
-                            "replica": rep.idx})
+                            "replica": rep.idx, "trace": tid})
             else:
                 ReplicaServer._send_json(
                     handler, 200,
-                    {"tokens": tokens, "replica": rep.idx},
+                    {"tokens": tokens, "replica": rep.idx, "trace": tid},
+                    headers={_trace.HEADER: tid},
                 )
             return
 
@@ -646,7 +781,8 @@ def request_generate(router_url: str, prompt, max_new_tokens: int,
                      stream: bool = False, timeout: float = 120.0) -> dict:
     """POST one generation to a Router (or directly to a ReplicaServer's
     /generate). Returns {"tokens": [...], "replica": idx|None,
-    "ttft_s": seconds-to-first-token, "events": n}. Raises the
+    "ttft_s": seconds-to-first-token, "events": n, "trace": id|None —
+    the session's X-Tfde-Trace id for /trace/<id> lookups}. Raises the
     underlying urllib error on transport failure and RuntimeError on an
     in-stream retriable error."""
     url = router_url.rstrip("/")
@@ -657,18 +793,23 @@ def request_generate(router_url: str, prompt, max_new_tokens: int,
     tokens: list = []
     ttft = None
     replica = None
+    trace_id = None
     n_events = 0
     with _post_json(url + path, payload, timeout) as resp:
+        trace_id = resp.headers.get(_trace.HEADER)
         for ev in sse_events(resp):
             n_events += 1
             if "token" in ev:
                 if ttft is None:
                     ttft = time.perf_counter() - t0
                 tokens.append(ev["token"])
+            elif "meta" in ev:
+                trace_id = ev["meta"].get("trace", trace_id)
             elif "error" in ev:
                 raise RuntimeError(ev["error"])
             elif ev.get("done"):
                 replica = ev.get("replica")
+                trace_id = ev.get("trace", trace_id)
                 break
     return {"tokens": tokens, "replica": replica, "ttft_s": ttft,
-            "events": n_events}
+            "events": n_events, "trace": trace_id}

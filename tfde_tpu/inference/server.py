@@ -82,6 +82,7 @@ from tfde_tpu.inference.prefix_cache import (
 )
 from tfde_tpu.inference.speculative import _set_index_counters
 from tfde_tpu.observability import metrics
+from tfde_tpu.observability import trace as _trace
 from tfde_tpu.observability.spans import span
 
 
@@ -426,6 +427,11 @@ class _BatcherBase:
         # `primed` set only for submit_primed() entries (K/V in hand)
         self._queue: collections.deque = collections.deque()
         self._submitted_at: dict = {}   # rid -> submit wall time (TTFT)
+        self._first_at: dict = {}       # rid -> first-token time (TPOT)
+        # rid -> request trace id; populated ONLY while the trace ring is
+        # active AND the submitter handed one over, so the off path pays
+        # an empty-dict truthiness check and nothing else
+        self._trace_ids: dict = {}
         self._next_id = 0
         self._rounds = 0         # decode ticks run
         self._generated = 0      # every delivered token (incl. prefill 1st)
@@ -464,18 +470,22 @@ class _BatcherBase:
         )
         return active + sum(int(b) for _rid, _p, b, _pr in self._queue)
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
-        """Queue a request; returns its id. prompt: 1-D int token ids."""
+    def submit(self, prompt, max_new_tokens: int,
+               trace: Optional[str] = None) -> int:
+        """Queue a request; returns its id. prompt: 1-D int token ids.
+        `trace`: the request's distributed-trace id (X-Tfde-Trace),
+        recorded on every span event the request generates."""
         if self._role == "prefill":
             raise RuntimeError(
                 "prefill-only replica: use prime() and hand the result to "
                 "a decode replica's submit_primed()"
             )
         prompt = self._check_request(prompt, max_new_tokens)
-        rid = self._enqueue(prompt, int(max_new_tokens), None)
+        rid = self._enqueue(prompt, int(max_new_tokens), None, trace)
         return rid
 
-    def submit_primed(self, primed: PrimedRequest) -> int:
+    def submit_primed(self, primed: PrimedRequest,
+                      trace: Optional[str] = None) -> int:
         """Queue a request whose prefill already ran on a prefill-role
         replica (`prime()`); only the K/V scatter and decode happen
         here. Returns the local request id."""
@@ -486,7 +496,8 @@ class _BatcherBase:
         if self._role == "prefill":
             raise RuntimeError("prefill-only replica cannot decode")
         prompt = self._check_request(primed.prompt, primed.max_new_tokens)
-        return self._enqueue(prompt, int(primed.max_new_tokens), primed)
+        return self._enqueue(prompt, int(primed.max_new_tokens), primed,
+                             trace)
 
     def enable_progress(self) -> None:
         """Track per-request incremental tokens for `take_progress` (the
@@ -519,6 +530,10 @@ class _BatcherBase:
         discarded. Returns whether the request was found in flight."""
         self._stream.pop(rid, None)
         self._submitted_at.pop(rid, None)
+        self._first_at.pop(rid, None)
+        tid = self._trace_ids.pop(rid, None)
+        if tid is not None:
+            _trace.event("serve/cancelled", trace=tid, rid=rid)
         for i, item in enumerate(self._queue):
             if item[0] == rid:
                 del self._queue[i]
@@ -554,13 +569,20 @@ class _BatcherBase:
         self._validate_submit(prompt, max_new_tokens)
         return prompt
 
-    def _enqueue(self, prompt: np.ndarray, budget: int, primed) -> int:
+    def _enqueue(self, prompt: np.ndarray, budget: int, primed,
+                 trace: Optional[str] = None) -> int:
         rid = self._next_id
         self._next_id += 1
         self._queue.append((rid, prompt, budget, primed))
         self._submitted_at[rid] = time.perf_counter()
         if self._track_progress:
             self._stream[rid] = {"tokens": [], "taken": 0, "done": False}
+        if trace is not None and _trace.active():
+            self._trace_ids[rid] = trace
+            _trace.event("serve/queued", trace=trace, rid=rid,
+                         prompt_tokens=int(prompt.size), budget=int(budget),
+                         primed=primed is not None,
+                         queue_depth=len(self._queue))
         return rid
 
     def serve_metrics(self, port: int = 0, aggregator=None):
@@ -611,7 +633,24 @@ class _BatcherBase:
         if self._budget[r] <= 0 or (self._eos is not None and t == self._eos):
             if ent is not None:
                 ent["done"] = True
-            done = (self._req[r], np.asarray(self._out[r], np.int32))
+            rid = self._req[r]
+            n = len(self._out[r])
+            t1 = self._first_at.pop(rid, None)
+            if t1 is not None and n > 1:
+                # decode-side TPOT: first token -> last token, per decode
+                # step (the SLO layer's second latency axis)
+                tpot_ms = (time.perf_counter() - t1) * 1e3 / (n - 1)
+                metrics.default_registry().histogram(
+                    "serving/tpot_ms").observe(tpot_ms)
+                tid = self._trace_ids.get(rid)
+                if tid is not None:
+                    _trace.note_exemplar("serving/tpot_ms", tpot_ms, tid)
+            tid = self._trace_ids.pop(rid, None)
+            if tid is not None:
+                _trace.event("serve/done", trace=tid, rid=rid, tokens=n,
+                             eos=bool(self._eos is not None
+                                      and t == self._eos))
+            done = (rid, np.asarray(self._out[r], np.int32))
             self._req[r] = None
             self._out[r] = []
             self._committed[r] = 0
@@ -691,6 +730,7 @@ class _BatcherBase:
                 rows = free[taken:taken + n]
                 taken += n
                 t_wave = time.perf_counter()
+                wall_wave = time.time()
                 with span("serving/prefill"):
                     toks = self._admit_group(kind, key, group, rows)
                 # admission waves in the flight ring: one event per wave
@@ -704,6 +744,17 @@ class _BatcherBase:
                     queue_depth=len(self._queue),
                 )
                 now = time.perf_counter()
+                if self._trace_ids:
+                    tids = [self._trace_ids.get(it[0]) for it in group]
+                    if any(tids):
+                        # one wave slice tagged with EVERY member trace:
+                        # the waterfall shows who shared the prefill
+                        _trace.event(
+                            f"serve/prefill_{kind}", traces=tids,
+                            ts=wall_wave, dur=now - t_wave, rows=n,
+                            key=list(key) if isinstance(key, tuple)
+                            else int(key),
+                        )
                 for i, (rid, prompt, budget, _pr, _x) in enumerate(group):
                     r = rows[i]
                     self._req[r] = rid
@@ -711,16 +762,26 @@ class _BatcherBase:
                     self._budget[r] = budget
                     self._committed[r] = prompt.size
                     t0 = self._submitted_at.pop(rid, None)
+                    self._first_at[rid] = now
                     if t0 is not None:
                         # the TTFT decomposition the bench reports:
                         # queue_wait (submit -> wave start) + prefill
                         # (the serving/prefill span) = first token
+                        queue_ms = (t_wave - t0) * 1e3
+                        ttft_ms = (now - t0) * 1e3
                         reg.histogram("serving/queue_wait_ms").observe(
-                            (t_wave - t0) * 1e3
+                            queue_ms
                         )
-                        reg.histogram("serving/ttft_ms").observe(
-                            (now - t0) * 1e3
-                        )
+                        reg.histogram("serving/ttft_ms").observe(ttft_ms)
+                        tid = self._trace_ids.get(rid)
+                        if tid is not None:
+                            _trace.event(
+                                "serve/first_token", trace=tid, rid=rid,
+                                kind=kind, ttft_ms=round(ttft_ms, 3),
+                                queue_wait_ms=round(queue_ms, 3),
+                            )
+                            _trace.note_exemplar("serving/ttft_ms",
+                                                 ttft_ms, tid)
                     finished.extend(self._take_token(r, int(toks[i])))
             self._mark_dirty()
         return finished
@@ -898,6 +959,11 @@ class ContinuousBatcher(_BatcherBase):
             toks_np, emitted_np = _fetch((toks, emitted))
             self._syncs += 1
         self._rounds += depth
+        traced = (
+            [self._trace_ids[rid] for r in active
+             if (rid := self._req[r]) in self._trace_ids]
+            if self._trace_ids else []
+        )
         n_emitted = 0
         for r in active:
             row = toks_np[r][emitted_np[r]]
@@ -909,10 +975,14 @@ class ContinuousBatcher(_BatcherBase):
             self._committed[r] += int(row.size)
             for t in row:
                 finished.extend(self._take_token(r, int(t)))
+        dt = time.perf_counter() - t0
+        if traced:
+            _trace.event("serve/decode_round", traces=traced, dur=dt,
+                         depth=depth, rows=len(active), emitted=n_emitted)
         if n_emitted:
             metrics.default_registry().histogram(
                 "serving/ms_per_token"
-            ).observe((time.perf_counter() - t0) * 1e3 / n_emitted)
+            ).observe(dt * 1e3 / n_emitted)
         self._publish_stats()
         return finished
 
@@ -1034,7 +1104,9 @@ class ContinuousBatcher(_BatcherBase):
                     (rid, prompt, budget, pr, None)
                 )
                 continue
-            pre_len, kv = self._prefix.lookup(prompt)
+            pre_len, kv = self._prefix.lookup(
+                prompt, trace=self._trace_ids.get(rid)
+            )
             # the suffix feeds at cache position pre_len, so its bucket
             # must ALSO fit the row: pre_len + sbucket <= max_len, or the
             # transformer's clamped dynamic_update_slice would silently
@@ -1138,7 +1210,8 @@ class ContinuousBatcher(_BatcherBase):
         return tok_np
 
     # -- prefill/decode role split -------------------------------------------
-    def prime(self, prompt, max_new_tokens: int) -> PrimedRequest:
+    def prime(self, prompt, max_new_tokens: int,
+              trace: Optional[str] = None) -> PrimedRequest:
         """Run ONLY the prefill for one request and return the hand-off
         payload (host K/V + pending first token) for a decode replica's
         `submit_primed()` — the prefill half of the role split. Touches
@@ -1146,6 +1219,7 @@ class ContinuousBatcher(_BatcherBase):
         long-prompt admissions without ever stalling a decode scan."""
         if self._role == "decode":
             raise RuntimeError("decode-only replica cannot prime")
+        t_prime = time.perf_counter()
         prompt = self._check_request(prompt, max_new_tokens)
         bucket = next(b for b in self._buckets if b >= prompt.size)
         prompts = np.full((1, bucket), self._pad, np.int32)
@@ -1172,6 +1246,12 @@ class ContinuousBatcher(_BatcherBase):
             kv[leaf_name(path)] = leaf[0, :prompt.size]
         kv_np, tok_np = _fetch((kv, tok))
         self._syncs += 1
+        if trace is not None and _trace.active():
+            # the prefill half of the primed hand-off: the decode
+            # replica's serve/queued(primed=True) is the other half
+            _trace.event("serve/prime", trace=trace,
+                         dur=time.perf_counter() - t_prime,
+                         prompt_tokens=int(prompt.size))
         return PrimedRequest(
             prompt=prompt.astype(np.int32),
             first_token=int(tok_np[0]),
@@ -1387,6 +1467,11 @@ class SpeculativeContinuousBatcher(_BatcherBase):
             self._dispatches += 1
             round_np, n_np = _fetch((round_toks, n_new))
             self._syncs += 1
+        traced = (
+            [self._trace_ids[rid] for r in active
+             if (rid := self._req[r]) in self._trace_ids]
+            if self._trace_ids else []
+        )
         n_emitted = 0
         for r in active:
             toks = round_np[r, : int(n_np[r])].tolist()
@@ -1409,9 +1494,14 @@ class SpeculativeContinuousBatcher(_BatcherBase):
                 # both caches (the pending one stays unfed) — the
                 # generate_speculative commit bookkeeping
                 self._committed[r] += taken
+        dt = time.perf_counter() - t0
+        if traced:
+            _trace.event("serve/decode_round", traces=traced, dur=dt,
+                         depth=self._nd, rows=len(active),
+                         emitted=n_emitted)
         if n_emitted:
             metrics.default_registry().histogram(
                 "serving/ms_per_token"
-            ).observe((time.perf_counter() - t0) * 1e3 / n_emitted)
+            ).observe(dt * 1e3 / n_emitted)
         self._publish_stats()
         return finished

@@ -7,6 +7,10 @@
 - tensorboard.py event-file SummaryWriter
 - profiler.py    jax.profiler trace windows
 - counters.py    legacy counter API (shim over metrics.py)
+- trace.py       per-request distributed tracing ring (TFDE_TRACE)
+- slo.py         TTFT/TPOT SLO attainment + burn-rate gauges
+- flightrec.py   crash-dump flight recorder ring
+- aggregate.py   cross-host metric aggregation + trace stitching
 """
 
 from tfde_tpu.observability.tensorboard import SummaryWriter  # noqa: F401
@@ -22,3 +26,5 @@ from tfde_tpu.observability.exposition import (  # noqa: F401
     serve_metrics,
     to_prometheus_text,
 )
+from tfde_tpu.observability import trace  # noqa: F401
+from tfde_tpu.observability.slo import SLOTracker  # noqa: F401

@@ -324,3 +324,36 @@ class ClusterAggregator:
                 }
                 for hid, h in self._hosts.items()
             }
+
+
+# -- distributed request traces ----------------------------------------------
+def fetch_trace(url: str, trace_id: str, timeout: float = 5.0) -> list:
+    """GET one replica's /trace/<id> slice; [] on any transport failure
+    (a SIGKILL'd replica has no ring left to contribute — the router's
+    own events still tell its side of the story)."""
+    import urllib.request
+
+    target = f"{url.rstrip('/')}/trace/{trace_id}"
+    try:
+        with urllib.request.urlopen(target, timeout=timeout) as resp:
+            payload = json.loads(resp.read())
+        return list(payload.get("events") or [])
+    except Exception as e:  # noqa: BLE001 — dead peers are expected here
+        log.debug("trace fetch from %s failed: %s", target, e)
+        return []
+
+
+def collect_trace(trace_id: str, urls, local_events=None,
+                  timeout: float = 5.0) -> dict:
+    """Chief-side stitcher: pull one trace id's events from every replica
+    endpoint, merge them with the caller's local ring slice onto the
+    shared wall-clock axis (trace.stitch), and report which processes
+    contributed — the payload behind the Router's GET /trace/<id>."""
+    from tfde_tpu.observability import trace as _trace
+
+    lists = [fetch_trace(u, trace_id, timeout=timeout) for u in urls]
+    if local_events is not None:
+        lists.append(list(local_events))
+    events = _trace.stitch(lists)
+    procs = sorted({str(e["proc"]) for e in events if e.get("proc")})
+    return {"trace": trace_id, "events": events, "procs": procs}

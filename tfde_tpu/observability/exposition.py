@@ -161,10 +161,17 @@ class JsonlMetricsLog:
         flat = metrics.flatten_snapshot(self._reg.snapshot())
         if extra:
             flat.update(extra)
-        line = json.dumps(
-            {"ts": time.time(), "step": int(step), "metrics": flat},
-            sort_keys=True,
-        )
+        rec = {"ts": time.time(), "step": int(step), "metrics": flat}
+        # exemplar linking (trace.py): when request tracing is on, each
+        # snapshot line carries the trace ids of the slowest latency
+        # observations so a post-mortem can jump from a bad percentile
+        # straight to the offending waterfalls
+        from tfde_tpu.observability import trace as _trace
+
+        ex = _trace.exemplars()
+        if ex:
+            rec["exemplars"] = ex
+        line = json.dumps(rec, sort_keys=True)
         with self._lock:
             if self._f is not None:
                 self._f.write(line + "\n")

@@ -16,6 +16,11 @@ XProf timeline — one vocabulary across metrics and traces. The
 TraceAnnotation is only constructed while tracing (the
 `set_trace_active` flag, flipped by profiler.py at start/stop), keeping
 the steady-state span cost to a clock read and a locked histogram add.
+
+When the request-trace ring (trace.py) is active, every span ALSO lands
+as a duration event on that timeline, tagged with the thread's bound
+trace id (`trace.bind`) — so training-phase spans and serving request
+waterfalls share one vocabulary and one viewer.
 """
 
 from __future__ import annotations
@@ -25,15 +30,23 @@ import time
 from typing import Iterator, Optional
 
 from tfde_tpu.observability import metrics
+from tfde_tpu.observability import trace as reqtrace
 
 _trace_active = False
+# jax is resolved ONCE when profiler tracing first activates — span()
+# used to re-run the import machinery on every traced span
+_jax = None
 
 
 def set_trace_active(active: bool) -> None:
     """Flipped by profiler.py when a jax.profiler trace starts/stops; spans
     emit TraceAnnotations only while True."""
-    global _trace_active
+    global _trace_active, _jax
     _trace_active = bool(active)
+    if _trace_active and _jax is None:
+        import jax
+
+        _jax = jax
 
 
 def trace_active() -> bool:
@@ -50,10 +63,9 @@ def span(name: str,
     reg = registry or metrics.default_registry()
     ann = None
     if _trace_active:
-        import jax
-
-        ann = jax.profiler.TraceAnnotation(name)
+        ann = _jax.profiler.TraceAnnotation(name)
         ann.__enter__()
+    wall = time.time() if reqtrace.active() else None
     t0 = time.perf_counter()
     try:
         yield
@@ -62,6 +74,10 @@ def span(name: str,
         if ann is not None:
             ann.__exit__(None, None, None)
         reg.histogram(name).observe(dt)
+        if wall is not None:
+            # same name, same timeline: picks up the thread's bound
+            # request id (trace.bind) automatically via current()
+            reqtrace.event(name, ts=wall, dur=dt)
 
 
 def record(name: str, seconds: float,

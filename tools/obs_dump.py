@@ -18,10 +18,18 @@ surfaces, composable in one invocation:
   serving Router's ``/replicas`` and print the routing table: per
   replica up/drained, outstanding tokens (the placement signal), served
   sessions, and metric-push age (the serving-cluster runbook surface,
-  WORKFLOWS.md §13).
+  WORKFLOWS.md §13), plus the SLO block (TTFT/TPOT attainment and
+  burn rates, WORKFLOWS.md §14).
+- ``python tools/obs_dump.py --trace <id> --router http://router:8000``
+  (or with a model_dir holding ``debug/trace_*.jsonl`` dumps) — print
+  one request's stitched cross-process waterfall; add ``--chrome
+  out.json`` to also write Chrome trace-event JSON for Perfetto /
+  chrome://tracing.
 - ``--tail N`` — how many trailing flight events to print (default 10).
 
-Reads only; stdlib only — safe to run against a production model_dir.
+Reads only; stdlib only — safe to run against a production model_dir
+(the sole exception: ``--trace`` imports tfde_tpu's stitcher, still
+pure stdlib underneath).
 """
 
 from __future__ import annotations
@@ -43,7 +51,8 @@ _HEADLINE_KINDS = (
 
 #: metric-name prefixes worth printing from the last JSONL snapshot
 _SNAPSHOT_PREFIXES = ("train/", "goodput/", "cluster/", "resilience/",
-                      "sentry/", "checkpoint/")
+                      "sentry/", "checkpoint/", "serving/", "slo/",
+                      "router/")
 
 _LABELLED = re.compile(r'^(\w+)\{host="(\d+)"\}\s+(\S+)$')
 
@@ -97,6 +106,13 @@ def dump_metrics_log(path: str) -> None:
     for name in sorted(flat):
         if name.startswith(_SNAPSHOT_PREFIXES):
             print(f"    {name:<40} {flat[name]}")
+    ex = last.get("exemplars", {})
+    if ex:
+        print("  slowest-request exemplars (value, trace id):")
+        for metric in sorted(ex):
+            rows_ = ", ".join(f"{r['value']:.1f}:{r['trace']}"
+                              for r in ex[metric][:3])
+            print(f"    {metric:<40} {rows_}")
 
 
 def dump_live(url: str) -> None:
@@ -156,6 +172,79 @@ def dump_router(url: str) -> None:
               f"{r.get('served', 0):>7} "
               f"{(f'{age:.1f}' if age is not None else '-'):>10}  "
               f"{r.get('url', '?')}")
+    slo = body.get("slo")
+    if slo:
+        print(f"  slo: objective {slo.get('objective')} | "
+              f"ttft target {slo.get('ttft_target_ms')}ms | "
+              f"tpot target {slo.get('tpot_target_ms')}ms")
+        for metric in ("ttft", "tpot"):
+            att = slo.get(f"{metric}_attainment")
+            att_s = f"{att:.4f}" if att is not None else "-"
+            burns = slo.get(f"{metric}_burn_rate", {})
+            burn_s = " ".join(
+                f"{w}={v:.2f}" if v is not None else f"{w}=-"
+                for w, v in sorted(burns.items())
+            )
+            print(f"    {metric}: attainment {att_s} "
+                  f"({slo.get(f'{metric}_requests', 0)} reqs) "
+                  f"burn[{burn_s}]")
+
+
+def _fmt_trace_event(e: dict, t0: float) -> str:
+    extra = {k: v for k, v in e.items()
+             if k not in ("ts", "dur", "name", "proc", "pid", "trace",
+                          "traces")}
+    fields = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+    dur = f"{e['dur'] * 1e3:8.2f}ms" if "dur" in e else " " * 10
+    return (f"  +{(e.get('ts', t0) - t0) * 1e3:9.2f}ms {dur} "
+            f"{str(e.get('proc', '?')):<10} {e.get('name', '?'):<22} "
+            f"{fields}")
+
+
+def dump_trace(trace_id: str, router_url=None, model_dir=None,
+               chrome_out=None) -> int:
+    """Print one request's stitched waterfall — from a live router's
+    /trace/<id> endpoint, or from dumped debug/trace_*.jsonl files —
+    and optionally write Chrome trace-event JSON."""
+    # lazy: only --trace pays the package import (and the path shim for
+    # running as `python tools/obs_dump.py`); every other mode stays
+    # import-free
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tfde_tpu.observability import trace as reqtrace
+
+    if router_url:
+        target = router_url.rstrip("/") + f"/trace/{trace_id}"
+        body = json.loads(urllib.request.urlopen(target, timeout=5).read())
+        events = body.get("events", [])
+        src = target
+    else:
+        paths = sorted(glob.glob(
+            os.path.join(model_dir, "debug", "trace_*.jsonl")))
+        if not paths:
+            print(f"no debug/trace_*.jsonl dumps under {model_dir}")
+            return 1
+        per_proc = [reqtrace.load(p) for p in paths]
+        events = reqtrace.stitch([
+            [e for e in evs
+             if e.get("trace") == trace_id
+             or trace_id in e.get("traces", ())]
+            for evs in per_proc
+        ])
+        src = f"{len(paths)} dump file(s) under {model_dir}/debug"
+    print(f"== trace {trace_id} ({src}): {len(events)} events, "
+          f"procs {sorted({str(e.get('proc')) for e in events})}")
+    if not events:
+        return 1
+    t0 = min(e.get("ts", 0.0) for e in events)
+    for e in events:
+        print(_fmt_trace_event(e, t0))
+    if chrome_out:
+        with open(chrome_out, "w") as f:
+            json.dump(reqtrace.to_chrome(events), f)
+        print(f"  chrome trace-event JSON -> {chrome_out} "
+              f"(load in Perfetto / chrome://tracing)")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -168,10 +257,23 @@ def main(argv=None) -> int:
                                      "http://router:8000")
     ap.add_argument("--tail", type=int, default=10,
                     help="trailing flight events to print (default 10)")
+    ap.add_argument("--trace", metavar="ID",
+                    help="print one request's stitched waterfall (needs "
+                         "--router for live stitching, or a model_dir "
+                         "with debug/trace_*.jsonl dumps)")
+    ap.add_argument("--chrome", metavar="PATH",
+                    help="with --trace: also write Chrome trace-event "
+                         "JSON (Perfetto-loadable) to PATH")
     args = ap.parse_args(argv)
     if not args.model_dir and not args.url and not args.router:
         ap.error("give a model_dir, --url, --router, or a combination")
+    if args.trace and not (args.router or args.model_dir):
+        ap.error("--trace needs --router (live) or a model_dir (dumps)")
 
+    if args.trace:
+        return dump_trace(args.trace, router_url=args.router,
+                          model_dir=args.model_dir,
+                          chrome_out=args.chrome)
     if args.url:
         dump_live(args.url)
     if args.router:

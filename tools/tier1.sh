@@ -26,6 +26,15 @@
 #                                   the whole suite doubles as the
 #                                   cache-on parity sweep. Also accepts
 #                                   an integer byte budget.)
+#        TFDE_TRACE=on tools/tier1.sh
+#                                  (re-run with per-request distributed
+#                                   tracing recording into every
+#                                   process's ring —
+#                                   observability/trace.py; greedy
+#                                   outputs are unaffected by design, so
+#                                   the whole suite doubles as the
+#                                   tracing-on parity sweep. Also
+#                                   accepts an integer ring capacity.)
 #
 # Also prints DOTS_DELTA (this run's DOTS_PASSED minus the previous
 # run's, from /tmp/_t1.passed) so a regression is visible at a glance
@@ -40,6 +49,7 @@ timeout -k 10 1140 env JAX_PLATFORMS=cpu \
     TFDE_GRAD_TRANSPORT="${TFDE_GRAD_TRANSPORT:-fp32}" \
     TFDE_OPT_SHARDING="${TFDE_OPT_SHARDING:-replicated}" \
     TFDE_PREFIX_CACHE="${TFDE_PREFIX_CACHE:-off}" \
+    TFDE_TRACE="${TFDE_TRACE:-off}" \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     --durations=10 \
