@@ -408,7 +408,8 @@ class Router:
                     ReplicaServer._send_json(
                         self, 200,
                         {"replicas": router.table(),
-                         "slo": router.slo.summary()},
+                         "slo": router.slo.summary(),
+                         "mem": router.mem_table()},
                     )
                 elif self.path.startswith("/trace/"):
                     tid = self.path[len("/trace/"):]
@@ -557,6 +558,34 @@ class Router:
                 flightrec.record("replica_drain", replica=idx, tier=tier)
                 return True
         return False
+
+    def mem_table(self) -> dict:
+        """Per-replica memory & compile snapshot from the pushed metrics
+        (the mem/* block on obs_dump --router): live device bytes, the
+        largest registered program's peak, and the sentinel's total
+        cache-miss count — enough to spot an HBM leak or a recompiling
+        replica from the routing table without scraping each replica."""
+        if self._agg is None:
+            return {}
+        out = {}
+        for hid, flat in self._agg.host_metrics(("mem/", "compile/")).items():
+            peaks = {name[len("mem/"):-len("/peak_bytes")]: v
+                     for name, v in flat.items()
+                     if name.startswith("mem/")
+                     and name.endswith("/peak_bytes")}
+            top = max(peaks.items(), key=lambda kv: kv[1], default=None)
+            out[str(hid)] = {
+                "live_bytes": flat.get("mem/live/bytes"),
+                "live_buffers": flat.get("mem/live/buffers"),
+                "peak_program": top[0] if top else None,
+                "peak_bytes": top[1] if top else None,
+                "compile_misses": sum(
+                    v for name, v in flat.items()
+                    if name.startswith("compile/")
+                    and name.endswith("/misses")),
+                "compile_seconds": flat.get("compile/seconds_total"),
+            }
+        return out
 
     def table(self) -> list:
         """Live routing table (the obs_dump --router surface)."""

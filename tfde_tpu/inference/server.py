@@ -62,6 +62,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import itertools
 import time
 from typing import Optional
 
@@ -81,9 +82,18 @@ from tfde_tpu.inference.prefix_cache import (
     resolve as _resolve_prefix,
 )
 from tfde_tpu.inference.speculative import _set_index_counters
+from tfde_tpu.observability import memwatch as _memwatch
 from tfde_tpu.observability import metrics
+from tfde_tpu.observability import recompile as _recompile
 from tfde_tpu.observability import trace as _trace
 from tfde_tpu.observability.spans import span
+
+#: per-batcher fingerprint tag: distinct batcher instances hold distinct
+#: static model objects, so the SAME (kind, key, wave) signature compiles
+#: separately per instance — the recompile sentinel's fingerprints carry
+#: this tag so a second batcher's first wave reads as a novel compile,
+#: not as an unexpected recompile of the first batcher's site
+_BATCHER_TAGS = itertools.count()
 
 
 def _fetch(tree):
@@ -442,6 +452,11 @@ class _BatcherBase:
         # unread stream entry would leak
         self._track_progress = False
         self._stream: dict = {}  # rid -> {"tokens", "taken", "done"}
+        # recompile-sentinel fingerprint tag + the memory-ledger program
+        # names this instance already registered (one interrogation per
+        # pad-ladder bucket, not per wave)
+        self._rc_tag = next(_BATCHER_TAGS)
+        self._mem_programs: set = set()
 
     #: subclasses that implement `_primed_wave` + `prime` flip this
     _accepts_primed = False
@@ -678,11 +693,41 @@ class _BatcherBase:
         return plans
 
     def _admit_group(self, kind: str, key, group, rows) -> np.ndarray:
+        """Run one admission group under the recompile sentinel: every
+        prefill wave is a watched jit entry point fingerprinted by
+        (batcher, group key, padded wave width), so a mid-serve recompile
+        lands in the compile/serve/prefill_<kind>/* counters, the flight
+        ring, and — when the wave carries traced requests — the PR-9
+        waterfall."""
+        rp = _pad_wave(len(group), self._b)
+        traces = None
+        if self._trace_ids:
+            tids = [t for it in group
+                    if (t := self._trace_ids.get(it[0])) is not None]
+            traces = tids or None
+        site = _recompile.site(f"serve/prefill_{kind}")
+        with site.watch(self._rc_tag, kind, key, rp, traces=traces):
+            return self._run_group(kind, key, group, rows)
+
+    def _run_group(self, kind: str, key, group, rows) -> np.ndarray:
+        """Dispatch one admission group to its wave implementation —
+        the seam subclasses extend with new admission kinds (the
+        sentinel wrapper above stays shared)."""
         if kind == "cold":
             return self._cold_wave(key, group, rows)
         if kind == "primed":
             return self._primed_wave(key, group, rows)
         raise ValueError(f"unknown admission kind {kind!r}")
+
+    def _mem_register(self, name: str, fn, args, donated=None) -> None:
+        """Register one serving program with the memory ledger, once per
+        (program name, shape signature) per batcher — publishes the
+        mem/<name>/* peak/argument/output gauges for every pad-ladder
+        bucket the server actually compiles."""
+        if name in self._mem_programs or not _memwatch.enabled():
+            return
+        self._mem_programs.add(name)
+        _memwatch.register(name, fn, args=args, donated=donated)
 
     def _cold_wave(self, bucket: int, group, rows) -> np.ndarray:
         n = len(group)
@@ -939,17 +984,38 @@ class ContinuousBatcher(_BatcherBase):
             return finished
 
         depth = self._pick_depth(active)
+        traced = (
+            [self._trace_ids[rid] for r in active
+             if (rid := self._req[r]) in self._trace_ids]
+            if self._trace_ids else []
+        )
         t0 = time.perf_counter()
         with span("serving/decode"):
             if self._dev is None:
                 self._upload_state()
             tok, idx, budget, done = self._dev
             rng = self._rng if self._sampling["temperature"] != 0.0 else None
-            out = _decode_scan(
-                self._decode_model, self._cache, self._params, tok, idx,
-                budget, done, self._seen, rng, depth=depth,
-                eos_id=self._eos, pad_id=self._pad, **self._sampling,
+            self._mem_register(
+                f"serve/decode/k{depth}",
+                functools.partial(
+                    _decode_scan, self._decode_model, depth=depth,
+                    eos_id=self._eos, pad_id=self._pad, **self._sampling,
+                ),
+                (self._cache, self._params, tok, idx, budget, done,
+                 self._seen, rng),
+                donated=(self._cache, tok, idx, budget, done, self._seen),
             )
+            # steady-state decode is the shape-stable site: the depth
+            # ladder gives O(log scan_depth) expected signatures, and any
+            # repeat-fingerprint miss is an unexpected recompile (the
+            # per-token-recompile pathology memgate pins)
+            rc = _recompile.site("serve/decode", stable=True)
+            with rc.watch(self._rc_tag, depth, traces=traced or None):
+                out = _decode_scan(
+                    self._decode_model, self._cache, self._params, tok, idx,
+                    budget, done, self._seen, rng, depth=depth,
+                    eos_id=self._eos, pad_id=self._pad, **self._sampling,
+                )
             self._dispatches += 1
             (self._cache, tok, idx, budget, done, self._seen, rng,
              toks, emitted) = out
@@ -959,11 +1025,6 @@ class ContinuousBatcher(_BatcherBase):
             toks_np, emitted_np = _fetch((toks, emitted))
             self._syncs += 1
         self._rounds += depth
-        traced = (
-            [self._trace_ids[rid] for r in active
-             if (rid := self._req[r]) in self._trace_ids]
-            if self._trace_ids else []
-        )
         n_emitted = 0
         for r in active:
             row = toks_np[r][emitted_np[r]]
@@ -1052,9 +1113,19 @@ class ContinuousBatcher(_BatcherBase):
         rng = None
         if self._sampling["temperature"] != 0.0:
             self._rng, rng = jax.random.split(self._rng)
+        tmpl = self._row_template(rp)
+        prompts_dev = jnp.asarray(prompts)
+        last_dev = jnp.asarray(last)
+        self._mem_register(
+            f"serve/prefill/b{bucket}r{rp}",
+            functools.partial(_prefill_rows, self._decode_model,
+                              **self._sampling),
+            (tmpl, self._params, prompts_dev, last_dev, valid, rng),
+            donated=tmpl,
+        )
         row_cache, tok, row_seen = _prefill_rows(
-            self._decode_model, self._row_template(rp), self._params,
-            jnp.asarray(prompts), jnp.asarray(last), valid, rng,
+            self._decode_model, tmpl, self._params,
+            prompts_dev, last_dev, valid, rng,
             **self._sampling,
         )
         self._dispatches += 1
@@ -1144,10 +1215,10 @@ class ContinuousBatcher(_BatcherBase):
         plans += [("primed", b, g) for b, g in primed.items()]
         return plans
 
-    def _admit_group(self, kind: str, key, group, rows) -> np.ndarray:
+    def _run_group(self, kind: str, key, group, rows) -> np.ndarray:
         if kind == "warm":
             return self._warm_wave(key, group, rows)
-        return super()._admit_group(kind, key, group, rows)
+        return super()._run_group(kind, key, group, rows)
 
     def _warm_wave(self, key, group, rows) -> np.ndarray:
         """Admit rows whose prompt prefix is cached: land the prefix K/V
@@ -1188,9 +1259,20 @@ class ContinuousBatcher(_BatcherBase):
         rng = None
         if self._sampling["temperature"] != 0.0:
             self._rng, rng = jax.random.split(self._rng)
+        tmpl = self._row_template(rp)
+        suffixes_dev = jnp.asarray(suffixes)
+        last_dev = jnp.asarray(last)
+        self._mem_register(
+            f"serve/prefill_warm/p{pre_len}s{sbucket}r{rp}",
+            functools.partial(_prefill_suffix, self._decode_model,
+                              **self._sampling),
+            (tmpl, self._params, kv_stack, suffixes_dev, last_dev, fullp,
+             valid, rng),
+            donated=tmpl,
+        )
         row_cache, tok, row_seen = _prefill_suffix(
-            self._decode_model, self._row_template(rp), self._params,
-            kv_stack, jnp.asarray(suffixes), jnp.asarray(last), fullp,
+            self._decode_model, tmpl, self._params,
+            kv_stack, suffixes_dev, last_dev, fullp,
             valid, rng, **self._sampling,
         )
         self._dispatches += 2  # the per-wave kv stack + the fused prefill
@@ -1288,6 +1370,12 @@ class ContinuousBatcher(_BatcherBase):
                 seen_rows[i, pr.first_token] = True
         kv_dev = {name: jnp.asarray(b) for name, b in stacked.items()}
         rows_dev = jnp.asarray(rows_pad)
+        self._mem_register(
+            f"serve/prefill_primed/b{bucket}r{rp}",
+            _scatter_primed_rows,
+            (self._cache, kv_dev, rows_dev),
+            donated=self._cache,
+        )
         self._cache = _scatter_primed_rows(self._cache, kv_dev, rows_dev)
         self._dispatches += 1
         if seen_rows is not None:

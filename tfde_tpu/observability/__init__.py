@@ -11,6 +11,8 @@
 - slo.py         TTFT/TPOT SLO attainment + burn-rate gauges
 - flightrec.py   crash-dump flight recorder ring
 - aggregate.py   cross-host metric aggregation + trace stitching
+- memwatch.py    measured memory ledger (mem/*, TFDE_MEMWATCH)
+- recompile.py   jit-cache-miss sentinel (compile/*)
 """
 
 from tfde_tpu.observability.tensorboard import SummaryWriter  # noqa: F401
@@ -28,3 +30,5 @@ from tfde_tpu.observability.exposition import (  # noqa: F401
 )
 from tfde_tpu.observability import trace  # noqa: F401
 from tfde_tpu.observability.slo import SLOTracker  # noqa: F401
+from tfde_tpu.observability import memwatch  # noqa: F401
+from tfde_tpu.observability import recompile  # noqa: F401

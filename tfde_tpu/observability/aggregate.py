@@ -309,6 +309,18 @@ class ClusterAggregator:
                 )
         return "\n".join(lines) + ("\n" if lines else "")
 
+    def host_metrics(self, prefixes: tuple) -> Dict[int, Dict[str, float]]:
+        """{host: {name: value}} for every pushed scalar whose name starts
+        with one of `prefixes` — the filtered read the router's mem/compile
+        snapshot block uses (the full flat dict can be thousands of
+        series; nobody should json-dump it per poll)."""
+        with self._lock:
+            return {
+                hid: {name: float(v) for name, v in h.flat.items()
+                      if name.startswith(prefixes)}
+                for hid, h in self._hosts.items()
+            }
+
     def hosts(self) -> Dict[int, dict]:
         """{host: {"age": s, "pushes": n, "median_step_ms": ms|None}} —
         the obs_dump/debugging surface."""

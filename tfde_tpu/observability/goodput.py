@@ -27,6 +27,13 @@ Category definitions (all in seconds of the measured wall):
                     (train/init span; includes init-time compiles)
 - ``compile``       first-step JIT compile+execute (train/compile_seconds,
                     measured by the loop's first-step block-until-ready)
+                    PLUS mid-run recompiles observed by the recompile
+                    sentinel at watched sites (the per-site
+                    ``compile/<site>/seconds_total`` counters, minus the
+                    first-step portion already inside
+                    train/compile_seconds) — a serving-bucket or
+                    int8/ZeRO step-swap recompile lands here, not in
+                    ``compute``
 - ``data_wait``     host-input blocking in the device feed (train/data_wait)
 - ``compute``       step time (start-to-start iteration wall minus the
                     categorized chunks, recorded as train/step) plus the
@@ -113,7 +120,25 @@ class GoodputLedger:
 
         seconds = {cat: sum(d(f"sum:{h}") for h in hists)
                    for cat, hists in _SPAN_SOURCES.items()}
-        seconds["compile"] = d("train/compile_seconds")
+        # compile = the first step's synchronous compile+execute wall plus
+        # every later recompile the sentinel attributed to a watched site
+        # (recompile.py). The sentinel-measured portion of the first step
+        # (train/compile_seconds_measured, recorded by the loop) is
+        # subtracted so it is not double-counted; with no sentinel
+        # installed both extra terms are zero and this reduces to the old
+        # first-step-only definition. Only WATCHED sites feed the bucket —
+        # un-watched compiles (eval hooks, checkpoint glue) stay where
+        # they fell, keeping the categories disjoint.
+        first_measured = d("train/compile_seconds_measured")
+        site_compile = sum(
+            self._delta(now, k)
+            for k in set(now) | set(self._base)
+            if k.startswith("compile/") and k.endswith("/seconds_total")
+            and k.count("/") >= 2
+            and not k.startswith("compile/memwatch")
+        )
+        midrun = max(0.0, site_compile - first_measured)
+        seconds["compile"] = d("train/compile_seconds") + midrun
 
         # productive time: step iterations + the sync that drains compute
         steps = d("count:train/step")
@@ -122,7 +147,13 @@ class GoodputLedger:
         lost = d("resilience/lost_steps")
         # replayed steps burned step-shaped wall-clock that trained nothing
         replay = min(step_time, lost * mean_step)
-        seconds["compute"] = step_time - replay
+        # mid-run recompiles of the train step itself burned step-shaped
+        # wall too (the first-step compile is already outside step_time)
+        in_step = min(
+            max(0.0, step_time - replay),
+            max(0.0, d("compile/train_step/seconds_total") - first_measured),
+        )
+        seconds["compute"] = step_time - replay - in_step
         seconds["restart_loss"] = replay + d("resilience/restart_backoff_seconds")
 
         accounted = sum(seconds.values())

@@ -211,6 +211,23 @@ class Registry:
     def __init__(self):
         self._lock = threading.RLock()
         self._metrics: Dict[str, Metric] = {}
+        self._collectors: list = []
+
+    def add_collector(self, fn) -> None:
+        """Register a callable run at the top of every `snapshot()` —
+        the "metrics cadence" hook for values that must be *sampled*
+        rather than pushed (live device-buffer totals, memwatch.py). The
+        collector runs OUTSIDE the registry lock (it is expected to set
+        gauges on this registry) and its exceptions are swallowed: a
+        broken sampler must not take the scrape path down."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def remove_collector(self, fn) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
 
     def _get_or_create(self, name: str, cls, **kwargs) -> Metric:
         with self._lock:
@@ -243,6 +260,13 @@ class Registry:
         {"value": float} for counters/gauges and the histogram dict (count/
         sum/min/max/buckets/p50/p95/p99) for histograms. Plain data — safe
         to serialize, diff, or hand to exposition renderers."""
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:  # outside the lock: collectors set gauges
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — scrape must survive
+                pass
         with self._lock:
             out = {}
             for name in sorted(self._metrics):

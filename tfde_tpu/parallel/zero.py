@@ -333,6 +333,21 @@ def state_bytes(opt_state: Any, layout: Optional[Layout] = None) -> float:
     return total
 
 
+def measured_state_bytes(opt_state: Any) -> float:
+    """Per-device optimizer-state bytes MEASURED from the committed arrays
+    (max over devices of the shard bytes each actually holds, via
+    memwatch.device_bytes) rather than derived from shapes. Returns 0.0
+    for abstract/uncommitted leaves (callers fall back to the analytic
+    state_bytes). The two should agree within padding; a larger gap is a
+    sharding bug worth an alarm."""
+    from tfde_tpu.observability import memwatch
+
+    try:
+        return float(memwatch.device_bytes(opt_state))
+    except Exception:  # noqa: BLE001 — accounting must not break the step
+        return 0.0
+
+
 def param_gather_bytes(layout: Optional[Layout]) -> float:
     """Per-device wire bytes of the trailing param all-gather (ring cost:
     (N-1)/N per payload byte; the payload is both fp32 segments plus one

@@ -37,6 +37,8 @@ from tfde_tpu.data.device import device_prefetch
 from tfde_tpu.resilience.preemption import PreemptionGuard as _PreemptionGuard
 from tfde_tpu.data.pipeline import AutoShardPolicy
 from tfde_tpu.observability import aggregate, exposition, flightrec, metrics
+from tfde_tpu.observability import memwatch
+from tfde_tpu.observability import recompile
 from tfde_tpu.observability import sentry as sentry_lib
 from tfde_tpu.observability.goodput import GoodputLedger
 from tfde_tpu.observability.profiler import StepWindowProfiler
@@ -462,6 +464,22 @@ class Estimator:
                          max_steps=max_steps,
                          resumed=bool(self._from_checkpoint),
                          sentry=scfg is not None)
+        # recompile sentinel on the train step: the batch shapes are pinned
+        # by the pipeline, so past the first compile (and one legitimate
+        # swap, e.g. an int8/ZeRO step change) every miss is a bug
+        rc_site = recompile.site("train_step", stable=True)
+        if memwatch.enabled():
+            memwatch.install_collector()  # mem/live/* on the snapshot cadence
+            if cfg.model_dir is not None:
+                memwatch.arm(cfg.model_dir)
+
+        def _step_fingerprint(b) -> tuple:
+            return tuple(
+                (tuple(getattr(leaf, "shape", ())),
+                 str(getattr(leaf, "dtype", type(leaf).__name__)))
+                for leaf in jax.tree_util.tree_leaves(b)
+            )
+
         last_metrics = None
         compiled = False  # first step = trace+compile+execute, timed apart
         t_window = time.perf_counter()
@@ -492,25 +510,42 @@ class Estimator:
                     # the first steps/sec window (both were poisoned by it
                     # before)
                     t0 = time.perf_counter()
-                    if sstate is not None:
-                        state, last_metrics, sstate = self._train_step(
-                            state, batch, rng, sstate)
-                    else:
-                        state, last_metrics = self._train_step(
-                            state, batch, rng)
-                    jax.block_until_ready(last_metrics)
+                    pre_site_s = rc_site.seconds
+                    with rc_site.watch(*_step_fingerprint(batch)):
+                        if sstate is not None:
+                            state, last_metrics, sstate = self._train_step(
+                                state, batch, rng, sstate)
+                        else:
+                            state, last_metrics = self._train_step(
+                                state, batch, rng)
+                        jax.block_until_ready(last_metrics)
                     compile_s = time.perf_counter() - t0
                     iter_overhead += compile_s
                     compiled = True
                     metrics.counter("train/compile_seconds").incr(compile_s)
+                    # the sentinel-measured portion of the first step, so
+                    # goodput can diff later site compiles against what the
+                    # first-step wall already covers
+                    metrics.counter("train/compile_seconds_measured").incr(
+                        max(0.0, rc_site.seconds - pre_site_s))
                     log.info("first step (compile): %.2fs", compile_s)
                     flightrec.record("compile", seconds=round(compile_s, 3),
                                      step=step + 1)
+                    if memwatch.enabled():
+                        # interrogate the just-compiled program: the NEW
+                        # state/carry have the same avals the executable
+                        # was specialized on (the old buffers were donated)
+                        sargs = ((state, batch, rng, sstate)
+                                 if sstate is not None
+                                 else (state, batch, rng))
+                        memwatch.register("train_step", self._train_step,
+                                          args=sargs, donated=state)
                     if writer is not None:
                         writer.scalars(step + 1,
                                        {"compile_seconds": compile_s})
                 else:
-                    with span("train/dispatch"):
+                    with span("train/dispatch"), \
+                            rc_site.watch(*_step_fingerprint(batch)):
                         if sstate is not None:
                             state, last_metrics, sstate = self._train_step(
                                 state, batch, rng, sstate)

@@ -680,13 +680,20 @@ def _export_opt_gauges(state: TrainState) -> None:
     gauges: per-device optimizer-state bytes (the ~N x saving the ZeRO
     layout buys) and the trailing param all-gather's wire bytes (0 when
     replicated — there is no gather). Static per model x config, set once
-    at step-build time."""
+    at step-build time.
+
+    ``opt/state_bytes`` is MEASURED from the arrays XLA actually
+    allocated (per-device shard bytes, parallel/zero.py
+    measured_state_bytes); the shape-derived number stays published as
+    ``opt/state_bytes_analytic`` for cross-check — a drift between the
+    two is a padding or layout bug."""
     from tfde_tpu.observability import metrics as obs_metrics
 
     reg = obs_metrics.default_registry()
-    reg.gauge("opt/state_bytes").set(
-        zero_lib.state_bytes(state.opt_state, state.opt_layout)
-    )
+    analytic = zero_lib.state_bytes(state.opt_state, state.opt_layout)
+    measured = zero_lib.measured_state_bytes(state.opt_state)
+    reg.gauge("opt/state_bytes").set(measured if measured else analytic)
+    reg.gauge("opt/state_bytes_analytic").set(analytic)
     reg.gauge("opt/param_gather_bytes").set(
         zero_lib.param_gather_bytes(state.opt_layout)
     )

@@ -1,0 +1,232 @@
+"""Memory & compile regression gate: pin the compile counts and peak-HBM
+of a deterministic workload against a checked-in baseline.
+
+The memwatch ledger (tfde_tpu/observability/memwatch.py) measures what
+every compiled program costs, and the recompile sentinel (recompile.py)
+counts every jit-cache miss per site. This tool turns both into a tier-1
+gate: it drives ONE fixed CPU workload — a short instrumented train run
+(tiny CNN through the Estimator loop) plus a serving drain (tiny GPT
+through ContinuousBatcher's pad-ladder admission and fused decode scan) —
+then compares the observed per-site miss counts and per-program peak
+bytes against tools/memgate_baseline.json:
+
+- a site compiling MORE than its baselined miss count fails the gate (a
+  new bucket, a donation bug, a per-token recompile — the regression
+  class the sentinel exists for);
+- a program whose peak bytes exceed its baselined ceiling by more than
+  PEAK_SLACK fails the gate (an activation or cache blow-up);
+- a site or program MISSING from the baseline fails loudly: the workload
+  is deterministic, so new names mean the wiring changed and the
+  baseline must be regenerated deliberately.
+
+Modes:
+
+  python tools/memgate.py --check    # compare vs baseline; exit 1 on
+                                     # regression (wired into tier1.sh)
+  python tools/memgate.py --update   # run the workload and REWRITE the
+                                     # baseline (commit the diff)
+  python tools/memgate.py --print    # run and dump the observation only
+
+Injection self-test (used by tests/test_recompile.py): with
+TFDE_MEMGATE_INJECT=1 the serving phase mutates the decode scan's static
+sampling temperature every step — a genuine per-token-recompile
+regression through the real batcher — and --check must fail.
+
+Re-baseline after a deliberate compile-count or memory change::
+
+  JAX_PLATFORMS=cpu python tools/memgate.py --update
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TFDE_MEMWATCH", "on")
+
+BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "memgate_baseline.json")
+#: peak-bytes ceiling slack: estimate-mode arg/out bytes are exact for a
+#: fixed workload, but leave headroom for dtype/layout drift that is not
+#: a regression (10%)
+PEAK_SLACK = 1.10
+ENV_INJECT = "TFDE_MEMGATE_INJECT"
+
+
+def _train_phase() -> None:
+    """A short instrumented Estimator run: registers the train_step site
+    and mem/train_step program, exercises the goodput compile bucket."""
+    import tempfile
+
+    import numpy as np
+    import optax
+
+    from tfde_tpu.models.cnn import PlainCNN
+    from tfde_tpu.training.lifecycle import Estimator, RunConfig
+
+    n, b = 128, 32
+    rng = np.random.default_rng(0)
+    images = rng.random((n, 784), np.float32)
+    labels = rng.integers(0, 10, (n, 1)).astype(np.int32)
+
+    def input_fn():
+        def gen():
+            i = 0
+            while True:
+                s = (i * b) % n
+                yield (images[s:s + b], labels[s:s + b])
+                i += 1
+
+        return gen()
+
+    est = Estimator(
+        model=PlainCNN(),
+        optimizer=optax.sgd(0.1),
+        config=RunConfig(
+            model_dir=tempfile.mkdtemp(prefix="tfde-memgate-"),
+            save_summary_steps=4,
+            log_step_count_steps=8,
+            save_checkpoints_steps=None,
+        ),
+    )
+    est.train(input_fn, 6)
+    est.close()
+
+
+def _serve_phase(inject: bool) -> None:
+    """A deterministic serving drain through the real batcher: two prompt
+    buckets, staggered budgets, the full pad ladder + decode-depth
+    ladder. With `inject`, every step perturbs the decode scan's static
+    temperature — the per-token-recompile regression the gate must
+    catch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfde_tpu.inference.server import ContinuousBatcher
+    from tfde_tpu.models.gpt import GPT
+
+    model = GPT(vocab_size=256, hidden_size=32, depth=2, num_heads=2,
+                mlp_dim=64, max_position=64, dtype=jnp.float32)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = ContinuousBatcher(model, params, batch_size=4, max_len=48,
+                            scan_depth=4)
+    rng = np.random.default_rng(0)
+    for i, (plen, n_new) in enumerate(
+            [(3, 8), (6, 5), (4, 12), (7, 6), (3, 9), (5, 4)]):
+        srv.submit(rng.integers(0, model.vocab_size, plen), n_new)
+    step = 0
+    while not srv.idle:
+        if inject and step > 0:
+            # a DISTINCT static temperature every step recompiles the
+            # decode scan on an already-seen fingerprint — the genuine
+            # cache-thrash pathology, driven through the real entry point
+            srv._sampling["temperature"] = 0.5 + 1e-4 * step
+        srv.step()
+        step += 1
+        if step > 200:
+            raise RuntimeError("serve phase failed to drain")
+
+
+def observe() -> dict:
+    """Run the workload; return {sites: {name: misses}, programs:
+    {name: peak_bytes}} from the sentinel + ledger."""
+    from tfde_tpu.observability import memwatch, recompile
+
+    recompile.install()
+    _train_phase()
+    _serve_phase(inject=os.environ.get(ENV_INJECT, "") not in ("", "0"))
+    return {
+        "sites": {name: {"misses": s["misses"]}
+                  for name, s in sorted(recompile.sites().items())},
+        "programs": {name: {"peak_bytes": int(p.peak_bytes)}
+                     for name, p in sorted(memwatch.programs().items())},
+    }
+
+
+def check(obs: dict, base: dict) -> list:
+    """Compare an observation against the baseline; returns the list of
+    failure strings (empty = gate passes)."""
+    fails = []
+    for name, s in obs["sites"].items():
+        b = base.get("sites", {}).get(name)
+        if b is None:
+            fails.append(
+                f"site {name} not in baseline — new watched entry point; "
+                f"re-baseline with: python tools/memgate.py --update"
+            )
+            continue
+        if s["misses"] > b["misses"]:
+            fails.append(
+                f"site {name}: {s['misses']} compiles > baseline "
+                f"{b['misses']} — a jit program is recompiling beyond "
+                f"its pinned budget (see WORKFLOWS.md §15)"
+            )
+    for name, p in obs["programs"].items():
+        b = base.get("programs", {}).get(name)
+        if b is None:
+            fails.append(
+                f"program {name} not in baseline — re-baseline with: "
+                f"python tools/memgate.py --update"
+            )
+            continue
+        ceiling = int(b["peak_bytes"] * PEAK_SLACK)
+        if p["peak_bytes"] > ceiling:
+            fails.append(
+                f"program {name}: peak {p['peak_bytes']} bytes > ceiling "
+                f"{ceiling} (baseline {b['peak_bytes']} x {PEAK_SLACK})"
+            )
+    return fails
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--check", action="store_true",
+                      help="compare vs baseline; exit 1 on regression")
+    mode.add_argument("--update", action="store_true",
+                      help="run the workload and rewrite the baseline")
+    mode.add_argument("--print", dest="show", action="store_true",
+                      help="run and dump the observation JSON only")
+    ap.add_argument("--baseline", default=BASELINE,
+                    help=f"baseline path (default {BASELINE})")
+    args = ap.parse_args()
+
+    obs = observe()
+    if args.show:
+        print(json.dumps(obs, indent=2, sort_keys=True))
+        return 0
+    if args.update:
+        obs["_note"] = ("generated by: JAX_PLATFORMS=cpu python "
+                        "tools/memgate.py --update — regenerate after any "
+                        "deliberate compile-count or memory change")
+        with open(args.baseline, "w") as f:
+            json.dump(obs, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"memgate: baseline written to {args.baseline}")
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            base = json.load(f)
+    except OSError as e:
+        print(f"memgate: FAIL — no baseline ({e}); generate one with "
+              f"python tools/memgate.py --update")
+        return 1
+    fails = check(obs, base)
+    if fails:
+        print("memgate: FAIL")
+        for f in fails:
+            print(f"  - {f}")
+        return 1
+    print(f"memgate: pass ({len(obs['sites'])} sites, "
+          f"{len(obs['programs'])} programs within baseline)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,11 @@ surfaces, composable in one invocation:
   one request's stitched cross-process waterfall; add ``--chrome
   out.json`` to also write Chrome trace-event JSON for Perfetto /
   chrome://tracing.
+- ``python tools/obs_dump.py --mem <model_dir>`` — the memory & compile
+  view (WORKFLOWS.md §15): per-program peak/arg/out bytes from the
+  memwatch ledger, per-site jit-cache hit/miss counters from the
+  recompile sentinel, the live-device-buffer trend across snapshots,
+  and the top-K largest buffers from ``debug/memwatch.json``.
 - ``--tail N`` — how many trailing flight events to print (default 10).
 
 Reads only; stdlib only — safe to run against a production model_dir
@@ -52,7 +57,7 @@ _HEADLINE_KINDS = (
 #: metric-name prefixes worth printing from the last JSONL snapshot
 _SNAPSHOT_PREFIXES = ("train/", "goodput/", "cluster/", "resilience/",
                       "sentry/", "checkpoint/", "serving/", "slo/",
-                      "router/")
+                      "router/", "mem/", "compile/", "opt/")
 
 _LABELLED = re.compile(r'^(\w+)\{host="(\d+)"\}\s+(\S+)$')
 
@@ -172,6 +177,23 @@ def dump_router(url: str) -> None:
               f"{r.get('served', 0):>7} "
               f"{(f'{age:.1f}' if age is not None else '-'):>10}  "
               f"{r.get('url', '?')}")
+    mem = body.get("mem")
+    if mem:
+        print(f"  {'host':>7} {'live_mb':>9} {'buffers':>8} "
+              f"{'peak_mb':>9} {'misses':>7} {'compile_s':>9}  "
+              f"peak program")
+        for hid in sorted(mem):
+            m = mem[hid]
+
+            def _mb(v):
+                return f"{v / 1e6:.1f}" if v is not None else "-"
+
+            print(f"  {hid:>7} {_mb(m.get('live_bytes')):>9} "
+                  f"{int(m.get('live_buffers') or 0):>8} "
+                  f"{_mb(m.get('peak_bytes')):>9} "
+                  f"{int(m.get('compile_misses') or 0):>7} "
+                  f"{(m.get('compile_seconds') or 0.0):>9.2f}  "
+                  f"{m.get('peak_program') or '-'}")
     slo = body.get("slo")
     if slo:
         print(f"  slo: objective {slo.get('objective')} | "
@@ -188,6 +210,103 @@ def dump_router(url: str) -> None:
             print(f"    {metric}: attainment {att_s} "
                   f"({slo.get(f'{metric}_requests', 0)} reqs) "
                   f"burn[{burn_s}]")
+
+
+def dump_mem(model_dir: str) -> int:
+    """``--mem``: the memory & compile post-mortem view of one run —
+    per-program peak/arg/out bytes and per-site compile counters from the
+    last metrics snapshot, the live-device-buffer trend across snapshots
+    (is it a leak or a plateau?), and the top-K largest live buffers from
+    the armed ``debug/memwatch.json`` side-file."""
+    logs = sorted(glob.glob(os.path.join(model_dir, "metrics", "*.jsonl")))
+    rows = []
+    for p in logs:
+        rows.extend(_load_jsonl(p))
+    side_path = os.path.join(model_dir, "debug", "memwatch.json")
+    side = None
+    if os.path.exists(side_path):
+        try:
+            with open(side_path) as f:
+                side = json.load(f)
+        except ValueError:
+            pass
+    if not rows and side is None:
+        print(f"no metrics/*.jsonl snapshots or debug/memwatch.json "
+              f"under {model_dir} — was the run instrumented "
+              f"(TFDE_MEMWATCH) with a model_dir?")
+        return 1
+
+    flat = rows[-1].get("metrics", {}) if rows else {}
+    programs: dict = collections.defaultdict(dict)
+    for name, val in flat.items():
+        if not name.startswith("mem/") or name.startswith("mem/live/"):
+            continue
+        prog, _, field = name[len("mem/"):].rpartition("/")
+        programs[prog][field] = val
+    if side:  # the side-file also has programs when no snapshot log exists
+        for prog, pm in side.get("programs", {}).items():
+            programs[prog] = {**pm, **programs[prog]}
+    print(f"== mem ledger: {model_dir} ({len(programs)} programs)")
+    if programs:
+        print(f"  {'program':<32} {'peak_mb':>9} {'args_mb':>9} "
+              f"{'out_mb':>9} {'temp_mb':>9} {'meas':>5}")
+        for prog in sorted(programs,
+                           key=lambda p: -programs[p].get("peak_bytes", 0)):
+            pm = programs[prog]
+            print(f"  {prog:<32} "
+                  f"{pm.get('peak_bytes', 0) / 1e6:>9.2f} "
+                  f"{pm.get('argument_bytes', 0) / 1e6:>9.2f} "
+                  f"{pm.get('output_bytes', 0) / 1e6:>9.2f} "
+                  f"{pm.get('temp_bytes', 0) / 1e6:>9.2f} "
+                  f"{int(pm.get('measured', 0)):>5}")
+
+    sites: dict = collections.defaultdict(dict)
+    for name, val in flat.items():
+        if not name.startswith("compile/") or name.count("/") < 2:
+            continue
+        site, _, field = name[len("compile/"):].rpartition("/")
+        sites[site][field] = val
+    if sites:
+        print(f"\n  {'compile site':<32} {'hits':>7} {'misses':>7} "
+              f"{'sigs':>5} {'seconds':>8} {'unexpected':>10}")
+        for site in sorted(sites):
+            s = sites[site]
+            print(f"  {site:<32} {int(s.get('cache_hits', 0)):>7} "
+                  f"{int(s.get('misses', 0)):>7} "
+                  f"{int(s.get('signatures', 0)):>5} "
+                  f"{s.get('seconds_total', 0.0):>8.2f} "
+                  f"{int(s.get('unexpected', 0)):>10}")
+
+    trend = [(r.get("step"), r["metrics"]["mem/live/bytes"],
+              r["metrics"].get("mem/live/buffers", 0))
+             for r in rows if "mem/live/bytes" in r.get("metrics", {})]
+    if trend:
+        print(f"\n  live device buffers across {len(trend)} snapshots "
+              f"(leak check — bytes should plateau):")
+        show = trend if len(trend) <= 8 else (
+            trend[:3] + [None] + trend[-4:])
+        for t in show:
+            if t is None:
+                print("    ...")
+                continue
+            step, b, n = t
+            print(f"    step {str(step):>8}  {b / 1e6:>10.2f} MB  "
+                  f"{int(n):>6} buffers")
+        first, last = trend[0][1], trend[-1][1]
+        if first > 0 and last > 1.5 * first:
+            print(f"    WARNING: live bytes grew {last / first:.2f}x over "
+                  f"the run — possible buffer leak (WORKFLOWS.md §15)")
+
+    if side and side.get("live", {}).get("top"):
+        live = side["live"]
+        print(f"\n  top live buffers at last dump "
+              f"({live.get('bytes', 0) / 1e6:.2f} MB total, "
+              f"{live.get('buffers', 0)} buffers):")
+        for b in live["top"]:
+            shape = "x".join(str(d) for d in b.get("shape", []))
+            print(f"    {b['bytes'] / 1e6:>10.3f} MB  "
+                  f"[{shape or 'scalar'}] {b.get('dtype', '?')}")
+    return 0
 
 
 def _fmt_trace_event(e: dict, t0: float) -> str:
@@ -264,12 +383,20 @@ def main(argv=None) -> int:
     ap.add_argument("--chrome", metavar="PATH",
                     help="with --trace: also write Chrome trace-event "
                          "JSON (Perfetto-loadable) to PATH")
+    ap.add_argument("--mem", action="store_true",
+                    help="memory & compile view of a model_dir: per-"
+                         "program peak bytes, per-site compile counters, "
+                         "live-buffer trend, top-K largest buffers")
     args = ap.parse_args(argv)
     if not args.model_dir and not args.url and not args.router:
         ap.error("give a model_dir, --url, --router, or a combination")
     if args.trace and not (args.router or args.model_dir):
         ap.error("--trace needs --router (live) or a model_dir (dumps)")
+    if args.mem and not args.model_dir:
+        ap.error("--mem needs a model_dir")
 
+    if args.mem:
+        return dump_mem(args.model_dir)
     if args.trace:
         return dump_trace(args.trace, router_url=args.router,
                           model_dir=args.model_dir,

@@ -35,6 +35,14 @@
 #                                   the whole suite doubles as the
 #                                   tracing-on parity sweep. Also
 #                                   accepts an integer ring capacity.)
+#        TFDE_MEMWATCH=full tools/tier1.sh
+#                                  (re-run with the memory ledger in
+#                                   AOT-measured mode — every registered
+#                                   program is lowered+compiled for XLA's
+#                                   memory_analysis instead of the free
+#                                   eval_shape estimate —
+#                                   observability/memwatch.py; 'off'
+#                                   disables the ledger entirely)
 #
 # Also prints DOTS_DELTA (this run's DOTS_PASSED minus the previous
 # run's, from /tmp/_t1.passed) so a regression is visible at a glance
@@ -43,13 +51,15 @@ set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
 
 rm -f /tmp/_t1.log
-# 19 min: the suite has grown a subsystem per PR and sat within ~5% of
-# the old 870s budget, so a loaded box could kill a fully-green run
-timeout -k 10 1140 env JAX_PLATFORMS=cpu \
+# 24 min: the suite has grown a subsystem per PR — PR 10's memwatch
+# default-on registrations plus two new test files pushed a loaded box
+# past the old 1140s budget (a fully-green run was killed at 93%)
+timeout -k 10 1440 env JAX_PLATFORMS=cpu \
     TFDE_GRAD_TRANSPORT="${TFDE_GRAD_TRANSPORT:-fp32}" \
     TFDE_OPT_SHARDING="${TFDE_OPT_SHARDING:-replicated}" \
     TFDE_PREFIX_CACHE="${TFDE_PREFIX_CACHE:-off}" \
     TFDE_TRACE="${TFDE_TRACE:-off}" \
+    TFDE_MEMWATCH="${TFDE_MEMWATCH:-on}" \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     --durations=10 \
@@ -69,6 +79,19 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     [ $rc -eq 0 ] && rc=1
 else
     echo "ROOFLINE_TILE_GATE=pass"
+fi
+# Memory & compile gate: one deterministic train+serve workload, per-site
+# jit-cache-miss counts and per-program peak bytes pinned against the
+# checked-in baseline (tools/memgate_baseline.json). A pad-ladder compile
+# regression or an HBM blow-up fails tier-1 here; re-baseline a
+# deliberate change with: python tools/memgate.py --update
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    TFDE_MEMWATCH="${TFDE_MEMWATCH:-on}" \
+    python tools/memgate.py --check; then
+    echo "MEMGATE=fail"
+    [ $rc -eq 0 ] && rc=1
+else
+    echo "MEMGATE=pass"
 fi
 if [ -f /tmp/_t1.passed ]; then
     prev=$(cat /tmp/_t1.passed)
