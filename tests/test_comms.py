@@ -15,6 +15,7 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from tfde_tpu.analysis import hlolint
 from tfde_tpu.models.cnn import PlainCNN
 from tfde_tpu.observability.sentry import (
     FLAG_COMM_OVERFLOW,
@@ -243,10 +244,6 @@ def test_int8_without_residual_falls_back(caplog):
     assert "comm_overflow" not in m  # fp32 path ran
 
 
-def _count(text, token):
-    return text.count(token)
-
-
 def test_int8_step_lowering_collective_count_and_no_callback():
     """The fixed-five-collectives guarantee, pinned from the lowered HLO:
     pmax + fp32-sidecar psum (all_reduce x2), int8 reduce_scatter x1,
@@ -256,12 +253,9 @@ def test_int8_step_lowering_collective_count_and_no_callback():
     gradient all-gather becomes a param all-gather (see
     test_sharded_step_lowering_collective_counts)."""
     step, state, batch = _cnn_setup("int8", opt_sharding="replicated")
-    text = step.jitted.lower(state, batch, jax.random.key(0)).as_text()
-    assert "callback" not in text
-    assert "outfeed" not in text
-    assert _count(text, '"stablehlo.all_reduce"') == 2, text.count("all_reduce")
-    assert _count(text, '"stablehlo.reduce_scatter"') == 1
-    assert _count(text, '"stablehlo.all_gather"') == 2
+    c = hlolint.census(step.jitted, state, batch, jax.random.key(0))
+    assert c.callbacks == 0
+    assert c.collective_counts == (2, 1, 2)
 
 
 def test_int8_collective_count_independent_of_grad_accum():
@@ -269,10 +263,8 @@ def test_int8_collective_count_independent_of_grad_accum():
     collective count must not scale with grad_accum."""
     step, state, batch = _cnn_setup("int8", grad_accum=4,
                                     opt_sharding="replicated")
-    text = step.jitted.lower(state, batch, jax.random.key(0)).as_text()
-    assert _count(text, '"stablehlo.all_reduce"') == 2
-    assert _count(text, '"stablehlo.reduce_scatter"') == 1
-    assert _count(text, '"stablehlo.all_gather"') == 2
+    c = hlolint.census(step.jitted, state, batch, jax.random.key(0))
+    assert c.collective_counts == (2, 1, 2)
 
 
 def test_sharded_step_lowering_collective_counts():
@@ -283,15 +275,12 @@ def test_sharded_step_lowering_collective_counts():
     all-gather of the replicated int8 path is REPLACED by the updated-
     param all-gather (grad_norm rides its payload), so every combo stays
     within PR 5's five-collective budget — and no host callback."""
-    for transport, ar, rs, ag in [("fp32", 1, 1, 1), ("int8", 2, 1, 1)]:
+    for transport, budget in [("fp32", (1, 1, 1)), ("int8", (2, 1, 1))]:
         step, state, batch = _cnn_setup(transport, opt_sharding="shard")
         assert state.opt_sharded
-        text = step.jitted.lower(state, batch, jax.random.key(0)).as_text()
-        assert "callback" not in text
-        assert "outfeed" not in text
-        assert _count(text, '"stablehlo.all_reduce"') == ar, transport
-        assert _count(text, '"stablehlo.reduce_scatter"') == rs, transport
-        assert _count(text, '"stablehlo.all_gather"') == ag, transport
+        c = hlolint.census(step.jitted, state, batch, jax.random.key(0))
+        assert c.callbacks == 0
+        assert c.collective_counts == budget, transport
 
 
 def test_explicit_replicated_pin_keeps_int8_budget_exact(monkeypatch):
@@ -303,10 +292,8 @@ def test_explicit_replicated_pin_keeps_int8_budget_exact(monkeypatch):
     monkeypatch.delenv(zero.ENV_OPT_SHARDING, raising=False)
     step, state, batch = _cnn_setup("int8", opt_sharding="replicated")
     assert not state.opt_sharded
-    text = step.jitted.lower(state, batch, jax.random.key(0)).as_text()
-    assert _count(text, '"stablehlo.all_reduce"') == 2
-    assert _count(text, '"stablehlo.reduce_scatter"') == 1
-    assert _count(text, '"stablehlo.all_gather"') == 2
+    c = hlolint.census(step.jitted, state, batch, jax.random.key(0))
+    assert c.collective_counts == (2, 1, 2)
 
 
 def test_int8_step_runs_and_reports_comm_metrics():

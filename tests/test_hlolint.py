@@ -1,0 +1,221 @@
+"""Lowered-program linter (tfde_tpu/analysis/hlolint.py): the census
+helper against the pinned collective budgets, donation survival and the
+dropped-donation violation, seeded host-callback / f64 / large-constant
+programs failing the lint, the text-level census mechanics, and the
+offer/collect registration seam that tools/lintgate.py drains.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tfde_tpu.analysis import hlolint
+from tfde_tpu.models.cnn import PlainCNN
+from tfde_tpu.parallel.strategies import MirroredStrategy
+from tfde_tpu.runtime.mesh import make_mesh
+from tfde_tpu.training.step import init_state, make_train_step
+
+
+def _cnn_step(transport, opt_sharding, donate=False):
+    strategy = MirroredStrategy(
+        mesh=make_mesh({"data": -1}, jax.devices()[:4]),
+        grad_transport=transport, opt_sharding=opt_sharding)
+    rng = np.random.default_rng(0)
+    images = rng.random((16, 784), np.float32)
+    labels = rng.integers(0, 10, (16, 1)).astype(np.int32)
+    state, _ = init_state(PlainCNN(), optax.sgd(0.1), strategy, images)
+    step = make_train_step(strategy, state, donate=donate)
+    return getattr(step, "jitted", step), state, (images, labels)
+
+
+# -- census vs the pinned budgets ---------------------------------------------
+def test_census_int8_replicated_budget():
+    """The PR 5 budget triple for the quantized replicated exchange —
+    and the payload-byte side the string pins never had: the int8
+    reduce-scatter + all-gather must dominate the fp32 sidecar psum."""
+    jitted, state, batch = _cnn_step("int8", "replicated")
+    c = hlolint.census(jitted, state, batch, jax.random.key(0))
+    assert c.collective_counts == (2, 1, 2)
+    assert c.callbacks == 0
+    assert c.f64_tensors == 0
+    assert c.large_constants == []
+    # payload bytes: every counted collective carries a nonzero payload
+    for kind in ("all_reduce", "reduce_scatter", "all_gather"):
+        assert c.collective_bytes[kind] > 0, c.collective_bytes
+    # the two all-reduces are the tiny fp32 sidecar + pmax scale probe;
+    # the compressed grad vector rides the reduce-scatter/all-gather
+    assert c.collective_bytes["all_reduce"] < c.collective_bytes["all_gather"]
+
+
+@pytest.mark.parametrize("transport,sharding,budget", [
+    ("fp32", "shard", (1, 1, 1)),
+    ("int8", "shard", (2, 1, 1)),
+])
+def test_census_sharded_budgets(transport, sharding, budget):
+    jitted, state, batch = _cnn_step(transport, sharding)
+    c = hlolint.census(jitted, state, batch, jax.random.key(0))
+    assert c.collective_counts == budget
+    assert c.callbacks == 0
+
+
+# -- donation -----------------------------------------------------------------
+def test_donation_survives_and_lints_clean():
+    jitted, state, batch = _cnn_step("int8", "replicated", donate=True)
+    rep = hlolint.lint("t", jitted, (state, batch, jax.random.key(0)),
+                       donated=state)
+    assert rep.ok, rep.violations
+    assert rep.census.aliased_outputs > 0
+
+
+def test_dropped_donation_is_a_violation():
+    """donate_argnums on an arg whose shape matches no output: XLA drops
+    the alias and the linter must say so."""
+
+    dn = jax.jit(lambda x: jnp.sum(x, axis=0), donate_argnums=(0,))
+    x = jnp.ones((8, 8), jnp.float32)
+    with pytest.warns(UserWarning, match="donated buffers were not usable"):
+        rep = hlolint.lint("shrink", dn, (x,), donated=x)
+    assert not rep.ok
+    assert "donation was dropped" in rep.violations[0]
+    # the same program with donation undeclared is clean
+    rep2 = hlolint.lint("shrink", jax.jit(lambda x: jnp.sum(x, axis=0)), (x,))
+    assert rep2.ok
+
+
+# -- seeded violations --------------------------------------------------------
+def test_host_callback_is_a_violation_unless_allowed():
+    def poll(x):
+        flag = jax.pure_callback(
+            lambda v: np.asarray(float(v) > 0, np.float32),
+            jax.ShapeDtypeStruct((), jnp.float32), jnp.sum(x))
+        return x * flag
+
+    cb = jax.jit(poll)
+    args = (jnp.ones((4, 4), jnp.float32),)
+    rep = hlolint.lint("poll", cb, args)
+    assert not rep.ok
+    assert "host-callback" in rep.violations[0]
+    assert "ALLOW" in rep.violations[0]  # the message names the escape hatch
+    # an explicit per-program allowance clears it
+    allowed = hlolint.lint(
+        "poll", cb, args,
+        policy=hlolint.Policy(allow_callbacks=rep.census.callbacks))
+    assert allowed.ok, allowed.violations
+
+
+def test_f64_leaf_is_a_violation():
+    text = ('func.func @main(%arg0: tensor<4xf64>) -> tensor<4xf64> {\n'
+            '  return %arg0 : tensor<4xf64>\n}\n')
+    rep = hlolint.lint("dbl", text=text)
+    assert not rep.ok
+    assert "f64" in rep.violations[0]
+    assert hlolint.lint(
+        "dbl", text=text, policy=hlolint.Policy(allow_f64=True)).ok
+
+
+def test_large_constant_is_a_violation():
+    text = ('%0 = stablehlo.constant dense_resource<w> : tensor<512x1024xf32>\n'
+            '%1 = stablehlo.constant dense<0.0> : tensor<4xf32>\n')
+    rep = hlolint.lint("tbl", text=text)
+    assert len(rep.census.large_constants) == 1
+    assert rep.census.large_constants[0][0] == 512 * 1024 * 4
+    assert not rep.ok and "constant" in rep.violations[0]
+    # raising the threshold past the table clears it
+    assert hlolint.lint("tbl", text=text, policy=hlolint.Policy(
+        max_constant_bytes=4 << 20)).ok
+
+
+# -- text-level census mechanics ----------------------------------------------
+def test_census_text_counts_and_payload_bytes():
+    text = (
+        '%0 = "stablehlo.all_reduce"(%a) ({...}) : '
+        '(tensor<100xf32>) -> tensor<100xf32>\n'
+        '%1 = "stablehlo.all_reduce"(%b) ({...}) : '
+        '(tensor<2x3xf32>) -> tensor<2x3xf32>\n'
+        '%2 = "stablehlo.reduce_scatter"(%c) ({...}) : '
+        '(tensor<64xi8>) -> tensor<16xi8>\n'
+        '%3 = stablehlo.convert %d : (tensor<8xbf16>) -> tensor<8xf32>\n'
+    )
+    c = hlolint.census_text(text)
+    assert c.collective_counts == (2, 1, 0)
+    assert c.collective_bytes["all_reduce"] == 400 + 24  # result bytes
+    assert c.collective_bytes["reduce_scatter"] == 16
+    assert c.bf16_to_f32_converts == 1
+    assert c.callbacks == 0 and c.f64_tensors == 0
+
+
+def test_census_text_pretty_print_fallback():
+    # non-generic spelling (no quotes) must still be counted
+    text = '%0 = stablehlo.all_gather %x : tensor<8xf32> -> tensor<32xf32>\n'
+    assert hlolint.census_text(text).all_gather == 1
+
+
+# -- the registration seam ----------------------------------------------------
+def test_offer_collect_seam_arm_disarm():
+    hlolint.reset()
+    try:
+        f = jax.jit(lambda x: x * 2)
+        x = jnp.ones((4,), jnp.float32)
+        # disarmed: offers vanish
+        hlolint.arm(False)
+        hlolint.offer("off/one", f, (x,))
+        assert hlolint.offers() == ()
+        # armed: recorded once, deduped, collectable
+        hlolint.arm(True)
+        hlolint.offer("on/one", f, (x,))
+        hlolint.offer("on/one", f, (x,))
+        assert hlolint.offers() == ("on/one",)
+        reports = hlolint.collect()
+        assert reports["on/one"].ok
+        assert reports["on/one"].census.callbacks == 0
+    finally:
+        hlolint.reset()
+
+
+def test_offer_snapshot_outlives_donated_buffer():
+    """The memwatch-seam contract: lowering at collect() time must work
+    from avals even after the offered buffers are deleted."""
+    hlolint.reset()
+    try:
+        hlolint.arm(True)
+        f = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+        x = jnp.ones((16,), jnp.float32)
+        hlolint.offer("donated/add", f, (x,), donated=x)
+        f(x)  # consumes x
+        x.delete()
+        reports = hlolint.collect()
+        assert reports["donated/add"].ok, reports["donated/add"].violations
+        assert reports["donated/add"].census.aliased_outputs == 1
+    finally:
+        hlolint.reset()
+
+
+def test_collect_reports_dropped_donation_from_offer():
+    hlolint.reset()
+    try:
+        hlolint.arm(True)
+        dn = jax.jit(lambda x: jnp.sum(x, axis=0), donate_argnums=(0,))
+        x = jnp.ones((8, 8), jnp.float32)
+        hlolint.offer("donated/shrink", dn, (x,), donated=x)
+        with pytest.warns(UserWarning, match="donated buffers were not usable"):
+            reports = hlolint.collect()
+        assert not reports["donated/shrink"].ok
+        assert "donation was dropped" in reports["donated/shrink"].violations[0]
+    finally:
+        hlolint.reset()
+
+
+def test_offer_never_raises_when_disarmed_or_on_bad_input():
+    hlolint.reset()
+    try:
+        hlolint.arm(True)
+        hlolint.offer("bad/none", None, (object(),))  # snapshot-proof leaf
+        # the offer is recorded (object() passes through _aval as-is) and
+        # collect() turns the lowering failure into a violation, not a raise
+        reports = hlolint.collect()
+        assert not reports["bad/none"].ok
+        assert "could not lower" in reports["bad/none"].violations[0]
+    finally:
+        hlolint.reset()

@@ -210,6 +210,24 @@ def test_comm_bytes_accounts_param_gather_leg():
     assert sh["param_gather"] > 0.0
 
 
+def test_sharded_step_census_budget_and_payloads():
+    """The lowered sharded step through the census helper
+    (analysis/hlolint.py — the tools/lintgate.py pin): the ZeRO budget
+    triple at 8-way, no host callback, and the wire asymmetry the packed
+    layout promises — the full-param all-gather result outweighs the
+    1/8-shard reduce-scatter result."""
+    from tfde_tpu.analysis import hlolint
+
+    _, state, step, batch = _setup("shard")
+    assert state.opt_sharded
+    c = hlolint.census(step.jitted, state, batch, jax.random.key(0))
+    assert c.collective_counts == (1, 1, 1)
+    assert c.callbacks == 0
+    assert c.f64_tensors == 0
+    assert c.collective_bytes["all_gather"] > c.collective_bytes[
+        "reduce_scatter"]
+
+
 # -- eligibility fallbacks ----------------------------------------------------
 def test_fsdp_falls_back_to_replicated(caplog):
     if len(jax.devices()) < 2:

@@ -34,5 +34,11 @@ try:
 except AttributeError:
     pass
 
+# Surface TFDE_* typos (unregistered names in the environment) at import,
+# before any knob read silently runs a default the operator didn't ask for.
+from tfde_tpu import knobs as _knobs
+
+_knobs.warn_unknown_env()
+
 from tfde_tpu.runtime.mesh import MeshSpec, make_mesh  # noqa: F401
 from tfde_tpu.runtime.cluster import ClusterInfo, bootstrap  # noqa: F401

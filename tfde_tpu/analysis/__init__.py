@@ -1,0 +1,17 @@
+"""Static analysis over the framework's lowered programs and source tree.
+
+Two passes, both gated in tier-1 by `tools/lintgate.py`:
+
+- `analysis.hlolint` — the lowered-program linter: census every
+  collective (count + payload bytes), verify declared donations survive
+  to output aliases, ban host callbacks outside an allow-list, enforce
+  the dtype policy (no f64, surface bf16->f32 upcasts), and flag large
+  replicated constants. The same helper backs the HLO pins in
+  `tests/test_comms.py`/`tests/test_zero.py`.
+- `tools/tfdelint.py` — the AST project lint (lock discipline for
+  threaded classes, greedy-path `jax.random.split` ban, TFDE_* knob
+  audit against `tfde_tpu/knobs.py`). Lives in tools/ because it reads
+  the source tree, not programs.
+"""
+
+from tfde_tpu.analysis import hlolint  # noqa: F401

@@ -1,0 +1,413 @@
+"""Lowered-program linter: invariant census over StableHLO text.
+
+Given any jitted callable + example args, lower it (trace only — no
+compile, no execution) and extract the invariants the repo used to pin
+ad hoc in scattered tests:
+
+- **Collective census** — all-reduce / reduce-scatter / all-gather /
+  collective-permute counts AND payload bytes (result-tensor bytes per
+  occurrence). Counting uses the same quoted-token convention the old
+  `tests/test_comms.py` string pins used (``'"stablehlo.all_reduce"'``),
+  so migrated budgets are bit-compatible, with a fallback to the pretty
+  non-generic spelling for ops StableHLO prints unquoted.
+- **Donation verification** — declared `donate_argnums` must survive to
+  ``tf.aliasing_output`` attributes in the lowered module; a program
+  that declares donation but aliases nothing has silently lost its
+  in-place update (double memory at runtime).
+- **Host-callback ban** — ``callback``/``outfeed``/``infeed`` markers
+  mean a host round-trip inside a hot program. Allowed only by explicit
+  per-program allowance (the sentry flag poll and the roofline tile
+  counter are the two legitimate users in this codebase, and both keep
+  their callbacks OUT of the fused step by design — so the default
+  allowance is zero).
+- **Dtype policy** — no f64 tensor anywhere (a silent x2 on bytes and
+  a ~10x on TPU throughput), and a census of bf16→f32 converts so an
+  activation-path upcast shows up as a baseline diff (deliberate logit
+  upcasts exist, so converts are counted, not banned).
+- **Large replicated constants** — a ``stablehlo.constant`` above the
+  threshold is a table baked into the program (replicated on every
+  device and re-shipped on every donation miss); it should be an
+  argument instead.
+
+Two consumption modes:
+
+1. Direct: ``census(fn, *args)`` / ``lint(name, fn, args, ...)`` — used
+   by tests and by `tools/lintgate.py`'s constructed train-step matrix.
+2. The registration seam: `lifecycle.py` (train_step first compile) and
+   `server.py._mem_register` (decode scan, cold/warm/primed prefill
+   waves) call :func:`offer` with the same (fn, args, donated) they hand
+   to memwatch. Offers are recorded only when the seam is armed
+   (``TFDE_HLOLINT=1`` or :func:`arm`) — zero cost in normal runs — and
+   interrogated lazily by :func:`collect`, so the linter sees exactly
+   the hot programs the process actually compiled, at the shapes it
+   compiled them.
+
+Arguments are snapshotted as avals (`jax.ShapeDtypeStruct`, sharding
+preserved) at offer time: donated buffers are deleted after the real
+call, but lowering needs only shapes/dtypes/shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from tfde_tpu import knobs
+
+log = logging.getLogger(__name__)
+
+#: collective ops censused, in (field, stablehlo op) pairs
+_COLLECTIVES = (
+    ("all_reduce", "stablehlo.all_reduce"),
+    ("reduce_scatter", "stablehlo.reduce_scatter"),
+    ("all_gather", "stablehlo.all_gather"),
+    ("collective_permute", "stablehlo.collective_permute"),
+)
+
+#: bytes per element for MLIR tensor element types (i1 counts a byte —
+#: that is what a packed predicate costs in practice on TPU)
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1,
+    "f8E4M3FN": 1, "f8E5M2": 1,
+}
+
+_TENSOR_RE = re.compile(r"tensor<(?:([0-9]+(?:x[0-9]+)*)x)?([a-zA-Z][a-zA-Z0-9]*)>")
+_F64_RE = re.compile(r"tensor<(?:[0-9]+(?:x[0-9]+)*x)?f64>")
+_CONVERT_RE = re.compile(
+    r"stablehlo\.convert[^\n]*:\s*\(tensor<[^>]*bf16>\)\s*->\s*tensor<[^>]*f32>")
+_CONST_RE = re.compile(
+    r"stablehlo\.constant[^\n]*?:\s*(tensor<[^>]+>)")
+
+#: default large-constant threshold: 1 MiB baked into the program text
+LARGE_CONSTANT_BYTES = 1 << 20
+
+
+def _tensor_bytes(type_str: str) -> int:
+    """``tensor<4x784xf32>`` -> 12544. Unknown element types count 0."""
+    m = _TENSOR_RE.search(type_str)
+    if not m:
+        return 0
+    dims, elem = m.groups()
+    n = 1
+    if dims:
+        for d in dims.split("x"):
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(elem, 0)
+
+
+def _result_types(text: str, start: int) -> str:
+    """The result-type tail of the op whose name starts at `start`:
+    scan forward to the first top-level ``-> `` and return the rest of
+    that line. Handles both the generic region form (``}) : (...) ->
+    ...``) and single-line ops (``... : (...) -> tensor<...>``)."""
+    arrow = text.find("-> ", start)
+    if arrow < 0:
+        return ""
+    eol = text.find("\n", arrow)
+    return text[arrow + 3:eol if eol > 0 else len(text)]
+
+
+@dataclasses.dataclass
+class Census:
+    """One lowered program's invariant census. Counts use the exact
+    quoted-token convention of the legacy test pins."""
+
+    all_reduce: int = 0
+    reduce_scatter: int = 0
+    all_gather: int = 0
+    collective_permute: int = 0
+    #: payload = result-tensor bytes summed over occurrences, per kind
+    collective_bytes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: host-boundary markers: 'callback' + 'outfeed' + 'infeed' tokens
+    callbacks: int = 0
+    #: count of tf.aliasing_output attrs (donations that survived)
+    aliased_outputs: int = 0
+    f64_tensors: int = 0
+    bf16_to_f32_converts: int = 0
+    #: [(bytes, "tensor<...>")] constants above LARGE_CONSTANT_BYTES
+    large_constants: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def collective_counts(self) -> Tuple[int, int, int]:
+        """(all_reduce, reduce_scatter, all_gather) — the budget triple
+        the comms/ZeRO tests pin."""
+        return (self.all_reduce, self.reduce_scatter, self.all_gather)
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["large_constants"] = [[b, t] for b, t in self.large_constants]
+        return d
+
+
+def census_text(text: str) -> Census:
+    """Walk one lowered module's text and extract the census."""
+    c = Census()
+    for field, op in _COLLECTIVES:
+        quoted = f'"{op}"'
+        count = text.count(quoted)
+        token = quoted
+        if count == 0:
+            # pretty (non-generic) print: `stablehlo.all_gather %x ...`
+            token = op + " "
+            count = text.count(token)
+        setattr(c, field, count)
+        payload = 0
+        pos = 0
+        for _ in range(count):
+            pos = text.find(token, pos)
+            if pos < 0:
+                break
+            payload += _tensor_bytes(_result_types(text, pos))
+            pos += len(token)
+        if count:
+            c.collective_bytes[field] = payload
+    c.callbacks = (text.count("callback") + text.count("outfeed")
+                   + text.count("infeed"))
+    c.aliased_outputs = text.count("tf.aliasing_output")
+    c.f64_tensors = len(_F64_RE.findall(text))
+    c.bf16_to_f32_converts = len(_CONVERT_RE.findall(text))
+    for m in _CONST_RE.finditer(text):
+        nbytes = _tensor_bytes(m.group(1))
+        if nbytes >= LARGE_CONSTANT_BYTES:
+            c.large_constants.append((nbytes, m.group(1)))
+    return c
+
+
+def lower_text(fn, args=(), kwargs=None) -> str:
+    """Lower a jitted callable (or a functools.partial over one) at the
+    given args and return the StableHLO module text. Lowering only — the
+    program is never compiled or run — under `recompile.suppress()` so
+    lint-time traces never count against the jit-cache-miss sentinel."""
+    from tfde_tpu.observability import recompile
+
+    kwargs = kwargs or {}
+    if isinstance(fn, functools.partial):
+        inner, bound_args, bound_kw = fn.func, fn.args, dict(fn.keywords)
+        bound_kw.update(kwargs)
+        args, kwargs, fn = (*bound_args, *args), bound_kw, inner
+    if not hasattr(fn, "lower"):
+        raise TypeError(
+            f"{fn!r} is not a jitted callable (no .lower); wrap it in "
+            f"jax.jit or pass the jitted attribute")
+    with recompile.suppress():
+        return fn.lower(*args, **kwargs).as_text()
+
+
+def census(fn, *args, **kwargs) -> Census:
+    """Lower + census in one call — the helper `tests/test_comms.py` /
+    `tests/test_zero.py` consume instead of private string matching."""
+    return census_text(lower_text(fn, args, kwargs))
+
+
+# -- lint policy --------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Per-program lint policy. The defaults are the house invariants;
+    per-program exceptions are declared in an allow-table, never by
+    loosening the default."""
+
+    #: host-boundary markers tolerated in this program (the allow-list:
+    #: sentry flag poll / roofline tile counter programs declare theirs)
+    allow_callbacks: int = 0
+    #: f64 is never OK on TPU-shaped programs
+    allow_f64: bool = False
+    #: constants at/above this many bytes are violations
+    max_constant_bytes: int = LARGE_CONSTANT_BYTES
+    #: when the program declares donation, at least one output alias
+    #: must survive lowering
+    require_donation_aliases: bool = True
+
+
+#: program-name -> Policy exceptions. The ONLY legitimate host-callback
+#: users keep their callbacks out of the registered hot programs today,
+#: so this table is empty — it exists so the next exception is an
+#: explicit, reviewable line instead of a loosened default.
+ALLOW: Dict[str, Policy] = {}
+
+
+@dataclasses.dataclass
+class Report:
+    """One linted program: its census plus any policy violations."""
+
+    name: str
+    census: Census
+    violations: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "census": self.census.as_dict(),
+                "violations": list(self.violations)}
+
+
+def _count_donated_leaves(donated) -> int:
+    import jax
+
+    return sum(1 for leaf in jax.tree_util.tree_leaves(donated)
+               if hasattr(leaf, "shape"))
+
+
+def lint(name: str, fn=None, args=(), kwargs=None, donated=None,
+         policy: Optional[Policy] = None, text: Optional[str] = None) -> Report:
+    """Lint one program. Pass either the jitted `fn` + `args` or a
+    pre-lowered `text`. `donated` is the pytree the caller declared via
+    `donate_argnums` (None = program donates nothing)."""
+    policy = policy or ALLOW.get(name, Policy())
+    if text is None:
+        text = lower_text(fn, args, kwargs)
+    c = census_text(text)
+    violations: List[str] = []
+    if c.callbacks > policy.allow_callbacks:
+        violations.append(
+            f"{name}: {c.callbacks} host-callback marker(s) in lowered "
+            f"program (allowance {policy.allow_callbacks}) — a host "
+            f"round-trip inside a hot program; if deliberate, add an "
+            f"analysis.hlolint.ALLOW entry for {name!r}")
+    if not policy.allow_f64 and c.f64_tensors:
+        violations.append(
+            f"{name}: {c.f64_tensors} f64 tensor(s) in lowered program — "
+            f"the dtype policy bans f64 (silent 2x bytes; cast the "
+            f"offending input or enable jax_enable_x64 nowhere)")
+    donated_leaves = _count_donated_leaves(donated)
+    if (policy.require_donation_aliases and donated_leaves
+            and c.aliased_outputs == 0):
+        violations.append(
+            f"{name}: declares {donated_leaves} donated buffer(s) but "
+            f"lowered program aliases 0 outputs — donation was dropped "
+            f"(shape/dtype mismatch between donated input and output, or "
+            f"the donated arg is unused); the program will hold both "
+            f"copies live")
+    for nbytes, type_str in c.large_constants:
+        if nbytes >= policy.max_constant_bytes:
+            violations.append(
+                f"{name}: {nbytes}-byte constant {type_str} baked into "
+                f"the program (threshold {policy.max_constant_bytes}) — "
+                f"replicated on every device; pass it as an argument")
+    return Report(name=name, census=c, violations=violations)
+
+
+# -- the registration seam ----------------------------------------------------
+@dataclasses.dataclass
+class _Offer:
+    name: str
+    fn: Any
+    args: Tuple
+    kwargs: Dict
+    donated_leaves: int
+
+
+_lock = threading.Lock()
+_offers: Dict[str, _Offer] = {}
+_armed: Optional[bool] = None  # None = defer to TFDE_HLOLINT
+
+
+def armed() -> bool:
+    """Whether :func:`offer` records anything. Defaults to the
+    ``TFDE_HLOLINT`` flag (off: the seam costs one dict probe)."""
+    if _armed is not None:
+        return _armed
+    return knobs.env_flag("TFDE_HLOLINT")
+
+
+def arm(on: bool = True) -> None:
+    """Explicitly arm/disarm the seam (overrides TFDE_HLOLINT)."""
+    global _armed
+    _armed = on
+
+
+def reset() -> None:
+    """Drop recorded offers and the explicit arm state (tests)."""
+    global _armed
+    with _lock:
+        _offers.clear()
+    _armed = None
+
+
+def _aval(leaf):
+    import jax
+
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return leaf  # static / non-array leaf: keep as-is
+    try:
+        # keep the sharding only when it actually constrains placement
+        # (committed / mesh-sharded arrays); an uncommitted leaf's
+        # default single-device sharding would conflict with the rest
+        sharding = getattr(leaf, "sharding", None)
+        committed = getattr(leaf, "_committed", False)
+        if sharding is not None and (
+                committed or isinstance(sharding, jax.sharding.NamedSharding)):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+        return jax.ShapeDtypeStruct(shape, dtype)
+    except Exception:  # noqa: BLE001 — exotic leaf: lower with it live
+        return leaf
+
+
+def offer(name: str, fn, args=(), kwargs=None, donated=None) -> None:
+    """Record one hot program for later interrogation. Called from the
+    same seams that feed memwatch (`lifecycle.py` train_step first
+    compile, `server.py._mem_register`), with the same (fn, args,
+    donated). No-op unless :func:`armed`; args are snapshotted as avals
+    so the offer stays valid after the donated buffers die. Never
+    raises — the seam must not take the caller down."""
+    if not armed():
+        return
+    with _lock:
+        if name in _offers:
+            return
+    try:
+        import jax
+
+        a = tuple(jax.tree_util.tree_map(_aval, tuple(args)))
+        k = {key: jax.tree_util.tree_map(_aval, val)
+             for key, val in (kwargs or {}).items()}
+        o = _Offer(name=name, fn=fn, args=a, kwargs=k,
+                   donated_leaves=_count_donated_leaves(donated))
+    except Exception as e:  # noqa: BLE001
+        log.warning("hlolint: could not snapshot offer %s: %s", name, e)
+        return
+    with _lock:
+        _offers.setdefault(name, o)
+
+
+def offers() -> Tuple[str, ...]:
+    with _lock:
+        return tuple(sorted(_offers))
+
+
+def collect() -> Dict[str, Report]:
+    """Lint every recorded offer; returns {name: Report}. A program that
+    fails to lower reports that as its violation rather than raising —
+    the gate should show every program's status, not stop at the first."""
+    with _lock:
+        pending = list(_offers.values())
+    out: Dict[str, Report] = {}
+    for o in pending:
+        try:
+            rep = lint(o.name, o.fn, o.args, o.kwargs,
+                       policy=ALLOW.get(o.name))
+            # donated pytrees are snapshotted as a leaf count at offer
+            # time (the buffers are long dead); apply the dropped-
+            # donation check from that count
+            if (o.donated_leaves and rep.census.aliased_outputs == 0
+                    and ALLOW.get(o.name, Policy()).require_donation_aliases):
+                rep.violations.append(
+                    f"{o.name}: declares {o.donated_leaves} donated "
+                    f"buffer(s) but lowered program aliases 0 outputs — "
+                    f"donation was dropped")
+        except Exception as e:  # noqa: BLE001
+            rep = Report(name=o.name, census=Census(),
+                         violations=[f"{o.name}: could not lower for "
+                                     f"lint: {e}"])
+        out[o.name] = rep
+    return out

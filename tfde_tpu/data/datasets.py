@@ -24,10 +24,12 @@ from typing import Tuple
 
 import numpy as np
 
+from tfde_tpu import knobs
+
 Arrays = Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]
 
 _SEARCH_DIRS = [
-    lambda: os.environ.get("TFDE_DATA_DIR"),
+    lambda: knobs.env_str("TFDE_DATA_DIR"),
     lambda: os.path.expanduser("~/.keras/datasets"),
     lambda: "/tmp/data",
 ]
@@ -248,7 +250,7 @@ def download(name: str, dest_dir: str = None, timeout: float = 600.0) -> str:
     spec = _DOWNLOADS[name]
     dest = Path(
         dest_dir
-        or os.environ.get("TFDE_DATA_DIR")
+        or knobs.env_str("TFDE_DATA_DIR")
         or os.path.expanduser("~/.keras/datasets")
     )
     dest.mkdir(parents=True, exist_ok=True)

@@ -39,6 +39,7 @@ recomputed), segments, evictions — the serving runbook's first stop
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Optional
 
 import jax
@@ -315,6 +316,11 @@ def resolve(spec) -> Optional[PrefixCache]:
         try:
             return PrefixCache(byte_budget=int(spec))
         except ValueError:
+            warnings.warn(
+                f"TFDE_PREFIX_CACHE={spec!r} is not a recognized value "
+                f"(off/on/<int byte budget>); prefix cache stays off",
+                stacklevel=2,
+            )
             return None
     if isinstance(spec, PrefixCache):
         return spec

@@ -82,6 +82,7 @@ from tfde_tpu.inference.prefix_cache import (
     resolve as _resolve_prefix,
 )
 from tfde_tpu.inference.speculative import _set_index_counters
+from tfde_tpu.analysis import hlolint as _hlolint
 from tfde_tpu.observability import memwatch as _memwatch
 from tfde_tpu.observability import metrics
 from tfde_tpu.observability import recompile as _recompile
@@ -724,10 +725,15 @@ class _BatcherBase:
         (program name, shape signature) per batcher — publishes the
         mem/<name>/* peak/argument/output gauges for every pad-ladder
         bucket the server actually compiles."""
-        if name in self._mem_programs or not _memwatch.enabled():
+        if name in self._mem_programs:
             return
         self._mem_programs.add(name)
-        _memwatch.register(name, fn, args=args, donated=donated)
+        # the linter rides the same seam: every pad-ladder bucket the
+        # server compiles is offered for interrogation (no-op unless
+        # armed — tools/lintgate.py / TFDE_HLOLINT)
+        _hlolint.offer(name, fn, args=args, donated=donated)
+        if _memwatch.enabled():
+            _memwatch.register(name, fn, args=args, donated=donated)
 
     def _cold_wave(self, bucket: int, group, rows) -> np.ndarray:
         n = len(group)

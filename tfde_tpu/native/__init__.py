@@ -21,6 +21,8 @@ from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tfde_tpu import knobs
+
 log = logging.getLogger(__name__)
 
 _SRC = Path(__file__).parent / "loader.cc"
@@ -33,7 +35,7 @@ def _build() -> Optional[ctypes.CDLL]:
     src = _SRC.read_bytes()
     tag = hashlib.sha256(src).hexdigest()[:16]
     cache_dir = Path(
-        os.environ.get("TFDE_NATIVE_CACHE", Path.home() / ".cache" / "tfde_tpu")
+        knobs.env_str("TFDE_NATIVE_CACHE") or Path.home() / ".cache" / "tfde_tpu"
     )
     cache_dir.mkdir(parents=True, exist_ok=True)
     so = cache_dir / f"loader_{tag}.so"

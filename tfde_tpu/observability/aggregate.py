@@ -245,16 +245,26 @@ class ClusterAggregator:
                "stale_hosts": sorted(stale), "straggler_host": -1,
                "straggler_ratio": 0.0, "host_medians_ms": {}}
 
-        for hid, age in stale.items():
-            if hid not in self._known_stale:
-                self._known_stale.add(hid)
-                log.warning("cluster: host %d stale (last push %.1fs ago)",
-                            hid, age)
-                try:
-                    self._on_stale(hid, age)
-                except Exception:
-                    log.exception("on_stale callback failed")
-        self._known_stale &= set(stale)  # re-arm when a host comes back
+        # Edge-detection state (_known_stale / _flagged_straggler below) is
+        # mutated under the lock: rollup() runs concurrently from every
+        # /push handler thread, and unlocked read-modify-writes here can
+        # double-fire callbacks or lose the re-arm. Callbacks themselves
+        # fire AFTER release — a slow or re-entrant on_stale must not hold
+        # the aggregator's lock. (tools/tfdelint.py lock-discipline rule.)
+        fire_stale = []
+        with self._lock:
+            for hid, age in stale.items():
+                if hid not in self._known_stale:
+                    self._known_stale.add(hid)
+                    fire_stale.append((hid, age))
+            self._known_stale &= set(stale)  # re-arm when a host comes back
+        for hid, age in fire_stale:
+            log.warning("cluster: host %d stale (last push %.1fs ago)",
+                        hid, age)
+            try:
+                self._on_stale(hid, age)
+            except Exception:
+                log.exception("on_stale callback failed")
 
         if medians:
             cluster_med = _median(list(medians.values()))
@@ -273,7 +283,12 @@ class ClusterAggregator:
             g("cluster/straggler_host").set(straggler)
             g("cluster/straggler_ratio").set(ratio)
             out["straggler_host"], out["straggler_ratio"] = straggler, ratio
-            if straggler >= 0 and straggler != self._flagged_straggler:
+            with self._lock:
+                fire = (straggler >= 0
+                        and straggler != self._flagged_straggler)
+                self._flagged_straggler = (straggler if straggler >= 0
+                                           else None)
+            if fire:
                 log.warning(
                     "cluster: host %d straggling (%.1fx the cluster median "
                     "step time)", straggler, ratio,
@@ -282,7 +297,6 @@ class ClusterAggregator:
                     self._on_straggler(straggler, ratio)
                 except Exception:
                     log.exception("on_straggler callback failed")
-            self._flagged_straggler = straggler if straggler >= 0 else None
         return out
 
     # -- exposition ----------------------------------------------------------

@@ -24,11 +24,11 @@ Targets come from the constructor or the environment
 from __future__ import annotations
 
 import collections
-import os
 import threading
 import time
 from typing import Dict, Optional, Sequence
 
+from tfde_tpu import knobs
 from tfde_tpu.observability import metrics
 
 DEFAULT_TTFT_MS = 500.0
@@ -41,13 +41,9 @@ MAX_SAMPLES = 65536
 
 
 def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
+    # central registry parse: a non-numeric value warns once and falls
+    # back, instead of silently running the default (tfde_tpu/knobs.py)
+    return float(knobs.env_float(name, default))
 
 
 class SLOTracker:

@@ -921,7 +921,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, window, scale,
 
 def _bwd(causal, block_q, block_k, interpret, window, scale, logit_cap,
          res, g):
-    import os
+    from tfde_tpu import knobs
 
     # default 'jax' (blockwise): the r04 hardware A/B (tools/flash_ab.py,
     # v5e) times it at 1.15-1.30x of the XLA reference einsum while the
@@ -929,7 +929,7 @@ def _bwd(causal, block_q, block_k, interpret, window, scale, logit_cap,
     # prefetch maps — lands at 0.6-0.73x. Same O(S) memory either way;
     # TFDE_FLASH_BWD=pallas keeps the kernel pair selectable.
     q, k = res[0], res[1]
-    if (os.environ.get("TFDE_FLASH_BWD", "jax") == "pallas"
+    if (knobs.env_choice("TFDE_FLASH_BWD") == "pallas"
             and k.shape[2] == q.shape[2]):
         # the kernel pair is MHA-only (its dK/dV out specs are per-q-head;
         # GQA would need a cross-head reduction) — GQA always takes the

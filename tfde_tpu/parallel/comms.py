@@ -61,6 +61,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from tfde_tpu import knobs
 from tfde_tpu.ops import quant as quant_lib
 from tfde_tpu.parallel import sharding as shd
 
@@ -111,7 +112,10 @@ def resolve(value: Any = None) -> CommsConfig:
     if isinstance(value, CommsConfig):
         return value
     if value is None:
-        value = os.environ.get(ENV_TRANSPORT) or "fp32"
+        # env-derived: a typo'd transport warns once and runs fp32
+        # (tfde_tpu/knobs.py); explicit call-site values still raise in
+        # CommsConfig.__post_init__.
+        value = knobs.env_choice(ENV_TRANSPORT) or "fp32"
     if isinstance(value, str):
         return CommsConfig(transport=value)
     raise TypeError(

@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from tfde_tpu import knobs
 from tfde_tpu.parallel import comms as comms_lib
 
 log = logging.getLogger(__name__)
@@ -75,7 +76,9 @@ def resolve(value: Any = None) -> str:
     $TFDE_OPT_SHARDING (unset = 'replicated', so existing configs are
     byte-identical)."""
     if value is None:
-        value = os.environ.get(ENV_OPT_SHARDING) or "replicated"
+        # env-derived: a typo'd mode warns once and runs 'replicated'
+        # (tfde_tpu/knobs.py); explicit call-site values still raise below.
+        value = knobs.env_choice(ENV_OPT_SHARDING) or "replicated"
     if isinstance(value, str):
         if value not in MODES:
             raise ValueError(

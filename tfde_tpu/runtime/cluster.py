@@ -30,6 +30,8 @@ import logging
 import os
 from typing import Optional
 
+from tfde_tpu import knobs
+
 log = logging.getLogger(__name__)
 
 _INITIALIZED = False
@@ -152,10 +154,14 @@ def resolve_cluster() -> ClusterInfo:
     """Resolve cluster identity from the environment without side effects."""
     # Native contract takes precedence.
     if os.environ.get("TFDE_NUM_PROCESSES"):
-        num = int(os.environ["TFDE_NUM_PROCESSES"])
-        pid = int(os.environ.get("TFDE_PROCESS_ID", "0"))
-        coord = os.environ.get("TFDE_COORDINATOR")
-        return ClusterInfo(num, pid, coord, "chief" if pid == 0 else "worker", pid)
+        # knobs.env_int warn-fallbacks on garbage: an unparseable world
+        # size drops to the TF_CONFIG path instead of crashing bootstrap
+        num = knobs.env_int("TFDE_NUM_PROCESSES")
+        if num is not None:
+            pid = knobs.env_int("TFDE_PROCESS_ID", 0)
+            coord = knobs.env_str("TFDE_COORDINATOR")
+            return ClusterInfo(num, pid, coord,
+                               "chief" if pid == 0 else "worker", pid)
 
     cfg = _parse_tf_config() or _synthesize_tf_config()
     if cfg is None:
@@ -185,7 +191,7 @@ def coordinator_endpoint(coord: str, default_port: int = 8476) -> str:
             derived = int(spec_port) - 1011
     else:
         host, derived = coord, default_port
-    port = int(os.environ.get("TFDE_COORD_PORT", derived))
+    port = knobs.env_int("TFDE_COORD_PORT", int(derived))
     return f"{host}:{port}"
 
 
@@ -204,12 +210,11 @@ def metrics_push_url(info: Optional[ClusterInfo] = None,
     Returns None when neither is derivable (single-process, or no fixed
     metrics port configured) — callers treat that as "pushing disabled".
     """
-    env = os.environ.get("TFDE_METRICS_PUSH_URL")
+    env = knobs.env_str("TFDE_METRICS_PUSH_URL")
     if env:
         return env
     if port is None:
-        raw = os.environ.get("TFDE_METRICS_PORT", "")
-        port = int(raw) if raw else None
+        port = knobs.env_int("TFDE_METRICS_PORT")
     if not port:  # None or 0 (ephemeral): workers can't guess the binding
         return None
     info = info or resolve_cluster()

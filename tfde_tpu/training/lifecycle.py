@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tfde_tpu import knobs
+from tfde_tpu.analysis import hlolint
 from tfde_tpu.checkpoint.manager import CheckpointManager
 from tfde_tpu.data.device import device_prefetch
 from tfde_tpu.resilience.preemption import PreemptionGuard as _PreemptionGuard
@@ -239,8 +241,7 @@ class Estimator:
             return self._metrics_srv
         port = self.config.metrics_port
         if port is None:
-            env = os.environ.get("TFDE_METRICS_PORT", "")
-            port = int(env) if env else None
+            port = knobs.env_int("TFDE_METRICS_PORT")
         if port is not None:
             # include_local=0 folds the chief's own registry into every
             # rollup as host 0, so cluster medians cover the chief without
@@ -531,15 +532,19 @@ class Estimator:
                     log.info("first step (compile): %.2fs", compile_s)
                     flightrec.record("compile", seconds=round(compile_s, 3),
                                      step=step + 1)
+                    # interrogate the just-compiled program: the NEW
+                    # state/carry have the same avals the executable
+                    # was specialized on (the old buffers were donated)
+                    sargs = ((state, batch, rng, sstate)
+                             if sstate is not None
+                             else (state, batch, rng))
                     if memwatch.enabled():
-                        # interrogate the just-compiled program: the NEW
-                        # state/carry have the same avals the executable
-                        # was specialized on (the old buffers were donated)
-                        sargs = ((state, batch, rng, sstate)
-                                 if sstate is not None
-                                 else (state, batch, rng))
                         memwatch.register("train_step", self._train_step,
                                           args=sargs, donated=state)
+                    # same seam feeds the lowered-program linter (no-op
+                    # unless armed — tools/lintgate.py / TFDE_HLOLINT)
+                    hlolint.offer("train_step", self._train_step,
+                                  args=sargs, donated=state)
                     if writer is not None:
                         writer.scalars(step + 1,
                                        {"compile_seconds": compile_s})

@@ -1,0 +1,328 @@
+"""Static-analysis regression gate: lint the hot programs + the source
+tree against a checked-in baseline.
+
+Two passes over ONE deterministic CPU workload, the static-analysis
+sibling of tools/memgate.py:
+
+- **Program lint** (`tfde_tpu/analysis/hlolint.py`): the train step
+  under all four `grad_transport` x `opt_sharding` combos (built the
+  way tests/test_comms.py builds them), plus every serving program the
+  real batcher compiles while draining a fixed request mix — decode
+  scan depths and cold/warm/primed prefill waves, captured through the
+  armed registration seam (`TFDE_HLOLINT`). For each program: the
+  collective census (counts AND payload bytes), donation survival,
+  host-callback count, dtype policy, large constants.
+- **Project lint** (`tools/tfdelint.py`): lock discipline for threaded
+  classes, the greedy-path `jax.random.split` ban, and the TFDE_* knob
+  audit against `tfde_tpu/knobs.py`.
+
+The observation is diffed EXACTLY against tools/lintgate_baseline.json:
+the workload is deterministic, so any census drift — one extra
+all-reduce, one fewer aliased output, a new bf16->f32 convert — is a
+program change that must be re-baselined deliberately. Unknown program
+names (either direction) and any lint violation fail loudly.
+
+Modes:
+
+  python tools/lintgate.py --check    # compare vs baseline; exit 1 on
+                                      # drift/violation (tier1.sh)
+  python tools/lintgate.py --update   # rewrite the baseline (commit it)
+  python tools/lintgate.py --print    # dump the observation JSON
+
+Injection self-test: with TFDE_LINTGATE_INJECT=1 the workload also
+lints two deliberately-broken programs through the real linter — one
+carrying a `jax.pure_callback` (stray host callback) and one whose
+declared donation cannot alias any output (dropped donation) — and
+--check must fail. tools/tier1.sh runs this after the clean check,
+mirroring the memgate inject drill.
+
+Re-baseline after a deliberate program or rule change::
+
+  JAX_PLATFORMS=cpu python tools/lintgate.py --update
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the train matrix needs a multi-device DP mesh; must be set before the
+# first jax import (same flag the test suite pins)
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# arm the hlolint registration seam before any tfde import
+os.environ.setdefault("TFDE_HLOLINT", "1")
+
+BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lintgate_baseline.json")
+ENV_INJECT = "TFDE_LINTGATE_INJECT"
+
+#: the transport x opt-sharding matrix, same combos tier1.sh sweeps
+TRAIN_COMBOS = (
+    ("fp32", "replicated"),
+    ("fp32", "shard"),
+    ("int8", "replicated"),
+    ("int8", "shard"),
+)
+
+
+def _train_matrix(reports: dict) -> None:
+    """Lint the train step under all four transport x sharding combos
+    (the tests/test_comms.py construction: PlainCNN on a 4-way DP mesh,
+    fixed batch)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tfde_tpu.analysis import hlolint
+    from tfde_tpu.models.cnn import PlainCNN
+    from tfde_tpu.parallel.strategies import MirroredStrategy
+    from tfde_tpu.runtime.mesh import make_mesh
+    from tfde_tpu.training.step import init_state, make_train_step
+
+    mesh = make_mesh({"data": -1}, jax.devices()[:4])
+    rng = np.random.default_rng(0)
+    images = rng.random((16, 784), np.float32)
+    labels = rng.integers(0, 10, (16, 1)).astype(np.int32)
+    for transport, sharding in TRAIN_COMBOS:
+        strategy = MirroredStrategy(mesh=mesh, grad_transport=transport,
+                                    opt_sharding=sharding)
+        state, _ = init_state(PlainCNN(), optax.sgd(0.1), strategy, images)
+        step = make_train_step(strategy, state, donate=True)
+        # plain fp32/replicated returns a bare jax.jit; the custom-step
+        # combos wrap it and expose .jitted
+        jitted = getattr(step, "jitted", step)
+        name = f"train_step/{transport}+{sharding}"
+        reports[name] = hlolint.lint(
+            name, jitted, (state, (images, labels), jax.random.key(0)),
+            donated=state)
+
+
+def _serve_phase() -> None:
+    """Drive the real batcher through every admission kind so the armed
+    seam captures decode + cold/warm/primed prefill programs:
+
+    - a cold drain over two prompt buckets (memgate's mix);
+    - a prefix-cache warm re-admission (same >=1-chunk prompt twice);
+    - a disaggregated prefill-role prime() handed to a decode-role
+      batcher via submit_primed().
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfde_tpu.inference.prefix_cache import PrefixCache
+    from tfde_tpu.inference.server import ContinuousBatcher
+    from tfde_tpu.models.gpt import GPT
+
+    model = GPT(vocab_size=256, hidden_size=32, depth=2, num_heads=2,
+                mlp_dim=64, max_position=64, dtype=jnp.float32)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+
+    def drain(srv):
+        step = 0
+        while not srv.idle:
+            srv.step()
+            step += 1
+            if step > 200:
+                raise RuntimeError("serve phase failed to drain")
+
+    # cold + decode ladder
+    srv = ContinuousBatcher(model, params, batch_size=4, max_len=48,
+                            scan_depth=4)
+    for plen, n_new in [(3, 8), (6, 5), (4, 12), (7, 6)]:
+        srv.submit(rng.integers(0, model.vocab_size, plen), n_new)
+    drain(srv)
+
+    # warm: one full-chunk prompt cached, then re-admitted with a suffix
+    warm = ContinuousBatcher(model, params, batch_size=4, max_len=64,
+                             scan_depth=4, prefix_cache=PrefixCache())
+    prompt = rng.integers(0, model.vocab_size, 20)
+    warm.submit(prompt, 4)
+    drain(warm)
+    warm.submit(np.concatenate([prompt, [5, 7]]), 4)
+    drain(warm)
+
+    # primed: prefill-role prime -> decode-role scatter + stream
+    pre = ContinuousBatcher(model, params, batch_size=1, max_len=64,
+                            role="prefill")
+    dec = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+                            role="decode")
+    primed = [pre.prime(rng.integers(0, model.vocab_size, k), 4)
+              for k in (3, 5)]
+    for pr in primed:
+        dec.submit_primed(pr)
+    drain(dec)
+
+
+def _inject(reports: dict) -> None:
+    """Seed two genuinely-broken programs through the real linter: the
+    self-test that proves the gate bites (tier1.sh, test_recompile's
+    memgate sibling in tests/test_hlolint.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfde_tpu.analysis import hlolint
+
+    # stray host callback inside a jitted program
+    def poll(x):
+        flag = jax.pure_callback(
+            lambda v: np.asarray(float(v) > 0, np.float32),
+            jax.ShapeDtypeStruct((), jnp.float32), jnp.sum(x))
+        return x * flag
+
+    cb = jax.jit(poll)
+    reports["inject/callback"] = hlolint.lint(
+        "inject/callback", cb, (jnp.ones((4, 4), jnp.float32),))
+
+    # declared donation that cannot alias: the donated input's shape
+    # matches no output, so lowering drops the alias
+    def shrink(x):
+        return jnp.sum(x, axis=0)
+
+    dn = jax.jit(shrink, donate_argnums=(0,))
+    x = jnp.ones((8, 8), jnp.float32)
+    reports["inject/dropped_donation"] = hlolint.lint(
+        "inject/dropped_donation", dn, (x,), donated=x)
+
+
+def observe() -> dict:
+    """Run both passes; returns the baseline-diffable observation."""
+    from tfde_tpu.analysis import hlolint
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tfdelint", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "tfdelint.py"))
+    tfdelint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tfdelint)
+
+    hlolint.arm(True)
+    reports: dict = {}
+    _train_matrix(reports)
+    _serve_phase()
+    reports.update(hlolint.collect())
+    if os.environ.get(ENV_INJECT, "") not in ("", "0"):
+        _inject(reports)
+
+    project = tfdelint.lint_repo()
+    return {
+        "programs": {name: rep.as_dict() for name, rep in sorted(
+            reports.items())},
+        "project": project,
+    }
+
+
+#: census fields diffed exactly per program
+_CENSUS_FIELDS = ("all_reduce", "reduce_scatter", "all_gather",
+                  "collective_permute", "callbacks", "aliased_outputs",
+                  "f64_tensors", "bf16_to_f32_converts")
+_REBASE = "re-baseline deliberately with: python tools/lintgate.py --update"
+
+
+def check(obs: dict, base: dict) -> list:
+    """Compare an observation against the baseline; returns failure
+    strings (empty = gate passes)."""
+    fails = []
+    for name, prog in obs["programs"].items():
+        for v in prog["violations"]:
+            fails.append(f"violation: {v}")
+        b = base.get("programs", {}).get(name)
+        if b is None:
+            fails.append(f"program {name} not in baseline — new hot "
+                         f"program; {_REBASE}")
+            continue
+        for field in _CENSUS_FIELDS:
+            got = prog["census"].get(field, 0)
+            want = b["census"].get(field, 0)
+            if got != want:
+                fails.append(
+                    f"program {name}: {field} {got} != baseline {want} — "
+                    f"the lowered program changed (an extra collective, a "
+                    f"lost donation alias, a new upcast); if deliberate, "
+                    f"{_REBASE}")
+        got_b = prog["census"].get("collective_bytes", {})
+        want_b = b["census"].get("collective_bytes", {})
+        if got_b != want_b:
+            fails.append(
+                f"program {name}: collective payload bytes {got_b} != "
+                f"baseline {want_b} — same op count but different tensor "
+                f"sizes on the wire; if deliberate, {_REBASE}")
+        if prog["census"]["large_constants"] != b["census"].get(
+                "large_constants", []):
+            fails.append(
+                f"program {name}: large embedded constants changed "
+                f"({prog['census']['large_constants']} vs baseline "
+                f"{b['census'].get('large_constants', [])}); {_REBASE}")
+    for name in base.get("programs", {}):
+        if name not in obs["programs"]:
+            fails.append(f"program {name} in baseline but not observed — "
+                         f"the workload lost a hot program; {_REBASE}")
+    for v in obs["project"]["violations"]:
+        fails.append(f"violation: {v}")
+    if obs["project"]["lock_audit"] != base.get("project", {}).get(
+            "lock_audit", {}):
+        fails.append(f"lock-discipline audit coverage changed "
+                     f"(threaded-class table drift); {_REBASE}")
+    if obs["project"]["knobs_seen"] != base.get("project", {}).get(
+            "knobs_seen", []):
+        fails.append(f"TFDE_* knob census changed (knob added or removed); "
+                     f"{_REBASE}")
+    return fails
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--check", action="store_true",
+                      help="compare vs baseline; exit 1 on drift")
+    mode.add_argument("--update", action="store_true",
+                      help="run the workload and rewrite the baseline")
+    mode.add_argument("--print", dest="show", action="store_true",
+                      help="run and dump the observation JSON only")
+    ap.add_argument("--baseline", default=BASELINE,
+                    help=f"baseline path (default {BASELINE})")
+    args = ap.parse_args()
+
+    obs = observe()
+    if args.show:
+        print(json.dumps(obs, indent=2, sort_keys=True))
+        return 0
+    if args.update:
+        obs["_note"] = ("generated by: JAX_PLATFORMS=cpu python "
+                        "tools/lintgate.py --update — regenerate after any "
+                        "deliberate change to a hot program's collectives/"
+                        "donation/dtypes or to the lint rules")
+        with open(args.baseline, "w") as f:
+            json.dump(obs, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"lintgate: baseline written to {args.baseline}")
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            base = json.load(f)
+    except OSError as e:
+        print(f"lintgate: FAIL — no baseline ({e}); generate one with "
+              f"python tools/lintgate.py --update")
+        return 1
+    fails = check(obs, base)
+    if fails:
+        print("lintgate: FAIL")
+        for f in fails:
+            print(f"  - {f}")
+        return 1
+    print(f"lintgate: pass ({len(obs['programs'])} programs clean, "
+          f"{len(obs['project']['knobs_seen'])} knobs audited, "
+          f"{len(obs['project']['lock_audit'])} threaded classes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

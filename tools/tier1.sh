@@ -93,6 +93,30 @@ if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
 else
     echo "MEMGATE=pass"
 fi
+# Static-analysis gate: hlolint census of every hot program (train-step
+# transport x sharding matrix, decode scan, cold/warm/primed prefill)
+# diffed exactly against tools/lintgate_baseline.json, plus the project
+# lint (lock discipline, greedy-split ban, TFDE_* knob audit). An extra
+# collective, a dropped donation alias, a stray host callback, an
+# unlocked threaded write or an unregistered knob fails tier-1 here;
+# re-baseline a deliberate change with: python tools/lintgate.py --update
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python tools/lintgate.py --check; then
+    echo "LINTGATE=fail"
+    [ $rc -eq 0 ] && rc=1
+else
+    echo "LINTGATE=pass"
+fi
+# Injection self-test: seed a host-callback program and a dropped
+# donation through the real linter — the gate must FAIL, proving it bites
+# (the memgate TFDE_MEMGATE_INJECT drill's static-analysis sibling).
+if timeout -k 10 420 env JAX_PLATFORMS=cpu TFDE_LINTGATE_INJECT=1 \
+    python tools/lintgate.py --check >/dev/null 2>&1; then
+    echo "LINTGATE_INJECT=fail (seeded violations did not fail the gate)"
+    [ $rc -eq 0 ] && rc=1
+else
+    echo "LINTGATE_INJECT=pass"
+fi
 if [ -f /tmp/_t1.passed ]; then
     prev=$(cat /tmp/_t1.passed)
     echo DOTS_DELTA=$((passed - prev))
