@@ -2149,6 +2149,48 @@ def _bench_decode(clock: _Clock, smoke: bool) -> dict:
     return out
 
 
+#: bench_meta schema: 1 = implicit (pre-provenance lines, no meta block);
+#: 2 = bench_meta {schema, git_sha, backend, knobs} on every emitted line
+BENCH_SCHEMA_VERSION = 2
+
+
+def _git_sha() -> str | None:
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=10, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode == 0:
+            return proc.stdout.strip() or None
+    except Exception:
+        pass
+    return None  # tarball checkouts bench too
+
+
+def _knob_snapshot() -> dict:
+    """Every TFDE_* knob actually set in this environment — the capture's
+    configuration fingerprint. Unregistered names are included on purpose:
+    a knob the registry doesn't know yet is exactly the drift a cross-round
+    diff needs to surface (registry: tfde_tpu/knobs.py)."""
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith("TFDE_")}
+
+
+def _bench_meta(platform: str | None = None, device_kind: str | None = None,
+                n_chips: int | None = None) -> dict:
+    """Provenance block stamped onto every emitted JSON line so captures
+    are alignable across machines and rounds (trendgate's raw material)."""
+    meta: dict = {
+        "schema": BENCH_SCHEMA_VERSION,
+        "git_sha": _git_sha(),
+        "knobs": _knob_snapshot(),
+    }
+    if platform is not None:
+        meta["backend"] = {"platform": platform, "device_kind": device_kind,
+                           "n_chips": n_chips}
+    return meta
+
+
 def run_mode() -> None:
     import jax
 
@@ -2245,6 +2287,7 @@ def run_mode() -> None:
             "vs_baseline": None,
             "vs_baseline_note": "reference publishes no benchmark numbers",
             **result,
+            "bench_meta": _bench_meta(platform, device_kind, n_chips),
         }
         if partial:
             line["partial"] = True
@@ -2445,21 +2488,47 @@ def _emit_fallback(reason: str, last_rc, last_tail: str,
         "live_budget_s": budget,
         "live_last_rc": str(last_rc),
         "live_last_stderr_tail": last_tail,
+        # bench_meta describes THIS reporting process; the replayed
+        # artifact's own provenance (if stamped) moves aside untouched
+        "source_bench_meta": art.get("bench_meta"),
+        "bench_meta": {**_bench_meta(), "replayed": True},
     })
     print(json.dumps(line))
     return True
+
+
+def _probe_give_up(consecutive_fails: int, probe_spent_s: float,
+                   budget_s: float, max_fails: int = 3,
+                   probe_budget_frac: float = 0.4) -> tuple[bool, str]:
+    """Probe give-up policy (pure, unit-testable): stop probing after
+    `max_fails` CONSECUTIVE failures, or once cumulative probe time has
+    eaten `probe_budget_frac` of the whole budget. Rounds r03/r04 burned
+    their entire hardware budget on back-to-back 2-minute probe hangs —
+    a hung tunnel now costs at most a bounded slice before the driver
+    falls through to the skip-with-reason fallback path."""
+    if consecutive_fails >= max_fails:
+        return True, (f"{consecutive_fails} consecutive backend-probe "
+                      f"failures (cap {max_fails})")
+    if budget_s > 0 and probe_spent_s > probe_budget_frac * budget_s:
+        return True, (f"probing consumed {probe_spent_s:.0f}s, over "
+                      f"{probe_budget_frac:.0%} of the {budget_s:.0f}s "
+                      f"budget")
+    return False, ""
 
 
 def driver_mode() -> None:
     budget = float(os.environ.get("TFDE_BENCH_BUDGET_S", "1200"))
     attempt_timeout = float(os.environ.get("TFDE_BENCH_ATTEMPT_TIMEOUT_S", "900"))
     probe_timeout = float(os.environ.get("TFDE_BENCH_PROBE_TIMEOUT_S", "120"))
+    max_probe_fails = int(os.environ.get("TFDE_BENCH_MAX_PROBE_FAILS", "3"))
     skip_probe = os.environ.get("TFDE_BENCH_FORCE_CPU") == "1"
     deadline = time.monotonic() + budget
     backoff = 15.0
     attempt = 0
     last_tail = ""
     last_rc: object = None
+    probe_fails = 0     # consecutive
+    probe_spent = 0.0   # cumulative seconds inside _backend_probe
 
     while True:
         remaining = deadline - time.monotonic()
@@ -2469,12 +2538,25 @@ def driver_mode() -> None:
         print(f"[bench driver] attempt {attempt} "
               f"(remaining budget {remaining:.0f}s)", file=sys.stderr)
         if not skip_probe:
+            t_probe = time.monotonic()
             status, detail = _backend_probe(min(probe_timeout, remaining))
+            probe_spent += time.monotonic() - t_probe
             if status == "cpu_only":
                 last_rc, last_tail = "cpu_only", detail
                 break  # permanent on this host; don't burn the budget
             if status == "down":
+                probe_fails += 1
                 last_rc, last_tail = "probe_failed", detail
+                give_up, why = _probe_give_up(
+                    probe_fails, probe_spent, budget,
+                    max_fails=max_probe_fails,
+                )
+                if give_up:
+                    last_rc = "probe_gave_up"
+                    last_tail = f"{why}; last probe: {detail[:400]}"
+                    print(f"[bench driver] giving up on probes: {why}",
+                          file=sys.stderr)
+                    break
                 sleep = min(backoff, max(deadline - time.monotonic() - 60, 0))
                 print(f"[bench driver] backend probe failed ({detail[:200]}); "
                       f"retrying in {sleep:.0f}s", file=sys.stderr)
@@ -2482,6 +2564,7 @@ def driver_mode() -> None:
                     time.sleep(sleep)
                 backoff = min(backoff * 2, 120)
                 continue
+            probe_fails = 0  # a live backend re-arms the consecutive cap
             print(f"[bench driver] backend up: {detail}", file=sys.stderr)
             remaining = deadline - time.monotonic()  # probe time is spent
         parsed, last_rc, last_tail = _attempt_full_run(
@@ -2504,6 +2587,8 @@ def driver_mode() -> None:
 
     reason = (f"TPU backend unavailable after {attempt} attempts "
               f"within {budget:.0f}s budget")
+    if last_rc == "probe_gave_up":
+        reason += f" (probe give-up: {last_tail[:200]})"
     # cpu_only is a PERMANENT condition (no TPU plugin on this host), not
     # a tunnel outage — replaying a committed TPU capture there would
     # claim "same chip" on a machine that never had one
@@ -2526,6 +2611,7 @@ def driver_mode() -> None:
         "error": reason,
         "last_rc": last_rc,
         "last_stderr_tail": last_tail,
+        "bench_meta": _bench_meta(),
     }))
     sys.exit(0)  # the JSON line IS the deliverable; don't hand back a traceback rc
 

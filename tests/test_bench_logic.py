@@ -153,3 +153,44 @@ def test_moe_flops_formula():
                                               moe_every=2)
     n_moe = d // 2
     assert moe - dense == 3 * n_moe * (4 * h * f + 2 * h * 8)
+
+
+def test_probe_give_up_policy():
+    """The r03/r04 failure mode: consecutive probe hangs must hit a cap
+    (default 3) or a cumulative probe budget, never the whole bench
+    budget. A live backend between failures re-arms the cap (the driver
+    resets the consecutive count), so only the pure policy is pinned here."""
+    # under both limits: keep probing
+    up, _ = bench._probe_give_up(1, 100.0, 1200.0)
+    assert not up
+    # consecutive cap
+    up, why = bench._probe_give_up(3, 100.0, 1200.0)
+    assert up and "consecutive" in why
+    # cumulative budget (default 40% of the whole budget)
+    up, why = bench._probe_give_up(1, 700.0, 1200.0)
+    assert up and "consumed" in why
+    # cap is configurable
+    up, _ = bench._probe_give_up(3, 0.0, 1200.0, max_fails=5)
+    assert not up
+    # zero budget never divides by zero / trips the fraction rule
+    up, _ = bench._probe_give_up(0, 50.0, 0.0)
+    assert not up
+
+
+def test_bench_meta_structure(monkeypatch):
+    """Every emitted line's provenance block: schema version, git sha,
+    backend identity, and the active TFDE_* knob snapshot (BASELINE.md
+    bench_meta schema note)."""
+    monkeypatch.setenv("TFDE_BENCH_SMOKE", "1")
+    monkeypatch.setenv("TFDE_PROFILE", "every:100")
+    meta = bench._bench_meta("tpu", "TPU v4", 4)
+    assert meta["schema"] == bench.BENCH_SCHEMA_VERSION == 2
+    assert meta["backend"] == {"platform": "tpu", "device_kind": "TPU v4",
+                               "n_chips": 4}
+    # this repo is a git checkout, so the sha must resolve here
+    assert isinstance(meta["git_sha"], str) and len(meta["git_sha"]) == 40
+    assert meta["knobs"]["TFDE_BENCH_SMOKE"] == "1"
+    assert meta["knobs"]["TFDE_PROFILE"] == "every:100"
+    assert all(k.startswith("TFDE_") for k in meta["knobs"])
+    # driver-side lines (backend unreachable) omit the backend block
+    assert "backend" not in bench._bench_meta()

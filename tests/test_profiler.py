@@ -1,0 +1,484 @@
+"""Trigger-driven profiling (observability/profiler.py): window parsing,
+arm() refusal paths, the ProfileTrigger hub's cooldown/dedupe contract, the
+retention-bounded artifact index, and the live drills — an SLO-burn
+crossing, a recompile storm, and a straggler flag must each produce a
+profile artifact stamped with the trigger reason (and in-flight trace ids)
+with no operator action, including the 2-process coordinated capture over
+the aggregator push channel."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tfde_tpu.observability import metrics
+from tfde_tpu.observability import profiler
+from tfde_tpu.observability.profiler import (
+    ProfileArtifacts,
+    ProfileTrigger,
+    RoundWindowProfiler,
+    StepWindowProfiler,
+    _parse_window,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    profiler.reset_hub()
+    yield
+    profiler.reset_hub()
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- window parsing ----------------------------------------------------------
+def test_parse_window_matrix():
+    assert _parse_window("") is None
+    assert _parse_window("0") is None
+    assert _parse_window("false") is None
+    assert _parse_window("7") == (7, 17)          # 10-step default span
+    assert _parse_window("5:9") == (5, 9)
+    assert _parse_window("every:0") is None       # disabled, like '0'
+    assert _parse_window("every:100") == ("every", 100, 10)
+    assert _parse_window("every:100:25") == ("every", 100, 25)
+    with pytest.raises(ValueError, match="shorter than the period"):
+        _parse_window("every:10:10")              # trace would never close
+    with pytest.raises(ValueError):
+        _parse_window("every:5:0")
+
+
+def test_env_garbage_disables_explicit_raises(tmp_path, monkeypatch):
+    """The knobs contract: garbage in $TFDE_PROFILE warns and disables
+    (a shell typo must not kill a run); the same garbage passed
+    explicitly (RunConfig.profile_steps) still raises."""
+    monkeypatch.setenv("TFDE_PROFILE", "every:10:10")
+    with pytest.warns(UserWarning, match="TFDE_PROFILE"):
+        p = StepWindowProfiler(str(tmp_path))
+    assert not p.enabled
+    with pytest.raises(ValueError):
+        StepWindowProfiler(str(tmp_path), window="every:10:10")
+
+
+def test_resume_global_step_semantics(tmp_path, monkeypatch):
+    """Windows are GLOBAL steps: a run resumed at step 6 with window
+    (5, 8) opens immediately (mid-window) and closes at 8 — the same
+    steps an uninterrupted run would trace."""
+    opened, closed = [], []
+    monkeypatch.setattr(profiler, "_start_trace", lambda d: opened.append(d))
+    monkeypatch.setattr(profiler, "_stop_trace", lambda: closed.append(1))
+    p = StepWindowProfiler(str(tmp_path), window=(5, 8))
+    for step in range(6, 11):   # resume past the window start
+        p.step(step)
+    assert len(opened) == 1 and len(closed) == 1
+    assert p.windows_traced == 1
+
+
+def test_arm_refusal_paths(tmp_path, monkeypatch):
+    monkeypatch.setattr(profiler, "_start_trace", lambda d: None)
+    monkeypatch.setattr(profiler, "_stop_trace", lambda: None)
+    # configured window: refuse (operator trace wins over auto-capture)
+    p = StepWindowProfiler(str(tmp_path), window=(5, 8))
+    assert not p.arm(10, 2)
+    # no logdir: refuse
+    assert not StepWindowProfiler(None, None).arm(10, 2)
+    # bad span: loud
+    p2 = StepWindowProfiler(str(tmp_path), window=None)
+    with pytest.raises(ValueError):
+        p2.arm(10, 0)
+    # success, then refuse while the armed window is live
+    assert p2.arm(10, span=2, reason="drill")
+    assert not p2.arm(20, 2)
+    # active trace: refuse
+    p2.step(10)
+    assert not p2.arm(20, 2)
+    # an auto-armed one-shot is consumed on close: armable again
+    p2.step(12)
+    assert p2.windows_traced == 1
+    assert p2.arm(20, 2, reason="drill2")
+
+
+def test_artifact_index_retention(tmp_path):
+    arts = ProfileArtifacts(str(tmp_path), retain=2)
+    for i in range(5):
+        path = arts.record(f"reason{i}", "step", i, i + 2,
+                           traces=["t1", "t2"], logdir=str(tmp_path))
+        assert path and os.path.exists(path)
+    recs = profiler.list_artifacts(str(tmp_path))
+    assert len(recs) == 2                      # oldest pruned
+    assert [r["reason"] for r in recs] == ["reason3", "reason4"]
+    assert recs[-1]["traces"] == ["t1", "t2"]
+    assert recs[-1]["kind"] == "step"
+    assert recs[-1]["start"] == 4 and recs[-1]["stop"] == 6
+    # no model_dir: record is a no-op, not a crash
+    assert ProfileArtifacts(None).record("r", "step", 0, 1) is None
+
+
+# -- trigger hub -------------------------------------------------------------
+def test_trigger_cooldown_and_dedupe():
+    clock = _FakeClock()
+    hub = ProfileTrigger(cooldown_s=10.0, dedupe_s=60.0, enabled=True,
+                         clock=clock)
+    calls = []
+    hub.register("sink", lambda r, s, i: (calls.append((r, s)), True)[1])
+    assert hub.trigger("slo_burn_ttft", span=4)
+    assert calls == [("slo_burn_ttft", 4)]
+    # global cooldown blocks even a DIFFERENT reason
+    assert not hub.trigger("recompile_storm")
+    clock.t += 11
+    # cooldown passed but the same key is deduped for 60s
+    assert not hub.trigger("slo_burn_ttft")
+    # a different reason goes through
+    assert hub.trigger("recompile_storm", span=2)
+    clock.t += 61
+    assert hub.trigger("slo_burn_ttft", span=4)
+    assert len(calls) == 3
+
+
+def test_trigger_refusal_preserves_budget():
+    """Timestamps are consumed only when a sink actually arms — a refused
+    trigger must not start the cooldown and starve the next anomaly."""
+    clock = _FakeClock()
+    hub = ProfileTrigger(cooldown_s=10.0, dedupe_s=60.0, clock=clock)
+    hub.register("refuser", lambda r, s, i: False)
+    assert not hub.trigger("slo_burn_ttft")
+    hub.register("armer", lambda r, s, i: True)
+    # same instant, same key: still fires because nothing was consumed
+    assert hub.trigger("slo_burn_ttft")
+
+
+def test_trigger_disabled_and_broken_sinks():
+    hub = ProfileTrigger(cooldown_s=0.0, dedupe_s=0.0, enabled=False,
+                         clock=_FakeClock())
+    hub.register("sink", lambda r, s, i: True)
+    assert not hub.trigger("anything")
+    hub2 = ProfileTrigger(cooldown_s=0.0, dedupe_s=0.0, enabled=True,
+                          clock=_FakeClock())
+    hub2.register("broken", lambda r, s, i: 1 / 0)
+    got = []
+    # a broken sink is logged, not raised, and the extra_sink still arms
+    assert hub2.trigger("x", extra_sink=lambda r, s, i: (got.append(i), True)[1])
+    assert got and got[0] == {}
+
+
+def test_trigger_knob_defaults(monkeypatch):
+    monkeypatch.setenv("TFDE_PROFILE_COOLDOWN_S", "5.5")
+    monkeypatch.setenv("TFDE_PROFILE_DEDUPE_S", "7.5")
+    monkeypatch.setenv("TFDE_PROFILE_TRIGGERS", "off")
+    hub = ProfileTrigger()
+    assert hub.cooldown_s == 5.5 and hub.dedupe_s == 7.5
+    assert not hub.enabled
+
+
+# -- serving round windows ---------------------------------------------------
+def test_round_window_capture(tmp_path, monkeypatch):
+    monkeypatch.setattr(profiler, "_start_trace", lambda d: None)
+    monkeypatch.setattr(profiler, "_stop_trace", lambda: None)
+    arts = ProfileArtifacts(str(tmp_path))
+    rp = RoundWindowProfiler(str(tmp_path), artifacts=arts)
+    with pytest.raises(ValueError):
+        rp.arm(span=0)
+    assert rp.arm(span=4, reason="slo_burn_tpot")
+    assert not rp.arm(span=4)               # already armed
+    rp.on_round(10, traces=["aaa"])         # opens; window [10, 14)
+    rp.on_round(12, traces=["bbb"])
+    assert rp.windows_traced == 0
+    rp.on_round(14, traces=["aaa"])         # closes
+    assert rp.windows_traced == 1
+    recs = profiler.list_artifacts(str(tmp_path))
+    assert len(recs) == 1
+    assert recs[0]["reason"] == "slo_burn_tpot"
+    assert recs[0]["kind"] == "round"
+    assert recs[0]["traces"] == ["aaa", "bbb"]
+    assert recs[0]["start"] == 10 and recs[0]["stop"] == 14
+    # consumed: re-armable
+    assert rp.arm(span=2, reason="again")
+    # no logdir: refuses instead of arming a trace it can't write
+    assert not RoundWindowProfiler(None).arm(span=2)
+
+
+# -- live drills: anomaly signal -> artifact, no operator action -------------
+def test_slo_burn_drill_produces_stamped_artifact(tmp_path, monkeypatch):
+    """The acceptance drill: a forced TTFT SLO burn must arm a serving
+    capture through the hub and leave an artifact stamped with the trigger
+    reason and the in-flight trace id — record() calls only, no operator
+    action. Uses the REAL hub and the real jax.profiler trace."""
+    from tfde_tpu.observability.slo import SLOTracker
+
+    monkeypatch.setenv("TFDE_PROFILE_SPAN", "3")
+    arts = ProfileArtifacts(str(tmp_path))
+    rp = RoundWindowProfiler(str(tmp_path), artifacts=arts)
+    profiler.hub().register("serve_round_window", rp.trigger_sink)
+    reg = metrics.Registry()
+    tracker = SLOTracker(ttft_target_ms=100.0, objective=0.99,
+                         registry=reg)
+    assert tracker.burn_threshold == 10.0     # TFDE_PROFILE_BURN_THRESHOLD
+    for _ in range(10):                       # every request breaches
+        tracker.record(ttft_ms=500.0)
+    # the batcher side: armed window opens and closes on round boundaries
+    rp.on_round(1, traces=["req-trace-1"])
+    rp.on_round(5, traces=["req-trace-2"])
+    profiler.hub().unregister("serve_round_window")
+    recs = profiler.list_artifacts(str(tmp_path))
+    assert len(recs) == 1
+    assert recs[0]["reason"] == "slo_burn_ttft"
+    assert "req-trace-1" in recs[0]["traces"]
+    assert "req-trace-2" in recs[0]["traces"]
+    # the capture-overhead ledger fed the goodput bucket's source
+    cap = metrics.default_registry().snapshot().get("profile/capture")
+    assert cap and cap["count"] >= 2          # start + stop observed
+    # sustained burn is edge-detected: more breaches don't re-trigger
+    # (and the hub cooldown would refuse anyway)
+    before = len(profiler.list_artifacts(str(tmp_path)))
+    for _ in range(5):
+        tracker.record(ttft_ms=500.0)
+    assert len(profiler.list_artifacts(str(tmp_path))) == before
+
+
+def test_recompile_storm_drill_triggers_capture(monkeypatch):
+    """A recompile storm (recompile.Site escalation) must reach the hub
+    with the site name in the dedupe key."""
+    from tfde_tpu.observability import recompile
+
+    fired = []
+    profiler.hub().register("probe", lambda r, s, i: (fired.append((r, i)),
+                                                      True)[1])
+    site = recompile.Site("stormy", stable=True, expect=1,
+                          storm_threshold=2, registry=metrics.Registry())
+    # settle 3 distinct compiled signatures on a stable expect=1 site:
+    # signatures 2 and 3 are unexpected, crossing the storm threshold
+    for n in range(3):
+        site._settle(("fp", n), 1, 0.01, None)
+    assert site.unexpected == 2
+    assert fired and fired[0][0] == "recompile_storm"
+    assert fired[0][1]["site"] == "stormy"
+
+
+def test_straggler_drill_triggers_and_broadcasts():
+    """A straggler flag must trigger the hub AND (coordinate=True) queue a
+    broadcast command that each pushing host receives exactly once."""
+    from tfde_tpu.observability.aggregate import ClusterAggregator
+
+    clock = _FakeClock()
+    fired = []
+    profiler.hub().register("probe", lambda r, s, i: (fired.append((r, i)),
+                                                      True)[1])
+    agg = ClusterAggregator(
+        registry=metrics.Registry(), straggler_factor=1.5,
+        coordinate=True, clock=clock,
+        on_straggler=lambda h, r: None, on_stale=lambda h, a: None,
+    )
+
+    def push(host, step_s, count):
+        agg.ingest({"host": host, "metrics": {
+            "train/step/sum": step_s * count, "train/step/count": count,
+        }})
+
+    for i in range(1, 4):   # deltas need two pushes per host
+        push(0, 0.1, i)
+        push(1, 1.0, i)     # 10x the median: straggler
+    assert fired and fired[0][0] == "straggler"
+    assert fired[0][1]["host"] == 1
+    # the broadcast sink queued a command; each host drains it once
+    cmd = agg.pending_profile(0)
+    assert cmd and cmd["reason"] == "straggler"
+    assert agg.pending_profile(0) is None      # once per host
+    assert agg.pending_profile(1)["id"] == cmd["id"]
+
+
+def test_push_reply_delivers_coordinated_command():
+    """A /push response carrying a profile command must reach the local
+    hub stamped `coordinated` (so a chief-side broadcast sink would skip
+    it — no broadcast loops); non-JSON legacy replies are ignored."""
+    from tfde_tpu.observability.aggregate import _apply_push_reply
+
+    got = []
+    profiler.hub().register("probe", lambda r, s, i: (got.append((r, s, i)),
+                                                      True)[1])
+    _apply_push_reply(b"ok\n")                 # legacy chief: no-op
+    assert not got
+    _apply_push_reply(json.dumps(
+        {"ok": True, "profile": {"id": 3, "reason": "straggler",
+                                 "span": 5}}).encode())
+    assert len(got) == 1
+    reason, span, info = got[0]
+    assert reason == "straggler" and span == 5
+    assert info["coordinated"] is True
+
+
+def test_sentry_trip_routes_through_hub(tmp_path, monkeypatch):
+    """The sentry's auto-arm now rides the hub (shared cooldown with the
+    other triggers) while keeping its own profiler via extra_sink."""
+    from tfde_tpu.observability import sentry as sentry_lib
+
+    monkeypatch.setattr(profiler, "_start_trace", lambda d: None)
+    monkeypatch.setattr(profiler, "_stop_trace", lambda: None)
+    p = StepWindowProfiler(str(tmp_path), window=None)
+    mon = sentry_lib.SentryMonitor(
+        sentry_lib.SentryConfig(action="warn", profile_span=4), profiler=p)
+    mon.on_trip(1, 10, 12)
+    assert p._window == (13, 17)               # armed at step+1
+    assert p._reason == "sentry_trip"
+
+
+# -- two-process coordinated capture ----------------------------------------
+_CHILD = """
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+model_dir, url = sys.argv[1], sys.argv[2]
+from tfde_tpu.observability import aggregate, profiler
+rp = profiler.RoundWindowProfiler(
+    model_dir, artifacts=profiler.ProfileArtifacts(model_dir))
+profiler.hub().register("child_round", rp.trigger_sink)
+rounds, deadline = 0, time.time() + 60
+while time.time() < deadline:
+    aggregate.push_once(url, host=7)
+    for _ in range(4):           # drive decode rounds
+        rounds += 1
+        rp.on_round(rounds, traces=["child-req"])
+    if profiler.list_artifacts(model_dir):
+        print("CAPTURED", flush=True)
+        sys.exit(0)
+    time.sleep(0.1)
+print("TIMEOUT", flush=True)
+sys.exit(1)
+"""
+
+
+def test_two_process_coordinated_capture(tmp_path):
+    """The chief-broadcast drill: a trigger on the chief must leave
+    profile artifacts on BOTH hosts — locally via its own sink, and on a
+    separate pushing process via the /push response channel."""
+    from tfde_tpu.observability.aggregate import ClusterAggregator
+    from tfde_tpu.observability.exposition import MetricsServer
+
+    chief_dir = str(tmp_path / "chief")
+    child_dir = str(tmp_path / "child")
+    os.makedirs(child_dir)
+    reg = metrics.Registry()
+    agg = ClusterAggregator(registry=reg, coordinate=True,
+                            on_straggler=lambda h, r: None,
+                            on_stale=lambda h, a: None)
+    srv = MetricsServer(port=0, host="127.0.0.1", registry=reg,
+                        aggregator=agg)
+    rp = RoundWindowProfiler(chief_dir,
+                             artifacts=ProfileArtifacts(chief_dir))
+    profiler.hub().register("chief_round", rp.trigger_sink)
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    child = subprocess.Popen(
+        [sys.executable, str(script), child_dir,
+         f"http://127.0.0.1:{srv.port}/push"],
+        env=env, cwd=ROOT, stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        # wait for the child's first push, then trigger on the chief
+        deadline = time.monotonic() + 60
+        while 7 not in agg.hosts() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert 7 in agg.hosts(), "child never pushed"
+        assert profiler.trigger("straggler_drill", span=3)
+        for r in range(1, 6):                  # chief's own rounds
+            rp.on_round(r, traces=["chief-req"])
+        out, _ = child.communicate(timeout=90)
+    finally:
+        child.kill()
+        srv.close()
+        profiler.hub().unregister("chief_round")
+    assert "CAPTURED" in out, f"child saw no coordinated capture: {out!r}"
+    chief_recs = profiler.list_artifacts(chief_dir)
+    child_recs = profiler.list_artifacts(child_dir)
+    assert chief_recs and chief_recs[0]["reason"] == "straggler_drill"
+    assert child_recs and child_recs[0]["reason"] == "straggler_drill"
+    assert child_recs[0]["traces"] == ["child-req"]
+
+
+# -- serving front door ------------------------------------------------------
+def test_replica_post_profile_end_to_end(tmp_path):
+    """POST /profile on a live replica arms a decode-round capture; real
+    generated traffic drives the window shut and the artifact lands under
+    the replica's model_dir with the operator reason. A second arm while
+    one is pending is refused with 409. The Router fans /profile out."""
+    import jax
+    import jax.numpy as jnp
+
+    from tfde_tpu.inference.router import ReplicaServer, Router
+    from tfde_tpu.inference.server import ContinuousBatcher
+    from tfde_tpu.models.gpt import gpt_tiny_test
+
+    model = gpt_tiny_test()
+    params = model.init(jax.random.key(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    b = ContinuousBatcher(model, params, batch_size=2, max_len=64)
+    rep = ReplicaServer(b, replica_id=0, model_dir=str(tmp_path)).start()
+    router = Router([rep.url]).start()
+    try:
+        def post(url, payload):
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, out = post(f"{router.url}/profile",
+                         {"span": 2, "reason": "operator_drill"})
+        assert code == 200
+        assert out["replicas"] == [{"replica": 0, "armed": True,
+                                    "reason": "operator_drill"}]
+        # double-arm refused at the replica
+        code2, out2 = post(f"{rep.url}/profile", {"span": 2})
+        assert code2 == 409 and out2["armed"] is False
+        # real traffic closes the window: decode rounds advance in
+        # scan_depth jumps and a single short request may finish inside
+        # the open window, so keep serving until the artifact lands
+        from tfde_tpu.inference.router import request_generate
+
+        deadline = time.monotonic() + 60
+        while (not profiler.list_artifacts(str(tmp_path))
+               and time.monotonic() < deadline):
+            request_generate(router.url, [5, 6, 7], 8)
+        recs = profiler.list_artifacts(str(tmp_path))
+        assert recs, "no artifact after served traffic"
+        assert recs[0]["reason"] == "operator_drill"
+        assert recs[0]["kind"] == "round"
+    finally:
+        router.close()
+        rep.close()
+
+
+# -- goodput bucket ----------------------------------------------------------
+def test_goodput_profile_bucket():
+    """In-window capture overhead lands in its own ledger bucket and comes
+    OUT of compute, so a traced window can't read as a compute
+    regression; fractions still sum to 1."""
+    from tfde_tpu.observability.goodput import CATEGORIES, GoodputLedger
+
+    assert "profile" in CATEGORIES
+    reg = metrics.Registry()
+    ledger = GoodputLedger(registry=reg)
+    for _ in range(10):
+        reg.histogram("train/step").observe(1.0)
+    reg.histogram("profile/capture").observe(2.0)   # start+stop dispatch
+    rep = ledger.report(wall_seconds=12.0)
+    assert rep["seconds"]["profile"] == pytest.approx(2.0)
+    assert rep["seconds"]["compute"] == pytest.approx(8.0)  # 10 - 2
+    assert sum(rep["seconds"].values()) == pytest.approx(12.0)
+    assert sum(rep["fractions"].values()) == pytest.approx(1.0)

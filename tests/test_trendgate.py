@@ -1,0 +1,207 @@
+"""Perf trendline gate (tools/trendgate.py): the committed BENCH history
+must be green with a real comparable pair, every burned round must skip
+with a reason (never crash the gate), synthetic regressions must fail
+loudly per-metric, and the TFDE_TRENDGATE_INJECT drill must bite."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def tg():
+    return _load("trendgate")
+
+
+@pytest.fixture(scope="module")
+def policy():
+    with open(os.path.join(ROOT, "tools", "trendgate_policy.json")) as f:
+        return json.load(f)
+
+
+# A minimal trusted capture: tpu platform, calibrated clock, nonzero
+# headline — everything parse_capture requires for "comparable".
+def _capture(**metrics):
+    doc = {"platform": "tpu", "calib_frac_of_peak": 0.95, "value": 1.0}
+    doc.update(metrics)
+    return doc
+
+
+def _write(repo, name, doc):
+    with open(os.path.join(repo, name), "w") as f:
+        if isinstance(doc, str):
+            f.write(doc)
+        else:
+            json.dump(doc, f)
+
+
+_POLICY = {
+    "trust": {"platform": "tpu", "min_calib_frac_of_peak": 0.8},
+    "default_slack": 0.10,
+    "metrics": {
+        "mfu": {"direction": "higher", "slack": 0.10},
+        "step_ms": {"direction": "lower", "slack": 0.15},
+        "flash_speedup": {"direction": "higher", "gate": False},
+    },
+}
+
+
+# -- the committed history itself --------------------------------------------
+def test_committed_history_is_green(tg, policy):
+    caps = tg.load_history(ROOT, policy.get("trust", {}))
+    assert caps, "no committed BENCH_*.json found"
+    fails = tg.check(caps, policy)
+    assert fails == [], f"committed BENCH history fails its own gate: {fails}"
+    # the gate must actually be comparing something: a real pair, not a
+    # degenerate <2-comparable pass
+    trend = tg.build_trend(caps, policy)
+    assert trend["pair"] is not None, (
+        "fewer than two comparable captures in the committed history — "
+        "the trend gate is vacuous")
+    # and every non-comparable round carries a human-readable reason
+    for s in trend["skipped"]:
+        assert s["reason"]
+
+
+def test_committed_inject_drill_bites(tg, policy):
+    caps = tg.load_history(ROOT, policy.get("trust", {}))
+    comp = tg.comparable(caps)
+    caps.append(tg.inject_capture(comp[-1], policy))
+    fails = tg.check(caps, policy)
+    gated = [n for n, mp in policy["metrics"].items()
+             if mp.get("gate", True) and n in comp[-1]["metrics"]]
+    assert len(fails) == len(gated) > 0
+    assert all("trendgate.py --update" in f for f in fails)
+
+
+# -- skip sorting -------------------------------------------------------------
+def test_skip_reasons_cover_burned_rounds(tg, tmp_path):
+    repo = str(tmp_path)
+    _write(repo, "BENCH_r01.json", "{not json")
+    _write(repo, "BENCH_r02.json", _capture(error="OOM on chip 3"))
+    _write(repo, "BENCH_r03.json", dict(_capture(), platform="cpu"))
+    nocalib = _capture()
+    del nocalib["calib_frac_of_peak"]
+    _write(repo, "BENCH_r04.json", nocalib)
+    _write(repo, "BENCH_r05.json", _capture(calib_frac_of_peak=0.5))
+    _write(repo, "BENCH_r06.json", dict(_capture(), value=0.0))
+    # a driver wrapper whose parsed is null and whose tail is truncated
+    _write(repo, "BENCH_r07.json",
+           {"cmd": ["bench"], "rc": 124, "parsed": None,
+            "tail": '{"platform": "tpu", "calib_'})
+    caps = tg.load_history(repo, _POLICY["trust"])
+    assert len(caps) == 7
+    reasons = {c["file"]: c["skip"] for c in caps}
+    assert "unparseable" in reasons["BENCH_r01.json"]
+    assert "OOM on chip 3" in reasons["BENCH_r02.json"]
+    assert "'cpu'" in reasons["BENCH_r03.json"]
+    assert "calibration anchor" in reasons["BENCH_r04.json"]
+    assert "below trust floor" in reasons["BENCH_r05.json"]
+    assert "zero/absent" in reasons["BENCH_r06.json"]
+    assert "no parseable payload" in reasons["BENCH_r07.json"]
+    # nothing comparable -> no trend, but the gate still passes (a burned
+    # history is a missing baseline, not a regression)
+    assert tg.check(caps, _POLICY) == []
+
+
+def test_driver_tail_salvage(tg, tmp_path):
+    """A timed-out driver attempt whose tail still ends in a complete
+    JSON line is salvaged as a comparable capture."""
+    repo = str(tmp_path)
+    payload = _capture(mfu=0.42)
+    _write(repo, "BENCH_r01.json",
+           {"cmd": ["bench"], "rc": 124, "parsed": None,
+            "tail": "noise line\n" + json.dumps(payload)})
+    caps = tg.load_history(repo, _POLICY["trust"])
+    assert caps[0]["skip"] is None
+    assert caps[0]["metrics"]["mfu"] == pytest.approx(0.42)
+
+
+def test_builder_sorts_before_driver_same_round(tg, tmp_path):
+    repo = str(tmp_path)
+    _write(repo, "BENCH_r02.json", _capture(mfu=0.5))
+    _write(repo, "BENCH_builder_r02.json", _capture(mfu=0.4))
+    caps = tg.load_history(repo, _POLICY["trust"])
+    assert [c["file"] for c in caps] == ["BENCH_builder_r02.json",
+                                        "BENCH_r02.json"]
+
+
+# -- gating -------------------------------------------------------------------
+def test_regression_fails_within_slack_passes(tg, tmp_path):
+    repo = str(tmp_path)
+    _write(repo, "BENCH_r01.json", _capture(mfu=0.50, step_ms=100.0))
+    # within slack both directions: pass
+    _write(repo, "BENCH_r02.json", _capture(mfu=0.46, step_ms=112.0))
+    caps = tg.load_history(repo, _POLICY["trust"])
+    assert tg.check(caps, _POLICY) == []
+    # past slack, both directions: one failure per metric, loud
+    _write(repo, "BENCH_r03.json", _capture(mfu=0.40, step_ms=130.0))
+    caps = tg.load_history(repo, _POLICY["trust"])
+    fails = tg.check(caps, _POLICY)
+    assert len(fails) == 2
+    assert any("mfu" in f and "dropped" in f for f in fails)
+    assert any("step_ms" in f and "rose" in f for f in fails)
+
+
+def test_ungated_metric_is_informational(tg, tmp_path):
+    repo = str(tmp_path)
+    _write(repo, "BENCH_r01.json", _capture(flash_speedup=3.0))
+    _write(repo, "BENCH_r02.json", _capture(flash_speedup=1.1))
+    caps = tg.load_history(repo, _POLICY["trust"])
+    assert tg.check(caps, _POLICY) == []
+    rows = {r["metric"]: r for r in tg.build_trend(caps, _POLICY)["rows"]}
+    assert rows["flash_speedup"]["status"] == "regressed (informational)"
+
+
+def test_gated_metric_disappearing_fails(tg, tmp_path):
+    repo = str(tmp_path)
+    _write(repo, "BENCH_r01.json", _capture(mfu=0.50))
+    _write(repo, "BENCH_r02.json", _capture(step_ms=100.0))
+    caps = tg.load_history(repo, _POLICY["trust"])
+    fails = tg.check(caps, _POLICY)
+    assert len(fails) == 1 and "ABSENT" in fails[0] and "mfu" in fails[0]
+    # improvement is never a failure
+    _write(repo, "BENCH_r03.json", _capture(mfu=0.9, step_ms=50.0))
+    del caps  # recompute: r02 -> r03 adds mfu back (status "new") + improves
+    caps = tg.load_history(repo, _POLICY["trust"])
+    assert tg.check(caps, _POLICY) == []
+
+
+# -- report -------------------------------------------------------------------
+def test_report_renders_both_outcomes(tg, tmp_path):
+    repo = str(tmp_path)
+    _write(repo, "BENCH_r01.json", _capture(mfu=0.50))
+    _write(repo, "BENCH_r02.json", _capture(mfu=0.30))
+    _write(repo, "BENCH_r03.json", "{not json")
+    caps = tg.load_history(repo, _POLICY["trust"])
+    fails = tg.check(caps, _POLICY)
+    report = tg.render_report(caps, _POLICY, fails)
+    assert "**FAIL**" in report and "mfu" in report
+    assert "skipped: unparseable" in report
+    ok = tg.render_report(caps[:1], _POLICY, [])
+    assert "Fewer than two comparable captures" in ok
+    assert "pass (1 comparable capture(s)" in ok
+
+
+def test_committed_trend_md_is_current(tg, policy):
+    """TREND.md is generated — a drifted checked-in report means someone
+    changed the history or policy without running --update."""
+    caps = tg.load_history(ROOT, policy.get("trust", {}))
+    fails = tg.check(caps, policy)
+    want = tg.render_report(caps, policy, fails)
+    with open(os.path.join(ROOT, "TREND.md")) as f:
+        assert f.read() == want, (
+            "TREND.md is stale — regenerate with: "
+            "python tools/trendgate.py --update")

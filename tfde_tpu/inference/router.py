@@ -159,6 +159,19 @@ class ReplicaServer:
         # label this process's trace events (a lone replica per process
         # in the cluster deployment — the stitched waterfall's row name)
         _trace.set_process(f"replica{self.replica_id}")
+        # serving-side bounded capture: a RoundWindowProfiler over decode
+        # rounds, armable by POST /profile or any hub trigger (SLO burn,
+        # recompile storm, coordinated broadcast)
+        from tfde_tpu.observability import profiler as profiler_lib
+
+        self.profiler = profiler_lib.RoundWindowProfiler(
+            model_dir,
+            artifacts=(profiler_lib.ProfileArtifacts(model_dir)
+                       if model_dir is not None else None),
+        )
+        batcher.attach_profiler(self.profiler)
+        self._hub_sink = f"replica{self.replica_id}_round_window"
+        profiler_lib.hub().register(self._hub_sink, self.profiler.trigger_sink)
         srv = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -201,6 +214,8 @@ class ReplicaServer:
                         srv._handle_generate(self, body, primed=True)
                     elif self.path == "/prime":
                         srv._handle_prime(self, body)
+                    elif self.path == "/profile":
+                        srv._handle_profile(self, body)
                     else:
                         self.send_error(404)
                 except (ValueError, RuntimeError) as e:
@@ -238,7 +253,24 @@ class ReplicaServer:
         self._httpd.server_close()
         if self._pusher is not None:
             self._pusher.close()
+        from tfde_tpu.observability import profiler as profiler_lib
+
+        profiler_lib.hub().unregister(self._hub_sink)
+        self.profiler.close()
         _trace.dump("replica_close")
+
+    def _handle_profile(self, handler, body: dict) -> None:
+        """POST /profile {"span": N?, "reason": str?} — arm a bounded
+        decode-round capture on this replica. 409 when one is already
+        armed/active or the replica has no local model_dir to trace to."""
+        span = body.get("span")
+        reason = str(body.get("reason") or "operator")
+        armed = self.profiler.arm(
+            span=int(span) if span is not None else None, reason=reason,
+        )
+        self._send_json(handler, 200 if armed else 409, {
+            "replica": self.replica_id, "armed": armed, "reason": reason,
+        })
 
     def load(self) -> dict:
         b = self.batcher
@@ -450,6 +482,9 @@ class Router:
                             self, 404,
                             {"error": f"unknown {tier} replica {idx}"},
                         )
+                elif self.path == "/profile":
+                    ReplicaServer._send_json(
+                        self, 200, router.profile_all(body))
                 else:
                     self.send_error(404)
 
@@ -476,6 +511,32 @@ class Router:
     @property
     def slo(self) -> SLOTracker:
         return self._slo
+
+    def profile_all(self, body: dict) -> dict:
+        """POST /profile fan-out: forward the arm request to every decode
+        and prefill replica; per-replica armed/refused results (a down
+        replica reports armed=False with its error). Fleet-wide capture
+        from one operator call — the serving face of the coordinated
+        cross-host window."""
+        payload = {"reason": str(body.get("reason") or "operator")}
+        if body.get("span") is not None:
+            payload["span"] = int(body["span"])
+        results = []
+        for rep in self._reps + self._pre:
+            try:
+                with _post_json(f"{rep.url}/profile", payload,
+                                timeout=5.0) as resp:
+                    results.append(json.loads(resp.read()))
+            except urllib.error.HTTPError as e:
+                try:
+                    results.append(json.loads(e.read()))
+                except Exception:
+                    results.append({"replica": rep.idx, "armed": False,
+                                    "error": str(e)})
+            except Exception as e:  # noqa: BLE001 — dead replica
+                results.append({"replica": rep.idx, "armed": False,
+                                "error": str(e)})
+        return {"reason": payload["reason"], "replicas": results}
 
     def trace(self, trace_id: str) -> dict:
         """Stitch one request's waterfall across this router and every

@@ -458,6 +458,18 @@ class _BatcherBase:
         # pad-ladder bucket, not per wave)
         self._rc_tag = next(_BATCHER_TAGS)
         self._mem_programs: set = set()
+        # serving-side bounded capture: armed via attach_profiler /
+        # POST /profile, driven once per step from the decode-round hook
+        self._profiler = None
+
+    def attach_profiler(self, profiler) -> None:
+        """Give this batcher a RoundWindowProfiler (observability/profiler);
+        armed windows open/close on decode-round boundaries."""
+        self._profiler = profiler
+
+    def _profiler_round(self, traced) -> None:
+        if self._profiler is not None:
+            self._profiler.on_round(self._rounds, traces=traced or None)
 
     #: subclasses that implement `_primed_wave` + `prime` flip this
     _accepts_primed = False
@@ -1031,6 +1043,7 @@ class ContinuousBatcher(_BatcherBase):
             toks_np, emitted_np = _fetch((toks, emitted))
             self._syncs += 1
         self._rounds += depth
+        self._profiler_round(traced)
         n_emitted = 0
         for r in active:
             row = toks_np[r][emitted_np[r]]
@@ -1566,6 +1579,7 @@ class SpeculativeContinuousBatcher(_BatcherBase):
              if (rid := self._req[r]) in self._trace_ids]
             if self._trace_ids else []
         )
+        self._profiler_round(traced)
         n_emitted = 0
         for r in active:
             toks = round_np[r, : int(n_np[r])].tolist()

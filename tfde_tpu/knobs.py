@@ -100,11 +100,39 @@ _register(
          "ops/flash_attention.py"),
     # --- training / runtime ----------------------------------------------
     Knob("TFDE_PROFILE", "spec", None,
-         ("<dir>", "<dir>:100:110", "<dir>:every:N:S"),
-         "Enable the XLA profiler: a trace directory, optionally with a "
-         "step window ('dir:100:110') or periodic capture "
-         "('dir:every:1000:5').",
+         ("<start>", "<start>:<stop>", "every:N", "every:N:S"),
+         "XLA profiler step window (traces land under the run's "
+         "model_dir): one window of global steps ('100:110', or '100' "
+         "for 10 steps) or a repeating capture ('every:1000:5').",
          "observability/profiler.py"),
+    Knob("TFDE_PROFILE_", "spec", None, (),
+         "Trigger-driven profiling family prefix (see members below).",
+         "observability/profiler.py", prefix=True),
+    Knob("TFDE_PROFILE_TRIGGERS", "flag", True, (),
+         "Allow anomaly signals (SLO burn, straggler, recompile storm, "
+         "sentry trip) to auto-arm bounded XProf captures; 'off' keeps "
+         "the trigger hub silent.",
+         "observability/profiler.py"),
+    Knob("TFDE_PROFILE_COOLDOWN_S", "float", 120.0, (),
+         "Minimum seconds between any two trigger-driven captures.",
+         "observability/profiler.py"),
+    Knob("TFDE_PROFILE_DEDUPE_S", "float", 600.0, (),
+         "Per-reason re-fire suppression window, seconds — the same "
+         "anomaly cannot arm a second capture within it.",
+         "observability/profiler.py"),
+    Knob("TFDE_PROFILE_SPAN", "int", 8, (),
+         "Default capture span for triggered windows: train steps "
+         "(StepWindowProfiler.arm) or serving decode rounds "
+         "(RoundWindowProfiler).",
+         "observability/profiler.py"),
+    Knob("TFDE_PROFILE_RETAIN", "int", 8, (),
+         "Profile artifacts retained under <model_dir>/debug/profiles/ "
+         "before the oldest capture (meta + trace dir) is pruned.",
+         "observability/profiler.py"),
+    Knob("TFDE_PROFILE_BURN_THRESHOLD", "float", 10.0, (),
+         "Fast-window SLO burn rate at which the tracker asks the "
+         "trigger hub for a capture; <= 0 disables the burn trigger.",
+         "observability/slo.py"),
     Knob("TFDE_METRICS_PORT", "int", None, (),
          "Fixed port for the chief's /metrics+/push HTTP server (unset or "
          "0 = ephemeral; workers then cannot derive a push URL).",
@@ -190,6 +218,44 @@ _register(
          "Lintgate self-test: lint two seeded-broken programs (a stray "
          "host callback, a dropped donation) so the gate must fail.",
          "tools/lintgate.py"),
+    Knob("TFDE_TRENDGATE_INJECT", "flag", False, (),
+         "Trendgate self-test: append a synthetic BENCH round with every "
+         "gated metric regressed past twice its slack so the gate must "
+         "fail.",
+         "tools/trendgate.py"),
+    # --- bench driver ------------------------------------------------------
+    Knob("TFDE_BENCH_", "spec", None, (),
+         "Bench driver family prefix (see members below).",
+         "bench.py", prefix=True),
+    Knob("TFDE_BENCH_BUDGET_S", "float", 1200.0, (),
+         "Total driver retry budget, seconds, across probes and attempts.",
+         "bench.py"),
+    Knob("TFDE_BENCH_ATTEMPT_TIMEOUT_S", "float", 900.0, (),
+         "Per-attempt wall-clock timeout, seconds, for one full bench run.",
+         "bench.py"),
+    Knob("TFDE_BENCH_PROBE_TIMEOUT_S", "float", 120.0, (),
+         "Hard timeout, seconds, on one backend-liveness probe subprocess "
+         "(a hung TPU runtime init must not eat the budget).",
+         "bench.py"),
+    Knob("TFDE_BENCH_MAX_PROBE_FAILS", "int", 3, (),
+         "Consecutive failed backend probes before the driver gives up "
+         "with a skip reason instead of burning the remaining budget.",
+         "bench.py"),
+    Knob("TFDE_BENCH_ALLOW_CPU", "flag", False, (),
+         "Let the measurement run on CPU and say so in the artifact "
+         "(otherwise a CPU-only backend is an honest-zero skip).",
+         "bench.py"),
+    Knob("TFDE_BENCH_FORCE_CPU", "flag", False, (),
+         "Force JAX_PLATFORMS=cpu for the bench (implies ALLOW_CPU): the "
+         "smoke path of the driver and tier-1.",
+         "bench.py"),
+    Knob("TFDE_BENCH_SMOKE", "flag", False, (),
+         "Tiny shapes, path validation only — numbers are not reportable.",
+         "bench.py"),
+    Knob("TFDE_BENCH_WATCH_OUT", "str", None, (),
+         "Artifact path for --watch mode's first-open-window capture "
+         "(default BENCH_builder_rNN.json next to bench.py).",
+         "bench.py"),
 )
 
 

@@ -80,11 +80,40 @@ def push_once(url: str, registry: Optional[metrics.Registry] = None,
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return 200 <= resp.status < 300
+            ok = 200 <= resp.status < 300
+            reply = resp.read()
     except Exception as e:
         metrics.counter("cluster/push_errors").incr()
         log.debug("metrics push to %s failed: %s", url, e)
         return False
+    if ok:
+        _apply_push_reply(reply)
+    return ok
+
+
+def _apply_push_reply(reply: bytes) -> None:
+    """The push channel is bidirectional on the cheap: the chief's /push
+    response can carry a pending coordinated-profile command, which we
+    deliver to this worker's trigger hub (stamped ``coordinated`` so the
+    chief-side broadcast sink never re-broadcasts it — no loops)."""
+    try:
+        payload = json.loads(reply)
+    except (ValueError, TypeError):
+        return  # pre-JSON chiefs reply b"ok\n" — nothing to deliver
+    cmd = payload.get("profile") if isinstance(payload, dict) else None
+    if not isinstance(cmd, dict):
+        return
+    try:
+        from tfde_tpu.observability import profiler
+
+        profiler.trigger(
+            str(cmd.get("reason") or "coordinated"),
+            key=f"coordinated:{cmd.get('id')}",
+            span=cmd.get("span"),
+            coordinated=True,
+        )
+    except Exception:
+        log.exception("coordinated profile command failed")
 
 
 class MetricsPusher:
@@ -182,6 +211,7 @@ class ClusterAggregator:
                  include_local: Optional[int] = None,
                  on_straggler: Optional[Callable[[int, float], None]] = None,
                  on_stale: Optional[Callable[[int, float], None]] = None,
+                 coordinate: bool = False,
                  clock: Callable[[], float] = time.monotonic):
         if straggler_factor <= 1.0:
             raise ValueError("straggler_factor must be > 1")
@@ -202,6 +232,51 @@ class ClusterAggregator:
         self._on_stale = on_stale
         self._flagged_straggler: Optional[int] = None
         self._known_stale: set = set()
+        # coordinated-capture broadcast: one pending command, delivered at
+        # most once per host via the /push response channel
+        self._profile_cmd: Optional[dict] = None
+        self._profile_delivered: set = set()
+        self._profile_seq = 0
+        if coordinate:
+            from tfde_tpu.observability import profiler
+
+            profiler.hub().register("cluster_broadcast", self._broadcast_sink)
+
+    # -- coordinated capture -------------------------------------------------
+    def broadcast_profile(self, reason: str,
+                          span: Optional[int] = None) -> dict:
+        """Queue a coordinated capture command for every pushing host. The
+        next /push from each host picks it up (once per host) through the
+        push response, so cross-host windows need no new channel."""
+        with self._lock:
+            self._profile_seq += 1
+            cmd = {"id": self._profile_seq, "reason": str(reason)}
+            if span is not None:
+                cmd["span"] = int(span)
+            self._profile_cmd = cmd
+            self._profile_delivered = set()
+        metrics.counter("cluster/profile_broadcasts").incr()
+        log.warning("cluster: broadcasting coordinated profile capture "
+                    "#%d (%s) to pushing hosts", cmd["id"], reason)
+        return dict(cmd)
+
+    def pending_profile(self, host: int) -> Optional[dict]:
+        """The command `host` has not seen yet, marking it delivered —
+        called by the /push handler to build its response."""
+        with self._lock:
+            cmd = self._profile_cmd
+            if cmd is None or host in self._profile_delivered:
+                return None
+            self._profile_delivered.add(int(host))
+            return dict(cmd)
+
+    def _broadcast_sink(self, reason: str, span: int, info: dict) -> bool:
+        # a command that ARRIVED via the push channel must not fan back
+        # out — only locally-originated triggers broadcast
+        if info.get("coordinated"):
+            return False
+        self.broadcast_profile(reason, span)
+        return True
 
     # -- ingest --------------------------------------------------------------
     def ingest(self, payload: dict) -> None:
@@ -297,6 +372,18 @@ class ClusterAggregator:
                     self._on_straggler(straggler, ratio)
                 except Exception:
                     log.exception("on_straggler callback failed")
+                try:
+                    # ask the trigger hub for capture evidence — on a chief
+                    # built with coordinate=True the broadcast sink turns
+                    # this into a cross-host window
+                    from tfde_tpu.observability import profiler
+
+                    profiler.trigger(
+                        "straggler", key=f"straggler:{straggler}",
+                        host=straggler, ratio=round(ratio, 2),
+                    )
+                except Exception:
+                    log.exception("straggler profile trigger failed")
         return out
 
     # -- exposition ----------------------------------------------------------

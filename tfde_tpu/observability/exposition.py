@@ -280,7 +280,17 @@ class MetricsServer:
                         self._send(400, f"bad push: {e}\n".encode(),
                                    "text/plain")
                         return
-                    self._send(200, b"ok\n", "text/plain")
+                    # the response doubles as the chief->worker command
+                    # channel: a pending coordinated-profile broadcast is
+                    # delivered (once per host) in the push reply
+                    reply: dict = {"ok": True}
+                    pending = getattr(agg, "pending_profile", None)
+                    if pending is not None:
+                        cmd = pending(int(payload.get("host", -1)))
+                        if cmd:
+                            reply["profile"] = cmd
+                    self._send(200, json.dumps(reply).encode(),
+                               "application/json")
                 except BrokenPipeError:
                     pass
 

@@ -48,6 +48,11 @@ Category definitions (all in seconds of the measured wall):
 - ``eval``          inline eval passes (train/eval)
 - ``restart_loss``  the preemption tax: restart backoff sleeps plus
                     replayed steps (resilience/lost_steps x mean step time)
+- ``profile``       profile-capture overhead: the host-side dispatch cost
+                    of opening/closing XProf trace windows
+                    (profile/capture, recorded by observability/profiler).
+                    Split out so a triggered capture window can't
+                    masquerade as a compute regression
 - ``other``         residual — loop bookkeeping and anything unspanned
 
 ``goodput`` = compute / wall.
@@ -71,7 +76,7 @@ _SPAN_SOURCES = {
 }
 
 CATEGORIES = ("init", "compile", "data_wait", "compute", "checkpoint",
-              "summary", "eval", "restart_loss", "other")
+              "summary", "eval", "restart_loss", "profile", "other")
 
 
 class GoodputLedger:
@@ -153,7 +158,15 @@ class GoodputLedger:
             max(0.0, step_time - replay),
             max(0.0, d("compile/train_step/seconds_total") - first_measured),
         )
-        seconds["compute"] = step_time - replay - in_step
+        # profile-capture overhead (start/stop-trace dispatch) — its
+        # in-step share comes out of compute (a traced window must not
+        # read as a compute regression); any remainder (serving-side
+        # captures outside the train loop) comes out of the residual
+        profile = d("sum:profile/capture")
+        seconds["profile"] = profile
+        in_step_profile = min(profile,
+                              max(0.0, step_time - replay - in_step))
+        seconds["compute"] = step_time - replay - in_step - in_step_profile
         seconds["restart_loss"] = replay + d("resilience/restart_backoff_seconds")
 
         accounted = sum(seconds.values())

@@ -4,18 +4,61 @@ ProfilerHook (mnist_keras_distributed.py:235-237,261; SURVEY.md §5).
 `jax.profiler` traces (XProf format) are viewable in TensorBoard's profile
 plugin or xprof; they capture XLA op timelines, HBM usage, and ICI collective
 time — the TPU-native superset of ProfilerHook's show_memory=True.
+
+Beyond the operator-requested window (``$TFDE_PROFILE``), this module hosts
+the *trigger-driven* capture loop: live anomaly signals (SLO burn-rate
+crossings, straggler flags, recompile storms, sentry trips) funnel into a
+``ProfileTrigger`` hub that arms a bounded capture on whichever profiler is
+registered — a training step window (``StepWindowProfiler``) or a serving
+decode-round window (``RoundWindowProfiler``). Every closed capture is
+recorded in a retention-bounded artifact index under
+``<model_dir>/debug/profiles/`` stamped with the trigger reason, step/round
+range, and active trace ids, so the evidence for a perf anomaly survives the
+process (surfaced by ``tools/obs_dump.py --profiles``).
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
 import logging
 import os
-from typing import Iterator, Optional
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional
 
 import jax
 
+from tfde_tpu import knobs
+
 log = logging.getLogger(__name__)
+
+# Capture-overhead histogram: the host-side dispatch cost of opening and
+# closing a trace (start_trace/stop_trace). goodput.py drains this into its
+# own ledger bucket so a traced window can't masquerade as a compute
+# regression.
+CAPTURE_HISTOGRAM = "profile/capture"
+
+
+def _observe_capture(seconds: float) -> None:
+    try:
+        from tfde_tpu.observability import metrics
+
+        metrics.default_registry().histogram(CAPTURE_HISTOGRAM).observe(seconds)
+    except Exception:  # pragma: no cover - metrics must never break a trace
+        pass
+
+
+def _start_trace(logdir: str) -> None:
+    t0 = time.perf_counter()
+    jax.profiler.start_trace(logdir)
+    _observe_capture(time.perf_counter() - t0)
+
+
+def _stop_trace() -> None:
+    t0 = time.perf_counter()
+    jax.profiler.stop_trace()
+    _observe_capture(time.perf_counter() - t0)
 
 
 @contextlib.contextmanager
@@ -29,7 +72,8 @@ def profile_trace(
         for batch in feed: state, m = step(...)
     """
     if enabled is None:
-        enabled = os.environ.get("TFDE_PROFILE", "") not in ("", "0", "false", "False")
+        raw = knobs.env_str("TFDE_PROFILE", "") or ""
+        enabled = raw not in ("", "0", "false", "False")
     if not enabled or logdir is None:
         yield
         return
@@ -38,13 +82,13 @@ def profile_trace(
     log.info("profiler trace -> %s/plugins/profile", logdir)
     from tfde_tpu.observability import spans
 
-    jax.profiler.start_trace(logdir)
+    _start_trace(logdir)
     spans.set_trace_active(True)
     try:
         yield
     finally:
         spans.set_trace_active(False)
-        jax.profiler.stop_trace()
+        _stop_trace()
 
 
 def annotate(name: str):
@@ -77,6 +121,284 @@ def _parse_window(raw: str) -> Optional[tuple]:
     return (start, start + 10)
 
 
+def _window_from_env() -> Optional[tuple]:
+    """Parse $TFDE_PROFILE with the knob contract: garbage in the
+    environment warns once and disables, it never raises (explicit
+    RunConfig/ctor windows still raise — operator typos in code should
+    fail fast, typos in a shell export should not kill a run)."""
+    raw = knobs.env_str("TFDE_PROFILE", "") or ""
+    try:
+        return _parse_window(raw)
+    except ValueError:
+        knobs._warn_once("TFDE_PROFILE", raw,
+                         "is not a valid profile window", None)
+        return None
+
+
+# --------------------------------------------------------------------------
+# Artifact index: <model_dir>/debug/profiles/
+# --------------------------------------------------------------------------
+
+PROFILES_SUBDIR = os.path.join("debug", "profiles")
+
+
+class ProfileArtifacts:
+    """Retention-bounded index of completed captures.
+
+    One JSON file per capture under ``<model_dir>/debug/profiles/``, stamped
+    with the trigger reason, capture kind, step/round range, and the request
+    trace ids that were in flight — enough to line a capture up against the
+    flight recorder and the distributed-trace store after the fact. Retention
+    (``TFDE_PROFILE_RETAIN``) bounds disk: oldest index entries are pruned.
+    The XProf payloads themselves live wherever jax.profiler put them
+    (``<logdir>/plugins/profile/<ts>``) and are not deleted here — the index
+    is the cheap part we keep tightly bounded and machine-readable.
+    """
+
+    def __init__(self, model_dir: Optional[str], retain: Optional[int] = None):
+        self._dir = (
+            os.path.join(model_dir, PROFILES_SUBDIR) if model_dir else None
+        )
+        if retain is None:
+            retain = knobs.env_int("TFDE_PROFILE_RETAIN", 8)
+        self._retain = max(1, int(retain))
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    @property
+    def dir(self) -> Optional[str]:
+        return self._dir
+
+    def record(
+        self,
+        reason: str,
+        kind: str,
+        start: Optional[int],
+        stop: Optional[int],
+        traces: Optional[List[str]] = None,
+        logdir: Optional[str] = None,
+        **extra,
+    ) -> Optional[str]:
+        """Write one capture record; returns its path (None when no dir)."""
+        if self._dir is None:
+            return None
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+            ts = time.time()
+            safe = "".join(c if c.isalnum() or c in "-_" else "-" for c in reason)[:48]
+            name = f"profile_{ts:017.6f}_{seq:04d}_{safe or 'capture'}.json"
+            rec = {
+                "reason": reason,
+                "kind": kind,
+                "start": start,
+                "stop": stop,
+                "traces": sorted(traces) if traces else [],
+                "logdir": logdir,
+                "host": jax.process_index() if jax.process_count() > 1 else 0,
+                "pid": os.getpid(),
+                "unix_time": ts,
+            }
+            rec.update(extra)
+            path = os.path.join(self._dir, name)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(rec, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+            self._prune()
+            return path
+        except OSError as e:  # index failure must never break the capture
+            log.warning("profile artifact index write failed: %s", e)
+            return None
+
+    def _prune(self) -> None:
+        entries = sorted(
+            f for f in os.listdir(self._dir)
+            if f.startswith("profile_") and f.endswith(".json")
+        )
+        for stale in entries[: max(0, len(entries) - self._retain)]:
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(self._dir, stale))
+
+
+def list_artifacts(model_dir: str) -> List[dict]:
+    """Load every capture record under <model_dir>/debug/profiles/,
+    oldest first (the obs_dump --profiles backend)."""
+    d = os.path.join(model_dir, PROFILES_SUBDIR)
+    out: List[dict] = []
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith("profile_") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                rec = json.load(f)
+            rec["_file"] = name
+            out.append(rec)
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+# --------------------------------------------------------------------------
+# Trigger hub
+# --------------------------------------------------------------------------
+
+# A sink arms a bounded capture: sink(reason, span, info) -> bool (armed).
+TriggerSink = Callable[[str, int, dict], bool]
+
+
+class ProfileTrigger:
+    """Funnel for live anomaly signals -> bounded profile captures.
+
+    SLO burn-rate crossings (slo.py), straggler flags (aggregate.py),
+    recompile storms (recompile.py), and sentry trips (sentry.py) all call
+    ``trigger(reason, ...)``; registered sinks (a StepWindowProfiler in
+    training, a RoundWindowProfiler in serving, the aggregator's cross-host
+    broadcast on the chief) arm the actual capture. Two rate limits keep
+    auto-capture from thrashing the run:
+
+    - global cooldown (``TFDE_PROFILE_COOLDOWN_S``): at most one armed
+      capture per window, regardless of reason;
+    - per-key dedupe (``TFDE_PROFILE_DEDUPE_S``): the *same* reason key
+      can't re-arm until its dedupe window passes, so a storm of identical
+      signals produces one capture, not eight.
+
+    Timestamps are consumed only when a sink actually armed — a refused
+    trigger (window already configured, no logdir) doesn't burn the budget,
+    so the next anomaly still gets its evidence.
+    """
+
+    def __init__(
+        self,
+        cooldown_s: Optional[float] = None,
+        dedupe_s: Optional[float] = None,
+        enabled: Optional[bool] = None,
+        clock=time.monotonic,
+    ):
+        if cooldown_s is None:
+            cooldown_s = knobs.env_float("TFDE_PROFILE_COOLDOWN_S", 120.0)
+        if dedupe_s is None:
+            dedupe_s = knobs.env_float("TFDE_PROFILE_DEDUPE_S", 600.0)
+        if enabled is None:
+            enabled = knobs.env_flag("TFDE_PROFILE_TRIGGERS", True)
+        self.cooldown_s = float(cooldown_s)
+        self.dedupe_s = float(dedupe_s)
+        self.enabled = bool(enabled)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._sinks: Dict[str, TriggerSink] = {}
+        self._last_fire: Optional[float] = None
+        self._last_by_key: Dict[str, float] = {}
+
+    def register(self, name: str, sink: TriggerSink) -> None:
+        with self._lock:
+            self._sinks[name] = sink
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._sinks.pop(name, None)
+
+    def sinks(self) -> List[str]:
+        with self._lock:
+            return sorted(self._sinks)
+
+    def trigger(
+        self,
+        reason: str,
+        key: Optional[str] = None,
+        span: Optional[int] = None,
+        extra_sink: Optional[TriggerSink] = None,
+        **info,
+    ) -> bool:
+        """Request a capture. Returns True when some sink armed one.
+
+        ``key`` scopes dedupe (defaults to the reason); ``extra_sink`` lets
+        a caller offer a capture mechanism without registering (the sentry's
+        own profiler, say). ``info`` rides along to sinks and into the
+        flightrec breadcrumb.
+        """
+        if not self.enabled:
+            return False
+        if span is None:
+            span = knobs.env_int("TFDE_PROFILE_SPAN", 8)
+        span = max(1, int(span))
+        key = key or reason
+        now = self._clock()
+        with self._lock:
+            if self._last_fire is not None and now - self._last_fire < self.cooldown_s:
+                return False
+            last_key = self._last_by_key.get(key)
+            if last_key is not None and now - last_key < self.dedupe_s:
+                return False
+            sinks = list(self._sinks.items())
+        if extra_sink is not None:
+            sinks = sinks + [("extra", extra_sink)]
+        armed_by = []
+        for name, sink in sinks:
+            try:
+                if sink(reason, span, dict(info)):
+                    armed_by.append(name)
+            except Exception as e:  # a broken sink must not mask the others
+                log.warning("profile trigger sink %r failed: %s", name, e)
+        if not armed_by:
+            return False
+        # consume the budget only on success so refusals don't starve the
+        # next real anomaly
+        with self._lock:
+            self._last_fire = now
+            self._last_by_key[key] = now
+        log.warning(
+            "profile trigger %r armed capture (span=%d) via %s",
+            reason, span, ",".join(armed_by),
+        )
+        try:
+            from tfde_tpu.observability import flightrec, metrics
+
+            metrics.default_registry().counter("profile/triggers").incr()
+            flightrec.record(
+                "profile_trigger", reason=reason, span=span,
+                sinks=armed_by, **{k: v for k, v in info.items()
+                                   if isinstance(v, (str, int, float, bool))},
+            )
+        except Exception:  # pragma: no cover
+            pass
+        return True
+
+
+_HUB: Optional[ProfileTrigger] = None
+_HUB_LOCK = threading.Lock()
+
+
+def hub() -> ProfileTrigger:
+    """Process-wide trigger hub (lazily built from the TFDE_PROFILE_* knobs)."""
+    global _HUB
+    with _HUB_LOCK:
+        if _HUB is None:
+            _HUB = ProfileTrigger()
+        return _HUB
+
+
+def trigger(reason: str, **kwargs) -> bool:
+    """Module-level convenience: hub().trigger(...)."""
+    return hub().trigger(reason, **kwargs)
+
+
+def reset_hub() -> None:
+    """Drop the process hub (test hook; next hub() re-reads the knobs)."""
+    global _HUB
+    with _HUB_LOCK:
+        _HUB = None
+
+
+# --------------------------------------------------------------------------
+# Training-side: step windows
+# --------------------------------------------------------------------------
+
+
 class StepWindowProfiler:
     """Trace training-step windows into `<logdir>/plugins/profile/` — the
     ProfilerHook capability (mnist_keras_distributed.py:235-237: save_steps +
@@ -95,9 +417,10 @@ class StepWindowProfiler:
     keep the first-compile out of the trace.
     """
 
-    def __init__(self, logdir: Optional[str], window=None):
+    def __init__(self, logdir: Optional[str], window=None,
+                 artifacts: Optional[ProfileArtifacts] = None):
         if window is None:
-            window = _parse_window(os.environ.get("TFDE_PROFILE", ""))
+            window = _window_from_env()
         elif isinstance(window, str):
             window = _parse_window(window)
         if window is not None and window[0] == "every":
@@ -113,6 +436,10 @@ class StepWindowProfiler:
         self.windows_traced = 0
         self._logdir = logdir
         self._active = False
+        self._artifacts = artifacts
+        self._reason = "window" if window is not None else None
+        self._open_step: Optional[int] = None
+        self._last_step = 0
         if window is not None and logdir is None:
             log.warning("profiling requested but no model_dir — disabled")
             self._window = None
@@ -131,13 +458,12 @@ class StepWindowProfiler:
     def enabled(self) -> bool:
         return self._window is not None
 
-    def arm(self, start_step: int, span: int = 10) -> bool:
+    def arm(self, start_step: int, span: int = 10, reason: str = "auto") -> bool:
         """Arm a one-shot window [start_step, start_step+span) at runtime —
-        the numerics sentry's auto-capture hook (observability/sentry.py):
-        on a trip it arms the next `span` steps so the blow-up's immediate
-        aftermath lands on an XProf timeline. Refuses (returns False) when
-        a window is already configured/active or there is no usable logdir,
-        so auto-capture never clobbers an operator-requested trace."""
+        the trigger hub's capture hook (sentry trips, SLO burn, recompile
+        storms). Refuses (returns False) when a window is already
+        configured/active or there is no usable logdir, so auto-capture
+        never clobbers an operator-requested trace."""
         if self._window is not None or self._active or self._logdir is None:
             return False
         from tfde_tpu.utils import fs
@@ -147,9 +473,15 @@ class StepWindowProfiler:
         if span < 1:
             raise ValueError("span must be >= 1")
         self._window = (int(start_step), int(start_step) + int(span))
-        log.info("profiler: auto-armed window [%d, %d) -> %s/plugins/profile",
-                 self._window[0], self._window[1], self._logdir)
+        self._reason = str(reason)
+        log.info("profiler: auto-armed window [%d, %d) (%s) -> %s/plugins/profile",
+                 self._window[0], self._window[1], self._reason, self._logdir)
         return True
+
+    def trigger_sink(self, reason: str, span: int, info: dict) -> bool:
+        """ProfileTrigger sink: arm a window starting at the next step."""
+        start = int(info.get("step", self._last_step)) + 1
+        return self.arm(start, span, reason=reason)
 
     def _in_window(self, step: int) -> bool:
         if self._window[0] == "every":
@@ -160,6 +492,7 @@ class StepWindowProfiler:
 
     def step(self, step: int) -> None:
         """Call once per train step with the *post-increment* global step."""
+        self._last_step = step
         if self._window is None:
             return
         in_window = self._in_window(step)
@@ -168,15 +501,30 @@ class StepWindowProfiler:
                 "profiler: trace window opening at step %d -> %s/plugins/profile",
                 step, self._logdir,
             )
-            jax.profiler.start_trace(self._logdir)
+            _start_trace(self._logdir)
             self._set_spans(True)
             self._active = True
+            self._open_step = step
         elif self._active and not in_window:
-            self._set_spans(False)
-            jax.profiler.stop_trace()
-            self._active = False
-            self.windows_traced += 1
+            self._close_window(step)
             log.info("profiler: trace complete at step %d", step)
+
+    def _close_window(self, step: int) -> None:
+        self._set_spans(False)
+        _stop_trace()
+        self._active = False
+        self.windows_traced += 1
+        if self._artifacts is not None:
+            self._artifacts.record(
+                self._reason or "window", "step",
+                self._open_step, step, logdir=self._logdir,
+            )
+        # a one-shot auto-armed window is consumed on close so the next
+        # trigger can arm again; repeating/explicit windows stay configured
+        if self._reason not in (None, "window"):
+            self._window = None
+            self._reason = None
+        self._open_step = None
 
     @staticmethod
     def _set_spans(active: bool) -> None:
@@ -188,7 +536,112 @@ class StepWindowProfiler:
 
     def close(self) -> None:
         if self._active:
-            self._set_spans(False)
-            jax.profiler.stop_trace()
-            self._active = False
-            self.windows_traced += 1
+            self._close_window(self._last_step)
+
+
+# --------------------------------------------------------------------------
+# Serving-side: decode-round windows
+# --------------------------------------------------------------------------
+
+
+class RoundWindowProfiler:
+    """Bounded capture over continuous-batcher decode rounds — the serving
+    sibling of StepWindowProfiler. There is no global step in serving, so
+    windows are measured in decode rounds: ``arm(span)`` opens a trace at
+    the next round boundary and closes it ``span`` rounds later, recording
+    an artifact stamped with the round range and every request trace id
+    that was in flight during the window.
+
+    Driven by the batcher: ``on_round(rounds, traces)`` once per step with
+    the cumulative round count and the active trace ids.
+    """
+
+    def __init__(self, logdir: Optional[str],
+                 artifacts: Optional[ProfileArtifacts] = None):
+        from tfde_tpu.utils import fs
+
+        if logdir is not None and fs.is_remote(logdir):
+            log.warning("round profiling to a remote dir (%s) is not "
+                        "supported — disabled", logdir)
+            logdir = None
+        self._logdir = logdir
+        self._artifacts = artifacts
+        self._lock = threading.Lock()
+        self._armed_span = 0
+        self._reason: Optional[str] = None
+        self._active = False
+        self._open_round: Optional[int] = None
+        self._stop_round: Optional[int] = None
+        self._traces: set = set()
+        self.windows_traced = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self._logdir is not None
+
+    def arm(self, span: Optional[int] = None, reason: str = "manual") -> bool:
+        """Arm a capture of the next `span` decode rounds. Refuses when a
+        capture is already armed/active or there is no usable logdir."""
+        if span is None:
+            span = knobs.env_int("TFDE_PROFILE_SPAN", 8)
+        if span < 1:
+            raise ValueError("span must be >= 1")
+        with self._lock:
+            if self._logdir is None or self._active or self._armed_span:
+                return False
+            self._armed_span = int(span)
+            self._reason = str(reason)
+        log.info("round profiler: armed %d-round capture (%s) -> %s",
+                 span, reason, self._logdir)
+        return True
+
+    def trigger_sink(self, reason: str, span: int, info: dict) -> bool:
+        """ProfileTrigger sink."""
+        return self.arm(span=span, reason=reason)
+
+    def on_round(self, rounds: int, traces=None) -> None:
+        """Batcher hook: cumulative decode-round count after each step."""
+        with self._lock:
+            if self._active:
+                if traces:
+                    self._traces.update(traces)
+                if rounds >= self._stop_round:
+                    self._close_locked(rounds)
+                return
+            if self._armed_span and self._logdir is not None:
+                _start_trace(self._logdir)
+                from tfde_tpu.observability import spans
+
+                spans.set_trace_active(True)
+                self._active = True
+                self._open_round = rounds
+                self._stop_round = rounds + self._armed_span
+                self._armed_span = 0
+                if traces:
+                    self._traces.update(traces)
+                log.info("round profiler: trace open at round %d (until %d)",
+                         rounds, self._stop_round)
+
+    def _close_locked(self, rounds: int) -> None:
+        from tfde_tpu.observability import spans
+
+        spans.set_trace_active(False)
+        _stop_trace()
+        self._active = False
+        self.windows_traced += 1
+        if self._artifacts is not None:
+            self._artifacts.record(
+                self._reason or "manual", "round",
+                self._open_round, rounds,
+                traces=list(self._traces), logdir=self._logdir,
+            )
+        log.info("round profiler: trace complete at round %d (%s)",
+                 rounds, self._reason)
+        self._reason = None
+        self._open_round = self._stop_round = None
+        self._traces = set()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._active:
+                self._close_locked(self._stop_round or 0)

@@ -221,6 +221,18 @@ class Site:
             self.name, self.unexpected, self.misses,
             len(self._fingerprints), self.seconds,
         )
+        try:
+            # a storm is exactly when an XProf timeline answers "what shape
+            # keeps changing" — ask the trigger hub for a bounded capture
+            from tfde_tpu.observability import profiler
+
+            profiler.trigger(
+                "recompile_storm", key=f"recompile_storm:{self.name}",
+                site=self.name, unexpected=self.unexpected,
+                signatures=len(self._fingerprints),
+            )
+        except Exception:  # escalation must never raise into the hot path
+            pass
 
     def snapshot(self) -> dict:
         with _lock:

@@ -222,7 +222,16 @@ class SentryMonitor:
             "step %d)", flag, trip_step, step,
         )
         if self.cfg.profile_span > 0 and self.profiler is not None:
-            armed = self.profiler.arm(step + 1, self.cfg.profile_span)
+            # route through the trigger hub (cooldown/dedupe shared with
+            # SLO-burn/straggler/recompile triggers); the extra_sink keeps
+            # this working when the profiler is not hub-registered
+            from tfde_tpu.observability import profiler as _prof
+
+            armed = _prof.trigger(
+                "sentry_trip", key=f"sentry_trip:{flag}",
+                span=self.cfg.profile_span, step=step, flag=flag,
+                extra_sink=self.profiler.trigger_sink,
+            )
             if armed:
                 flightrec.record("sentry_profile_armed", start=step + 1,
                                  span=self.cfg.profile_span)

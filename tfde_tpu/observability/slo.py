@@ -38,6 +38,10 @@ DEFAULT_OBJECTIVE = 0.99
 DEFAULT_WINDOWS = (300.0, 3600.0)
 #: per-metric sample ring bound — at 10k rps nobody wants this unbounded
 MAX_SAMPLES = 65536
+#: a burn-threshold crossing needs at least this many in-window samples
+#: before it can trigger a capture — one slow request at cold start is not
+#: an incident
+MIN_BURN_SAMPLES = 8
 
 
 def _env_float(name: str, default: float) -> float:
@@ -78,6 +82,11 @@ class SLOTracker:
         }
         self._total = {"ttft": 0, "tpot": 0}
         self._ok = {"ttft": 0, "tpot": 0}
+        # burn-rate capture trigger: fire on the upward crossing of the
+        # fast-window burn past TFDE_PROFILE_BURN_THRESHOLD (edge-detected
+        # per metric so a sustained burn triggers once, not per request)
+        self.burn_threshold = _env_float("TFDE_PROFILE_BURN_THRESHOLD", 10.0)
+        self._burning = {"ttft": False, "tpot": False}
         self._publish_targets()
 
     # -- ingest --------------------------------------------------------------
@@ -155,3 +164,32 @@ class SLOTracker:
                 if burn is not None:
                     self._reg.gauge(
                         f"slo/{metric}_burn_rate_{int(w)}s").set(burn)
+            self._maybe_trigger_capture(metric)
+
+    def _maybe_trigger_capture(self, metric: str) -> None:
+        """Fast-window burn crossing -> profile trigger hub. Edge-detected:
+        fires on the upward crossing only, and the hub's cooldown/dedupe
+        bound how often evidence capture can actually arm."""
+        if self.burn_threshold <= 0 or not self.windows:
+            return
+        fast = self.windows[0]
+        with self._lock:
+            cut = self._clock() - fast
+            rows = [ok for (t, ok) in self._samples[metric] if t >= cut]
+        if len(rows) < MIN_BURN_SAMPLES:
+            return
+        att = sum(rows) / len(rows)
+        burn = (1.0 - att) / (1.0 - self.objective)
+        above = burn >= self.burn_threshold
+        fire = above and not self._burning[metric]
+        self._burning[metric] = above
+        if not fire:
+            return
+        from tfde_tpu.observability import profiler
+
+        profiler.trigger(
+            f"slo_burn_{metric}",
+            burn_rate=round(burn, 2),
+            window_s=fast,
+            threshold=self.burn_threshold,
+        )

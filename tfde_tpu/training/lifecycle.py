@@ -441,11 +441,22 @@ class Estimator:
         with span("train/init"):  # second init chunk: writers/manager/feed
             writer = self._writer()
             mngr = self._ckpt_mngr()
+            from tfde_tpu.observability import profiler as profiler_lib
+
+            artifacts = (
+                profiler_lib.ProfileArtifacts(cfg.model_dir)
+                if self._is_chief and cfg.model_dir is not None else None
+            )
             profiler = (
-                StepWindowProfiler(cfg.model_dir, cfg.profile_steps)
+                StepWindowProfiler(cfg.model_dir, cfg.profile_steps,
+                                   artifacts=artifacts)
                 if self._is_chief
                 else StepWindowProfiler(None, None)
             )
+            # hub registration: SLO-burn/straggler/recompile-storm triggers
+            # can now arm a bounded step-window capture on this run
+            profiler_lib.hub().register("train_step_window",
+                                        profiler.trigger_sink)
 
             def batches():
                 yield first
@@ -623,6 +634,7 @@ class Estimator:
 
             self._state = state
             profiler.close()
+            profiler_lib.hub().unregister("train_step_window")
             flightrec.record(
                 "train_end", step=step,
                 preempted=(None if guard.fired is None else int(guard.fired)),

@@ -366,6 +366,34 @@ def dump_trace(trace_id: str, router_url=None, model_dir=None,
     return 0
 
 
+def dump_profiles(model_dir: str) -> int:
+    """The capture index: every debug/profiles/*.json record — what
+    triggered it, the step/round window it covered, and the request trace
+    ids that were in flight (feed those back to --trace)."""
+    # same lazy package import + path shim as --trace
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tfde_tpu.observability import profiler
+
+    recs = profiler.list_artifacts(model_dir)
+    if not recs:
+        print(f"no profile captures under {model_dir}/debug/profiles "
+              f"(nothing triggered, or retention pruned them)")
+        return 1
+    print(f"== profile captures ({len(recs)}) under {model_dir}")
+    for r in recs:
+        window = f"[{r.get('start')}, {r.get('stop')}]"
+        traces = r.get("traces") or []
+        shown = ",".join(traces[:4]) + ("…" if len(traces) > 4 else "")
+        print(f"  {r.get('_file')}: reason={r.get('reason')} "
+              f"kind={r.get('kind')} {window} host={r.get('host')}"
+              + (f" traces={shown}" if traces else ""))
+        if r.get("logdir"):
+            print(f"    xprof -> {r['logdir']}/plugins/profile/ "
+                  f"(TensorBoard profile plugin)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("model_dir", nargs="?",
@@ -387,6 +415,10 @@ def main(argv=None) -> int:
                     help="memory & compile view of a model_dir: per-"
                          "program peak bytes, per-site compile counters, "
                          "live-buffer trend, top-K largest buffers")
+    ap.add_argument("--profiles", action="store_true",
+                    help="list the triggered-capture index under "
+                         "<model_dir>/debug/profiles: trigger reason, "
+                         "step/round window, in-flight trace ids")
     args = ap.parse_args(argv)
     if not args.model_dir and not args.url and not args.router:
         ap.error("give a model_dir, --url, --router, or a combination")
@@ -394,7 +426,11 @@ def main(argv=None) -> int:
         ap.error("--trace needs --router (live) or a model_dir (dumps)")
     if args.mem and not args.model_dir:
         ap.error("--mem needs a model_dir")
+    if args.profiles and not args.model_dir:
+        ap.error("--profiles needs a model_dir")
 
+    if args.profiles:
+        return dump_profiles(args.model_dir)
     if args.mem:
         return dump_mem(args.model_dir)
     if args.trace:

@@ -117,6 +117,28 @@ if timeout -k 10 420 env JAX_PLATFORMS=cpu TFDE_LINTGATE_INJECT=1 \
 else
     echo "LINTGATE_INJECT=pass"
 fi
+# Perf trendline gate: every committed BENCH_*.json parsed in round order
+# and the latest comparable capture diffed per-metric against the
+# direction/slack policy (tools/trendgate_policy.json). A hardware capture
+# that regressed a gated metric past its slack fails tier-1 here;
+# re-render the report after a deliberate change with:
+# python tools/trendgate.py --update
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python tools/trendgate.py --check; then
+    echo "TRENDGATE=fail"
+    [ $rc -eq 0 ] && rc=1
+else
+    echo "TRENDGATE=pass"
+fi
+# Injection self-test: synthesize a latest capture with every gated metric
+# regressed past 2x slack — the gate must FAIL, proving it bites.
+if timeout -k 10 120 env JAX_PLATFORMS=cpu TFDE_TRENDGATE_INJECT=1 \
+    python tools/trendgate.py --check >/dev/null 2>&1; then
+    echo "TRENDGATE_INJECT=fail (seeded regression did not fail the gate)"
+    [ $rc -eq 0 ] && rc=1
+else
+    echo "TRENDGATE_INJECT=pass"
+fi
 if [ -f /tmp/_t1.passed ]; then
     prev=$(cat /tmp/_t1.passed)
     echo DOTS_DELTA=$((passed - prev))
