@@ -1,0 +1,291 @@
+"""Elastic topology-change tests (ISSUE 13): a supervised run that loses a
+peer does not merely restart — it re-resolves the cluster from the
+survivors, comes back up at the smaller world size, and resumes from the
+last committed checkpoint bit-exact against an uninterrupted oracle.
+
+Methodology mirrors test_supervisor.py: ONE constant batch every step so
+resume equivalence is decidable by a params digest. The in-process drill
+fakes a 2-process world through the bare ``TFDE_*`` env contract (no
+``jax.distributed`` runtime is ever started — world 2 is never
+bootstrapped, and after the shrink world 1 needs none), so the elastic
+machinery under test is exactly the production sequence: classify
+TOPOLOGY -> consume suspects -> shrink env -> re-bootstrap -> resume.
+The real two-OS-process kill drill lives in test_multiprocess.py.
+"""
+
+import json
+import os
+
+import jax
+import pytest
+
+from tfde_tpu.observability import counters, metrics
+from tfde_tpu.resilience import (
+    ElasticConfig,
+    PeerLossFault,
+    StepFaults,
+    Supervisor,
+    SupervisorAborted,
+)
+from tfde_tpu.resilience import elastic
+from tfde_tpu.resilience.supervisor import FailureKind, classify_failure
+from tfde_tpu.runtime import cluster
+
+from test_supervisor import (
+    MAX_STEPS,
+    constant_input_fn,
+    digest,
+    fast_restart,
+    make_factory,
+    oracle,  # noqa: F401  (module-scoped fixture, reused by name)
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_elastic_state():
+    """Module-global state (suspects, last bootstrap info, batch segment)
+    must not leak between tests — or into other test files."""
+    saved_info = cluster._LAST_INFO
+    saved_seg = elastic._LAST_SEGMENT
+    elastic.clear_suspects()
+    counters.reset("resilience/")
+    cluster._LAST_INFO = None
+    elastic._LAST_SEGMENT = None
+    yield
+    elastic.clear_suspects()
+    cluster._LAST_INFO = saved_info
+    elastic._LAST_SEGMENT = saved_seg
+
+
+def _fake_world(monkeypatch, n=2, rank=0, coordinator=None):
+    """Declare an n-process world through the bare TFDE_* contract."""
+    monkeypatch.setenv("TFDE_NUM_PROCESSES", str(n))
+    monkeypatch.setenv("TFDE_PROCESS_ID", str(rank))
+    if coordinator:
+        monkeypatch.setenv("TFDE_COORDINATOR", coordinator)
+    else:
+        monkeypatch.delenv("TFDE_COORDINATOR", raising=False)
+    monkeypatch.delenv("TF_CONFIG", raising=False)
+    monkeypatch.delenv("CLUSTER_SPEC", raising=False)
+
+
+# -- config resolution ---------------------------------------------------------
+def test_resolve_semantics(monkeypatch):
+    monkeypatch.delenv("TFDE_ELASTIC", raising=False)
+    assert elastic.resolve(None) is None  # off by default
+    assert elastic.resolve(False) is None
+    cfg = ElasticConfig(min_world=3)
+    assert elastic.resolve(cfg) is cfg  # explicit config passes through
+    monkeypatch.setenv("TFDE_ELASTIC", "on")
+    monkeypatch.setenv("TFDE_ELASTIC_MAX_CHANGES", "7")
+    monkeypatch.setenv("TFDE_ELASTIC_MIN_WORLD", "2")
+    tuned = elastic.resolve(None)
+    assert tuned is not None
+    assert tuned.max_topology_changes == 7
+    assert tuned.min_world == 2
+    monkeypatch.setenv("TFDE_ELASTIC", "off")
+    assert elastic.resolve(None) is None
+    assert elastic.resolve(True) is not None  # True overrides the off flag
+
+
+# -- suspicion registry & failure shapes ---------------------------------------
+def test_suspect_registry_dedups(monkeypatch):
+    elastic.note_peer_lost(3, "heartbeat silence")
+    elastic.note_peer_lost(3, "socket died")  # re-note: free, keeps first-seen
+    assert counters.value("resilience/peers_lost") == 1
+    assert set(elastic.suspects()) == {3}
+    elastic.note_peer_lost(1, "drill")
+    assert counters.value("resilience/peers_lost") == 2
+    elastic.clear_suspects()
+    assert elastic.suspects() == {}
+
+
+def test_looks_like_peer_loss_shapes():
+    assert elastic.looks_like_peer_loss(elastic.PeerLostError(1, "x"))
+    assert elastic.looks_like_peer_loss(
+        RuntimeError("gloo: Connection reset by peer [rank 1]"))
+    assert elastic.looks_like_peer_loss(OSError("Broken pipe"))
+    # a local shape bug or file error must never trigger a topology change
+    assert not elastic.looks_like_peer_loss(RuntimeError("shape mismatch"))
+    assert not elastic.looks_like_peer_loss(ValueError("connection reset"))
+
+
+def test_peer_loss_fault_raises_and_registers_suspect():
+    fault = PeerLossFault(rank=1, reason="injected")
+    with pytest.raises(elastic.PeerLostError) as ei:
+        fault.fire("batch draw")
+    assert ei.value.rank == 1
+    assert classify_failure(ei.value) is FailureKind.TOPOLOGY
+    assert 1 in elastic.suspects()
+
+
+# -- env shrink ----------------------------------------------------------------
+def test_shrink_env_tfde_contract(monkeypatch):
+    _fake_world(monkeypatch, n=4, rank=2, coordinator="a:1234")
+    old = cluster.resolve_cluster()
+    assert old.num_processes == 4 and old.process_id == 2
+    new_world, new_rank = elastic.shrink_env(old, [1])
+    assert (new_world, new_rank) == (3, 1)  # survivors [0, 2, 3], dense
+    assert os.environ["TFDE_NUM_PROCESSES"] == "3"
+    assert os.environ["TFDE_PROCESS_ID"] == "1"
+    # rank 0 survived: same coordinator host, but the port moves one over
+    # — the abandoned topology's coordination service still holds :1234
+    # (tearing it down with a dead peer is fatal, so it is parked alive)
+    assert os.environ["TFDE_COORDINATOR"] == "a:1235"
+
+
+def test_shrink_env_refuses_to_shrink_around_self(monkeypatch):
+    _fake_world(monkeypatch, n=2, rank=0)
+    with pytest.raises(ValueError, match="cannot shrink around self"):
+        elastic.shrink_env(cluster.resolve_cluster(), [0, 1])
+
+
+def test_shrink_env_drops_coordinator_when_alone(monkeypatch):
+    # bare TFDE_* contract, rank 0 (the coordinator host) lost, one
+    # survivor: no coordinator is needed at world 1, so the stale env
+    # entry must go away instead of pointing at a dead host
+    _fake_world(monkeypatch, n=2, rank=1, coordinator="dead:1234")
+    new_world, new_rank = elastic.shrink_env(cluster.resolve_cluster(), [0])
+    assert (new_world, new_rank) == (1, 0)
+    assert "TFDE_COORDINATOR" not in os.environ
+
+
+def test_shrink_env_tf_config_reelects_coordinator(monkeypatch):
+    monkeypatch.delenv("TFDE_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("TFDE_PROCESS_ID", raising=False)
+    monkeypatch.delenv("TFDE_COORDINATOR", raising=False)
+    monkeypatch.setenv("TF_CONFIG", json.dumps({
+        "cluster": {"worker": ["a:1000", "b:1000", "c:1000"]},
+        "task": {"type": "worker", "index": 2},
+    }))
+    old = cluster.resolve_cluster()
+    assert old.num_processes == 3 and old.coordinator_address == "a:1000"
+    new_world, new_rank = elastic.shrink_env(old, [0])  # the chief died
+    assert (new_world, new_rank) == (2, 1)
+    fresh = cluster.resolve_cluster()
+    assert fresh.num_processes == 2 and fresh.process_id == 1
+    # coordinator re-election = lowest surviving rank's host
+    assert fresh.coordinator_address == "b:1000"
+
+
+# -- semantic continuity -------------------------------------------------------
+def test_per_process_batch_preserves_global(monkeypatch):
+    assert elastic.per_process_batch(64, world=4) == 16
+    assert elastic.per_process_batch(64, world=1) == 64
+    with pytest.raises(ValueError, match="does not divide"):
+        elastic.per_process_batch(64, world=3)
+    with pytest.raises(ValueError, match="world must be"):
+        elastic.per_process_batch(64, world=0)
+    _fake_world(monkeypatch, n=2)
+    assert elastic.per_process_batch(64) == 32  # world from the env
+
+
+def test_note_batch_tracks_world_segments():
+    elastic.note_batch(8, 2)
+    assert metrics.gauge("cluster/world_size").value == 2
+    elastic.note_batch(16, 1)  # same global batch at the smaller world
+    assert metrics.gauge("cluster/world_size").value == 1
+    assert elastic._LAST_SEGMENT == (1, 16)
+
+
+# -- the elastic drill (acceptance criterion) ----------------------------------
+def test_lost_peer_shrinks_world_and_resumes_bit_exact(
+        tmp_path, oracle, monkeypatch):  # noqa: F811
+    """The acceptance drill, in-process: a declared 2-process run loses
+    peer rank 1 mid-training (after the step-4 checkpoint committed). The
+    supervisor classifies TOPOLOGY, shrinks the env to world 1,
+    re-bootstraps, and resumes — final params identical to an
+    uninterrupted single-process run on the same data order (the data
+    order IS preserved: one constant batch, global batch unchanged)."""
+    _fake_world(monkeypatch, n=2, rank=0)
+    d = str(tmp_path / "run")
+    faults = StepFaults({7: PeerLossFault(rank=1)})
+    sup = Supervisor(
+        make_factory(d),
+        fast_restart(max_restarts=3, elastic=ElasticConfig()),
+    )
+    state = sup.run(faults.wrap_input_fn(constant_input_fn), MAX_STEPS)
+    assert int(jax.device_get(state.step)) == MAX_STEPS
+    assert sup.restarts == 1
+    assert digest(state) == oracle
+    # the world actually shrank: env rewritten, runtime re-resolved
+    assert os.environ["TFDE_NUM_PROCESSES"] == "1"
+    assert os.environ["TFDE_PROCESS_ID"] == "0"
+    assert cluster.last_info() is not None
+    assert cluster.last_info().num_processes == 1
+    assert metrics.gauge("cluster/world_size").value == 1
+    assert counters.value("resilience/topology_changes") == 1
+    assert counters.value("resilience/peers_lost") == 1
+    # the re-bootstrap tax feeds the goodput ledger's restart_loss
+    assert counters.value("resilience/rebootstrap_seconds") > 0
+    assert elastic.suspects() == {}  # consumed by the re-bootstrap
+
+
+def test_untyped_peer_loss_upgrades_to_topology(
+        tmp_path, oracle, monkeypatch):  # noqa: F811
+    """A survivor's collective usually dies with an untyped RuntimeError,
+    not a PeerLostError. With elastic on and a distributed env declared,
+    the message heuristic upgrades it to TOPOLOGY; with no identified
+    suspect, presume-lost shrinks to self."""
+    from tfde_tpu.resilience import RaiseFault
+
+    _fake_world(monkeypatch, n=2, rank=0)
+    d = str(tmp_path / "run")
+    faults = StepFaults({7: RaiseFault(
+        exc_type=RuntimeError,
+        message="gloo: Connection reset by peer [rank 1]")})
+    sup = Supervisor(
+        make_factory(d),
+        fast_restart(max_restarts=3, elastic=ElasticConfig()),
+    )
+    state = sup.run(faults.wrap_input_fn(constant_input_fn), MAX_STEPS)
+    assert int(jax.device_get(state.step)) == MAX_STEPS
+    assert sup.restarts == 1
+    assert digest(state) == oracle
+    assert os.environ["TFDE_NUM_PROCESSES"] == "1"
+    assert counters.value("resilience/topology_changes") == 1
+
+
+def test_elastic_disabled_restarts_at_old_world(
+        tmp_path, oracle, monkeypatch):  # noqa: F811
+    """Without elastic, a peer loss is still a restartable failure — but
+    nothing rewrites the env (the pre-elastic behavior, preserved)."""
+    monkeypatch.delenv("TFDE_ELASTIC", raising=False)
+    _fake_world(monkeypatch, n=2, rank=0)
+    d = str(tmp_path / "run")
+    faults = StepFaults({7: PeerLossFault(rank=1)})
+    sup = Supervisor(make_factory(d), fast_restart(max_restarts=3))
+    state = sup.run(faults.wrap_input_fn(constant_input_fn), MAX_STEPS)
+    assert sup.restarts == 1
+    assert digest(state) == oracle
+    assert os.environ["TFDE_NUM_PROCESSES"] == "2"  # untouched
+    assert counters.value("resilience/topology_changes") == 0
+
+
+def test_topology_change_budget_aborts(tmp_path, monkeypatch):
+    """A cluster that keeps losing peers must converge to an abort, not
+    loop forever re-bootstrapping."""
+    _fake_world(monkeypatch, n=2, rank=0)
+    faults = StepFaults({2: PeerLossFault(rank=1)}, fires_once=False)
+    sup = Supervisor(
+        make_factory(str(tmp_path / "b")),
+        fast_restart(max_restarts=9, no_progress_limit=99,
+                     elastic=ElasticConfig(max_topology_changes=1)),
+    )
+    with pytest.raises(SupervisorAborted, match="topology-change budget"):
+        sup.run(faults.wrap_input_fn(constant_input_fn), MAX_STEPS)
+    assert sup.restarts == 1
+
+
+def test_min_world_refuses_to_resume(tmp_path, monkeypatch):
+    """min_world > survivors: the re-bootstrap refuses and the supervisor
+    aborts — a run that NEEDS N hosts must not silently limp on at 1."""
+    _fake_world(monkeypatch, n=2, rank=0)
+    faults = StepFaults({7: PeerLossFault(rank=1)})
+    sup = Supervisor(
+        make_factory(str(tmp_path / "m")),
+        fast_restart(max_restarts=3,
+                     elastic=ElasticConfig(min_world=2)),
+    )
+    with pytest.raises(SupervisorAborted, match="re-bootstrap failed"):
+        sup.run(faults.wrap_input_fn(constant_input_fn), MAX_STEPS)

@@ -569,6 +569,245 @@ def test_killed_replica_drains_to_survivor(tmp_path):
                 p.communicate()
 
 
+_ELASTIC_CHILD = textwrap.dedent(
+    """
+    import hashlib, json, os, signal, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from tfde_tpu.utils.devices import request_cpu_devices
+    request_cpu_devices(1)
+    import numpy as np, optax
+    from tfde_tpu import bootstrap
+    from tfde_tpu.data.pipeline import AutoShardPolicy
+    from tfde_tpu.models.cnn import PlainCNN
+    from tfde_tpu.observability import counters, flightrec, metrics
+    from tfde_tpu.parallel.strategies import MultiWorkerMirroredStrategy
+    from tfde_tpu.resilience import (
+        ElasticConfig, PeerLossFault, RetryPolicy, Supervisor,
+        SupervisorConfig,
+    )
+    from tfde_tpu.training.lifecycle import Estimator, RunConfig
+
+    mode, model_dir, hb_path, opt_sharding = sys.argv[1:5]
+    # kill two full steps after the step-5 save: the async commit barrier
+    # needs both processes alive to finalize, and steps 6-7's collectives
+    # guarantee they were
+    MAX_STEPS, SAVE_EVERY, KILL_AT = 12, 5, 10
+    rng = np.random.default_rng(0)  # same arrays on every host
+    X = rng.random((16, 784), np.float32)
+    Y = rng.integers(0, 10, (16, 1)).astype(np.int32)
+
+    info = bootstrap()
+
+    if info.num_processes == 2 and info.process_id == 1:
+        # a TRUE liveness heartbeat, decoupled from step timing: beating
+        # from the training loop itself would conflate "slow step" (ZeRO
+        # compile, loaded machine) with "dead peer" and let rank 0 accuse
+        # a live rank 1 — SIGKILL stops this thread with the process
+        import threading
+
+        def _beat():
+            while True:
+                with open(hb_path + ".tmp", "w") as f:
+                    f.write("alive")
+                os.replace(hb_path + ".tmp", hb_path)
+                time.sleep(0.25)
+
+        threading.Thread(target=_beat, daemon=True).start()
+
+    def input_fn():
+        # every host yields the full GLOBAL batch; OFF policy slices the
+        # current process's portion — so the global batch (and with it
+        # the loss trajectory) is preserved across a world change with
+        # no caller-side re-tuning
+        world, rank = jax.process_count(), jax.process_index()
+        def gen():
+            n = 0
+            while True:
+                n += 1
+                if world == 2 and rank == 1 and n == KILL_AT:
+                    os.kill(os.getpid(), signal.SIGKILL)  # no teardown
+                if world == 2 and rank == 0 and n == KILL_AT:
+                    # production detection channel, deterministic in-suite:
+                    # the peer's heartbeat file goes stale (the analog of
+                    # health.note_stale_host's metric-push staleness) --
+                    # accuse BEFORE entering the step's collective
+                    deadline = time.time() + 120
+                    while time.time() < deadline:
+                        if time.time() - os.path.getmtime(hb_path) > 2.0:
+                            PeerLossFault(
+                                rank=1, reason="heartbeat stale",
+                            ).fire("input_fn")
+                        time.sleep(0.1)
+                    raise RuntimeError("peer heartbeat never went stale")
+                yield (X, Y)
+        return gen()
+
+    def factory():
+        return Estimator(
+            model=PlainCNN(),
+            optimizer=optax.sgd(0.1),
+            strategy=MultiWorkerMirroredStrategy(opt_sharding=opt_sharding),
+            config=RunConfig(
+                model_dir=model_dir,
+                save_checkpoints_steps=SAVE_EVERY,
+                save_summary_steps=10_000,
+                log_step_count_steps=10_000,
+            ),
+        )
+
+    if mode == "elastic":
+        sup = Supervisor(factory, SupervisorConfig(
+            max_restarts=3,
+            restart_policy=RetryPolicy(initial_backoff=0.01, jitter=0.0),
+            elastic=ElasticConfig(),
+        ))
+        state = sup.run(input_fn, MAX_STEPS,
+                        shard_policy=AutoShardPolicy.OFF)
+        restarts = sup.restarts
+        dump = flightrec.dump("elastic_drill")
+    else:  # oracle: plain single-process resume from the copied checkpoint
+        est = factory()
+        state = est.train(input_fn, MAX_STEPS,
+                          shard_policy=AutoShardPolicy.OFF)
+        est.close()
+        restarts, dump = 0, None
+
+    leaves = jax.tree_util.tree_flatten_with_path(
+        jax.device_get(state.params))[0]
+    h = hashlib.sha256()
+    for path, leaf in sorted(leaves, key=lambda kv: str(kv[0])):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    print(json.dumps({
+        "process_id": info.process_id,
+        "step": int(jax.device_get(state.step)),
+        "restarts": restarts,
+        "world": jax.process_count(),
+        "topology_changes": counters.value("resilience/topology_changes"),
+        "world_gauge": metrics.gauge("cluster/world_size").value,
+        "params_sha": h.hexdigest(),
+        "flight_dump": dump,
+    }))
+    """
+)
+
+
+@pytest.mark.parametrize("opt_sharding", ["replicated", "shard"])
+def test_sigkill_peer_elastic_resume(tmp_path, opt_sharding):
+    """ISSUE 13 acceptance drill: two REAL processes train sync-DP over
+    loopback; rank 1 SIGKILLs itself mid-training (after the step-5
+    checkpoint committed). The survivor classifies the loss as TOPOLOGY,
+    shrinks the cluster env around the dead rank, re-bootstraps at world
+    1, and resumes from the checkpoint to max_steps — with final params
+    IDENTICAL to a single-process oracle resumed from the same
+    checkpoint (loss-trajectory continuity: OFF-policy hosts feed slices
+    of one constant global batch, so the post-resume segment is bit-
+    comparable). The 'shard' cell saves 2-way ZeRO-packed optimizer
+    state and must restore it at world 1 through the cross-world
+    bridge."""
+    import glob
+    import shutil
+    import signal
+    import time
+
+    from tfde_tpu.observability import flightrec
+
+    script = tmp_path / "child_elastic.py"
+    script.write_text(_ELASTIC_CHILD)
+    model_dir = str(tmp_path / "run")
+    hb_path = str(tmp_path / "hb1")
+    # rank 1's heartbeat exists before rank 0 can stat it
+    with open(hb_path, "w") as f:
+        f.write("0")
+
+    ports = [_free_port(), _free_port()]
+    cluster = {"worker": [f"127.0.0.1:{p}" for p in ports]}
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.update(
+            CLUSTER_SPEC=json.dumps(cluster),
+            TASK_INDEX=str(i),
+            JOB_NAME="worker",
+            PYTHONPATH=os.pathsep.join(
+                [os.path.dirname(os.path.dirname(__file__))]
+                + env.get("PYTHONPATH", "").split(os.pathsep)
+            ),
+        )
+        env.pop("TF_CONFIG", None)
+        # stderr to files, not pipes: a hung child's log survives the
+        # timeout kill and is the only record of where it stuck
+        errf = open(tmp_path / f"rank{i}.stderr", "w")
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script),
+                 "elastic", model_dir, hb_path, opt_sharding],
+                env=env, stdout=subprocess.PIPE, stderr=errf, text=True,
+            )
+        )
+
+    def child_err(i):
+        return (tmp_path / f"rank{i}.stderr").read_text()[-5000:]
+
+    try:
+        # rank 1 dies BY SIGKILL — unannounced, no flight dump, no teardown
+        out1, _ = procs[1].communicate(timeout=300)
+        assert procs[1].returncode == -signal.SIGKILL, (
+            procs[1].returncode, child_err(1))
+        # the survivor finishes the run at world 1
+        out0, _ = procs[0].communicate(timeout=300)
+        assert procs[0].returncode == 0, f"survivor failed:\n{child_err(0)}"
+        res = json.loads(out0.strip().splitlines()[-1])
+        assert res["step"] == 12
+        assert res["restarts"] == 1
+        assert res["world"] == 1
+        assert res["world_gauge"] == 1
+        assert res["topology_changes"] == 1
+
+        # the flight ring tells the whole story
+        assert res["flight_dump"] and os.path.exists(res["flight_dump"])
+        kinds = [e["kind"] for e in flightrec.load(res["flight_dump"])]
+        for kind in ("peer_lost", "env_shrunk", "topology_change",
+                     "batch_retune"):
+            assert kind in kinds, (kind, kinds)
+
+        # loss-trajectory continuity: a single-process oracle resuming the
+        # SAME step-5 checkpoint must land on identical params (prune the
+        # later checkpoints the survivor wrote after its re-bootstrap)
+        oracle_dir = str(tmp_path / "oracle")
+        shutil.copytree(model_dir, oracle_dir)
+        ckdir = os.path.join(oracle_dir, "checkpoints")
+        steps = sorted(int(d) for d in os.listdir(ckdir) if d.isdigit())
+        assert 5 in steps, f"step-5 checkpoint not retained: {steps}"
+        for d in steps:
+            if d > 5:
+                shutil.rmtree(os.path.join(ckdir, str(d)))
+        env = dict(os.environ)
+        for k in ("TF_CONFIG", "CLUSTER_SPEC", "TASK_INDEX", "JOB_NAME",
+                  "TFDE_NUM_PROCESSES", "TFDE_PROCESS_ID",
+                  "TFDE_COORDINATOR"):
+            env.pop(k, None)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(__file__))]
+            + env.get("PYTHONPATH", "").split(os.pathsep)
+        )
+        oracle = subprocess.run(
+            [sys.executable, str(script),
+             "oracle", oracle_dir, hb_path, opt_sharding],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert oracle.returncode == 0, f"oracle failed:\n{oracle.stderr[-3000:]}"
+        ores = json.loads(oracle.stdout.strip().splitlines()[-1])
+        assert ores["step"] == 12
+        assert ores["params_sha"] == res["params_sha"], (
+            "survivor's post-shrink trajectory diverged from the oracle")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+
 def test_killed_worker_leaves_flight_file_and_goes_stale(tmp_path):
     """The PR's cluster acceptance: chief /metrics carries the worker's
     host-labelled series; SIGTERM-killing the worker (a) leaves a parseable

@@ -110,6 +110,36 @@ class CheckpointManager:
             else x,
             self._tree(state),
         )
+        if self._packed_geometry_differs(step, state):
+            # ZeRO checkpoint from a DIFFERENT world size: same container
+            # skeleton, different [N, C] chunk shapes. The direct path must
+            # not even be attempted — orbax does not reliably reject the
+            # shape change (with sharded targets it can silently reshard
+            # the wrong bytes into the new chunks), so route straight to
+            # the cross-format bridge's re-chunk branch.
+            bridged = self._restore_cross_format(step, state, abstract)
+            if bridged is not None:
+                log.info(
+                    "restored checkpoint step %d from %s "
+                    "(cross-format opt state)",
+                    int(jax.device_get(bridged.step)), self._dir,
+                )
+                from tfde_tpu.observability import flightrec
+
+                flightrec.record(
+                    "ckpt_restore",
+                    step=int(jax.device_get(bridged.step)),
+                    cross_format=True,
+                )
+                return bridged
+            raise ValueError(
+                f"checkpoint step {step} in {self._dir} holds ZeRO-packed "
+                f"optimizer state with a different chunk geometry than the "
+                f"current state (written at a different world size or with "
+                f"different comms blocking), and the cross-world re-chunk "
+                f"could not bridge it. Resume at the writer's world size, "
+                f"or clear the checkpoint directory to restart"
+            )
         try:
             # NOTE goodput accounting: restores run inside the train loop's
             # init span, so "checkpoint/restore" is observability-only and
@@ -129,7 +159,8 @@ class CheckpointManager:
             # requested abstract tree, instead of sniffing the error text —
             # an unrelated ValueError that happens to mention "structure"
             # must surface unrelabeled.
-            if self._saved_structure_differs(step, abstract):
+            if (self._saved_structure_differs(step, abstract)
+                    or self._packed_geometry_differs(step, state)):
                 bridged = self._restore_cross_format(step, state, abstract)
                 if bridged is not None:
                     log.info(
@@ -185,21 +216,23 @@ class CheckpointManager:
         return None
 
     def _restore_cross_format(self, step, state, abstract):
-        """Bridge the two optimizer-state formats on restore: a checkpoint
-        written with opt_sharding='replicated' resumed into a ZeRO-sharded
-        state (pack after a replicated restore), or one written with
-        'shard' resumed into a replicated state (restore the packed slots,
-        then unpack). Both directions are bit-exact — pack/unpack are pure
-        reshapes of the same numbers. Conservative: any failure returns
-        None and the direct path's structure-mismatch guidance surfaces
-        instead."""
+        """Bridge optimizer-state formats on restore: a checkpoint written
+        with opt_sharding='replicated' resumed into a ZeRO-sharded state
+        (pack after a replicated restore), one written with 'shard' resumed
+        into a replicated state (restore the packed slots, then unpack), or
+        one written with 'shard' at a DIFFERENT world size resumed into a
+        ZeRO-sharded state (restore under the writer's M-way layout, then
+        re-chunk to the live N-way layout — the elastic shrink/grow path,
+        both M>N and M<N). All directions are bit-exact — pack/unpack/
+        relayout are pure reshapes of the same numbers. Conservative: any
+        failure returns None and the direct path's structure-mismatch
+        guidance surfaces instead."""
         try:
             from jax.sharding import NamedSharding, PartitionSpec
             from tfde_tpu.parallel import comms as comms_lib
             from tfde_tpu.parallel import zero as zero_lib
 
-            meta = self._mngr.item_metadata(step)
-            meta = getattr(meta, "tree", meta)
+            meta = self._item_meta(step)
             saved_packed = self._find_packed(meta["opt_state"])
             layout = getattr(state, "opt_layout", None)
             leaves = jax.tree_util.tree_leaves(state.params)
@@ -239,6 +272,25 @@ class CheckpointManager:
                 ))
                 restored = self._restore_opt_variant(step, abstract, ab_opt)
                 opt = zero_lib.unpack_opt_state(restored["opt_state"], cand)
+            elif layout is not None and saved_packed is not None:
+                # saved sharded M-way -> live sharded N-way: reconstruct
+                # the writer's layout from the live one (same params, same
+                # block; only nshards differs), restore the packed slots
+                # replicated under it, then re-chunk to the live layout
+                saved_n = int(saved_packed[zero_lib.BIG].shape[0])
+                cand = zero_lib.with_nshards(layout, saved_n)
+                if (tuple(saved_packed[zero_lib.BIG].shape)
+                        != (cand.nshards, cand.chunk_big)
+                        or tuple(saved_packed[zero_lib.SMALL].shape)
+                        != (cand.nshards, cand.chunk_small)):
+                    return None  # different params or comms block knobs
+                ab_opt = abstract_rep(jax.eval_shape(
+                    lambda p: state.tx.init(zero_lib.pack_params(p, cand)),
+                    state.params,
+                ))
+                restored = self._restore_opt_variant(step, abstract, ab_opt)
+                opt = zero_lib.relayout_opt_state(
+                    restored["opt_state"], cand, layout)
             else:
                 return None
             opt = jax.device_put(
@@ -287,6 +339,52 @@ class CheckpointManager:
             return [n(v) for v in tree] or None
         return None
 
+    def _packed_geometry_differs(self, step: int, state) -> bool:
+        """True when both the checkpoint and the live state hold ZeRO-packed
+        optimizer slots but with different chunk geometry — a checkpoint
+        written at a different world size. The container skeletons are
+        IDENTICAL in that case (same {packed_big, packed_small} dicts, only
+        the [N, C] shapes moved), so `_saved_structure_differs` cannot see
+        it; this is the trigger that routes the elastic M-way -> N-way
+        restore through the cross-format bridge. Conservative like its
+        sibling: any failure reading metadata returns False."""
+        try:
+            from tfde_tpu.parallel import zero as zero_lib
+
+            layout = getattr(state, "opt_layout", None)
+            if layout is None:
+                return False
+            meta = self._item_meta(step)
+            saved = self._find_packed(meta["opt_state"])
+            if saved is None:
+                return False
+            return (tuple(saved[zero_lib.BIG].shape)
+                    != (layout.nshards, layout.chunk_big)
+                    or tuple(saved[zero_lib.SMALL].shape)
+                    != (layout.nshards, layout.chunk_small))
+        except Exception:
+            return False
+
+    def _item_meta(self, step: int):
+        """Metadata tree of the saved checkpoint at `step`. The manager's
+        own `item_metadata` returns None until a save/restore registered
+        the item handler — a fresh manager that has done neither (the
+        restart/elastic-restore case) falls back to a standalone
+        StandardCheckpointHandler read of the step's item directory."""
+        meta = self._mngr.item_metadata(step)
+        if meta is None:
+            import os
+
+            ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+            try:
+                meta = ckptr.metadata(os.path.join(self._dir, str(step),
+                                                   "default"))
+            finally:
+                ckptr.close()
+        # newer orbax wraps the tree in a metadata object; older returns
+        # the (dict) tree itself
+        return getattr(meta, "tree", meta)
+
     def _saved_structure_differs(self, step: int, abstract) -> bool:
         """True when the on-disk checkpoint's pytree structure differs from
         the tree we asked to restore into — the condition the optimizer-
@@ -294,10 +392,7 @@ class CheckpointManager:
         failure reading metadata returns False (the original error then
         propagates untouched)."""
         try:
-            meta = self._mngr.item_metadata(step)
-            # newer orbax wraps the tree in a metadata object; older
-            # returns the (dict) tree itself
-            meta = getattr(meta, "tree", meta)
+            meta = self._item_meta(step)
             return (self._normalize_structure(meta)
                     != self._normalize_structure(abstract))
         except Exception:

@@ -178,6 +178,32 @@ _register(
     Knob("TFDE_RETRY_DEADLINE", "float", None, (),
          "Total retry wall-clock budget, seconds (unset = attempts only).",
          "resilience/policy.py"),
+    # --- elastic training -------------------------------------------------
+    Knob("TFDE_ELASTIC", "flag", False, (),
+         "Elastic topology-change handling in the supervisor: a failure "
+         "classified TOPOLOGY shrinks the cluster to the surviving hosts "
+         "and resumes from the latest checkpoint instead of dying.",
+         "resilience/elastic.py"),
+    Knob("TFDE_ELASTIC_", "spec", None, (),
+         "Elastic-training family prefix (see members below).",
+         "resilience/elastic.py", prefix=True),
+    Knob("TFDE_ELASTIC_MAX_CHANGES", "int", 4, (),
+         "Topology changes allowed across one supervised run before the "
+         "supervisor aborts.",
+         "resilience/elastic.py"),
+    Knob("TFDE_ELASTIC_DETECT_TIMEOUT_S", "float", 5.0, (),
+         "Heartbeat-staleness age, seconds, at which a silent host is "
+         "registered as a topology suspect.",
+         "resilience/elastic.py, resilience/health.py"),
+    Knob("TFDE_ELASTIC_PRESUME_LOST", "flag", True, (),
+         "When a collective dies with no identified peer, presume every "
+         "other rank lost and shrink to self (a scheduler env rewrite "
+         "always wins over presumption).",
+         "resilience/elastic.py"),
+    Knob("TFDE_ELASTIC_MIN_WORLD", "int", 1, (),
+         "Abort instead of resuming when the surviving world size is "
+         "smaller than this.",
+         "resilience/elastic.py"),
     # --- observability ----------------------------------------------------
     Knob("TFDE_TRACE", "spec", None, ("off", "on", "<int capacity>"),
          "Per-request distributed tracing: off (default), on (default "

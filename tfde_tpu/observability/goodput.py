@@ -46,8 +46,10 @@ Category definitions (all in seconds of the measured wall):
                     checkpoint/wait; restores are under init)
 - ``summary``       TensorBoard event writing (train/summary_write)
 - ``eval``          inline eval passes (train/eval)
-- ``restart_loss``  the preemption tax: restart backoff sleeps plus
-                    replayed steps (resilience/lost_steps x mean step time)
+- ``restart_loss``  the preemption tax: restart backoff sleeps, elastic
+                    re-bootstrap time (resilience/rebootstrap_seconds),
+                    plus replayed steps (resilience/lost_steps x mean
+                    step time)
 - ``profile``       profile-capture overhead: the host-side dispatch cost
                     of opening/closing XProf trace windows
                     (profile/capture, recorded by observability/profiler).
@@ -167,7 +169,10 @@ class GoodputLedger:
         in_step_profile = min(profile,
                               max(0.0, step_time - replay - in_step))
         seconds["compute"] = step_time - replay - in_step - in_step_profile
-        seconds["restart_loss"] = replay + d("resilience/restart_backoff_seconds")
+        seconds["restart_loss"] = (
+            replay + d("resilience/restart_backoff_seconds")
+            + d("resilience/rebootstrap_seconds")  # elastic topology changes
+        )
 
         accounted = sum(seconds.values())
         if wall <= 0:

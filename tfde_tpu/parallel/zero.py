@@ -214,6 +214,30 @@ def unpack_packed(packed: dict, layout: Layout) -> Any:
     )
 
 
+def with_nshards(layout: Layout, nshards: int) -> Layout:
+    """The same params packed over a *different* shard count: identical
+    treedef/shapes/mask/block (the big/small split and the quantum unit are
+    properties of the params + comms config, not of the world size), with
+    the segment paddings recomputed for `nshards`. This is how a reader
+    reconstructs the layout an M-way writer used from its own N-way layout
+    — the elastic cross-world checkpoint bridge (checkpoint/manager.py).
+    Accepts nshards=1 (a one-row packed form) so M->1 relayouts stay
+    expressible even though training itself falls back to replicated
+    below 2 shards."""
+    if nshards < 1:
+        raise ValueError(f"nshards must be >= 1, got {nshards}")
+    quantum = nshards * layout.block
+    total_big = layout.total_big
+    total_small = layout.total_small
+    return dataclasses.replace(
+        layout,
+        nshards=nshards,
+        padded_big=-(-total_big // quantum) * quantum if total_big else 0,
+        padded_small=(-(-total_small // nshards) * nshards
+                      if total_small else 0),
+    )
+
+
 # -- optimizer-state conversion (checkpoint cross-compat) ---------------------
 def _walk(node, match, rebuild):
     if match(node):
@@ -251,6 +275,24 @@ def unpack_opt_state(opt_state: Any, layout: Layout) -> Any:
     """Packed optimizer state -> replicated per-leaf form."""
     return _walk(opt_state, _is_packed_node,
                  lambda n: unpack_packed(n, layout))
+
+
+def relayout_opt_state(opt_state: Any, from_layout: Layout,
+                       to_layout: Layout) -> Any:
+    """Re-chunk a packed optimizer state from one shard count to another
+    (M-way checkpoint -> N-way mesh, both directions). Pure reshapes —
+    unpack to the per-leaf form under the writer's layout, re-pack under
+    the reader's — so the payload values are bit-exact; only the zero
+    padding at the segment tails differs."""
+    if (from_layout.treedef != to_layout.treedef
+            or from_layout.shapes != to_layout.shapes
+            or from_layout.mask != to_layout.mask):
+        raise ValueError(
+            "relayout_opt_state needs layouts over the same params "
+            "(treedef/shapes/segment mask must match; only nshards may "
+            "differ)"
+        )
+    return pack_opt_state(unpack_opt_state(opt_state, from_layout), to_layout)
 
 
 def packable(abstract_opt_state: Any) -> bool:

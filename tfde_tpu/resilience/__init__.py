@@ -10,6 +10,8 @@ story a first-class, independently testable layer:
 - preemption.py — the shared SIGTERM/SIGINT guard (hoisted from lifecycle)
 - health.py     — heartbeat/stall watchdog, escalates to checkpoint-and-exit
 - supervisor.py — bounded restart-from-checkpoint around Estimator.train
+- elastic.py    — topology-change survival: shrink the cluster to the
+                  survivors and resume from the latest checkpoint
 """
 
 from tfde_tpu.resilience.policy import (  # noqa: F401
@@ -26,9 +28,16 @@ from tfde_tpu.resilience.faults import (  # noqa: F401
     DelayFault,
     FaultInjector,
     FaultSchedule,
+    PeerLossFault,
     RaiseFault,
     SignalFault,
     StepFaults,
+)
+from tfde_tpu.resilience.elastic import (  # noqa: F401
+    ElasticConfig,
+    PeerLostError,
+    note_peer_lost,
+    per_process_batch,
 )
 from tfde_tpu.resilience.preemption import Preempted, PreemptionGuard  # noqa: F401
 from tfde_tpu.resilience.health import Heartbeat, StallError  # noqa: F401

@@ -1,0 +1,431 @@
+"""Elastic training: survive a topology change, not just a restart.
+
+The supervisor (resilience/supervisor.py) made single-topology restarts
+boringly reliable — but a preempted pod slice *changes N*. Restarting at
+the old world size then deadlocks in `jax.distributed.initialize` waiting
+for hosts that will never come back. This module is the missing leg: when
+a failure is classified TOPOLOGY (a peer died under us), the supervisor
+tears the old runtime down, re-resolves the cluster from the surviving
+hosts, and resumes from the latest checkpoint at the new world size. The
+checkpoint layer already restores M-way state onto an N-way mesh
+(checkpoint/manager.py::_restore_cross_format + parallel/zero.py::
+relayout_opt_state), so elasticity here is cluster plumbing, not math.
+
+The three mechanisms:
+
+- **suspicion registry** — `note_peer_lost(rank, reason)` is the sink for
+  every peer-death signal: the chief's staleness detector
+  (resilience/health.py::note_stale_host), the `PeerLossFault` drill
+  (resilience/faults.py), or application code that caught a dead socket.
+  Suspects accumulate until the next `rebootstrap()` consumes them.
+- **env shrink** — `shrink_env()` rewrites the cluster contract
+  (TF_CONFIG / CLUSTER_SPEC / TFDE_*) to the dense re-ranking of the
+  survivors, with coordinator re-election = lowest surviving rank's host.
+  It only runs when a fresh `resolve_cluster()` still matches the dead
+  topology — a scheduler that already rewrote the env wins outright.
+- **re-bootstrap** — `rebootstrap()` sequences teardown
+  (cluster.shutdown), env shrink, backend clearing (only when a
+  distributed runtime was actually up — never in single-process drills
+  sharing a backend with live arrays), and `cluster.bootstrap()` at the
+  new N. The transition is observable: `cluster/world_size` gauge,
+  `resilience/topology_changes` counter, `resilience/rebootstrap_seconds`
+  (charged to the goodput ledger's ``restart_loss``), and a
+  `topology_change` flight-recorder breadcrumb.
+
+Semantic continuity is the caller's half of the contract: the input_fn
+must re-derive its per-process batch from the *current* world so the
+global batch — and with it the loss trajectory and the LR schedule
+position — is preserved across the shrink. `per_process_batch()` does the
+division; `note_batch()` (called by the lifecycle at every train start)
+logs the re-tune line and drops the `batch_retune` breadcrumb when the
+world changed between segments.
+
+Enabled by `SupervisorConfig.elastic` or the ``TFDE_ELASTIC`` knob
+(off by default — see ``TFDE_ELASTIC_*`` in knobs.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, Iterable, Optional, Tuple, Union
+
+from tfde_tpu import knobs
+from tfde_tpu.observability import counters, metrics
+from tfde_tpu.runtime import cluster
+
+log = logging.getLogger(__name__)
+
+
+class PeerLostError(RuntimeError):
+    """A peer process is gone (heartbeat silence, dead socket, injected
+    drill). Classified as TOPOLOGY by the supervisor: restartable, but only
+    after an elastic re-bootstrap at the surviving world size."""
+
+    def __init__(self, rank: int, reason: str = "peer lost"):
+        super().__init__(f"peer rank {rank} lost: {reason}")
+        self.rank = int(rank)
+        self.reason = str(reason)
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic re-bootstrap policy (env defaults: ``TFDE_ELASTIC_*``)."""
+
+    #: topology changes allowed across one supervised run — a cluster that
+    #: keeps losing hosts converges to min_world and then to an abort
+    max_topology_changes: int = 4
+    #: heartbeat-staleness age at which a silent host becomes a suspect
+    #: (consumed by health.note_stale_host's forwarding gate)
+    detect_timeout_secs: float = 5.0
+    #: when a collective dies with NO identified peer, presume every other
+    #: rank lost and shrink to self. The only rank a survivor can vouch for
+    #: without evidence is itself; real deployments pair this with the
+    #: scheduler's env rewrite (which wins) or heartbeat evidence.
+    presume_lost_without_evidence: bool = True
+    #: abort instead of resuming when the surviving world is smaller
+    min_world: int = 1
+
+
+def resolve(value: Union[None, bool, ElasticConfig] = None
+            ) -> Optional[ElasticConfig]:
+    """Normalize a config knob: an ElasticConfig passes through, False
+    disables, True forces the env-tuned config, and None defers to the
+    ``TFDE_ELASTIC`` flag (off by default)."""
+    if isinstance(value, ElasticConfig):
+        return value
+    if value is False:
+        return None
+    if value is None and not knobs.env_flag("TFDE_ELASTIC", False):
+        return None
+    return ElasticConfig(
+        max_topology_changes=knobs.env_int("TFDE_ELASTIC_MAX_CHANGES", 4),
+        detect_timeout_secs=knobs.env_float(
+            "TFDE_ELASTIC_DETECT_TIMEOUT_S", 5.0),
+        presume_lost_without_evidence=knobs.env_flag(
+            "TFDE_ELASTIC_PRESUME_LOST", True),
+        min_world=knobs.env_int("TFDE_ELASTIC_MIN_WORLD", 1),
+    )
+
+
+# -- suspicion registry --------------------------------------------------------
+_lock = threading.Lock()
+_suspects: Dict[int, str] = {}
+
+
+def note_peer_lost(rank: int, reason: str) -> None:
+    """Register a suspected-dead peer. Every detection channel funnels here
+    (staleness detector, fault drill, application socket errors); the next
+    `rebootstrap()` consumes the set. Re-noting a known suspect is free —
+    detectors poll, and one flight breadcrumb per peer is enough."""
+    rank = int(rank)
+    with _lock:
+        known = rank in _suspects
+        _suspects[rank] = str(reason)
+    if known:
+        return
+    counters.incr("resilience/peers_lost")
+    log.warning("peer rank %d suspected lost: %s", rank, reason)
+    from tfde_tpu.observability import flightrec
+
+    flightrec.record("peer_lost", rank=rank, reason=str(reason))
+
+
+def suspects() -> Dict[int, str]:
+    """Snapshot of currently suspected-dead ranks -> reason."""
+    with _lock:
+        return dict(_suspects)
+
+
+def clear_suspects() -> None:
+    with _lock:
+        _suspects.clear()
+
+
+# -- failure-shape heuristics --------------------------------------------------
+#: lowercase substrings of the errors a survivor's collective raises when
+#: its peer's half of the connection died (gloo/grpc spellings observed on
+#: the CPU rehearsal backend and DCN)
+_PEER_LOSS_PATTERNS = (
+    "connection reset",
+    "connection closed",
+    "connection refused",
+    "connection aborted",
+    "broken pipe",
+    "socket",
+    "gloo",
+    "recv",
+    "peer",
+    "unavailable",
+    "deadline exceeded",
+)
+
+
+def looks_like_peer_loss(exc: BaseException) -> bool:
+    """Heuristic upgrade for errors that reach the supervisor untyped: a
+    RuntimeError/OSError whose message smells like a dead peer's half-open
+    connection. Only consulted when elastic is enabled AND the run is
+    distributed — a local file-descriptor error must not trigger a
+    topology change."""
+    if isinstance(exc, PeerLostError):
+        return True
+    if not isinstance(exc, (RuntimeError, ConnectionError, OSError)):
+        return False
+    msg = str(exc).lower()
+    return any(p in msg for p in _PEER_LOSS_PATTERNS)
+
+
+def in_distributed_run() -> bool:
+    """True when this process is (or was configured to be) part of a
+    multi-process cluster — the gate on the peer-loss heuristic."""
+    info = cluster.last_info()
+    if info is None:
+        info = cluster.resolve_cluster()
+    return info.is_distributed
+
+
+# -- env shrink ----------------------------------------------------------------
+def shrink_env(old: cluster.ClusterInfo,
+               lost_ranks: Iterable[int]) -> Tuple[int, int]:
+    """Rewrite the cluster env contract to the dense re-ranking of the
+    survivors of `old` minus `lost_ranks`; returns (new_world, new_rank)
+    for this process.
+
+    Coordinator re-election = lowest surviving rank's host, which the
+    TF_CONFIG path expresses naturally (survivor list order IS rank
+    order). The bare ``TFDE_*`` contract carries no per-rank host list, so
+    losing rank 0 under it is only recoverable when the surviving world is
+    1 (no coordinator needed) — otherwise the scheduler must rewrite the
+    env, which `refresh_if_changed()` picks up.
+    """
+    lost = sorted({int(r) for r in lost_ranks})
+    if old.process_id in lost:
+        raise ValueError(
+            f"cannot shrink around self: rank {old.process_id} is in the "
+            f"lost set {lost}")
+    survivors = [r for r in range(old.num_processes) if r not in lost]
+    new_world = len(survivors)
+    new_rank = survivors.index(old.process_id)
+
+    # when the old coordinator survives into a multi-survivor world, its
+    # abandoned coordination service still holds the old port (teardown of
+    # a dead topology's runtime is fatal — see cluster.shutdown); every
+    # survivor deterministically derives the SAME successor port
+    def _bump_port(addr: str) -> str:
+        host, _, port = addr.rpartition(":")
+        return f"{host}:{int(port) + 1}" if port.isdigit() and host else addr
+
+    rebind = 0 not in lost and new_world > 1
+
+    raw = os.environ.get("TF_CONFIG")
+    if raw:
+        try:
+            cfg = json.loads(raw)
+        except json.JSONDecodeError:
+            cfg = None
+        if cfg and "cluster" in cfg:
+            cl = cfg["cluster"]
+            ranked = (list(cl.get("chief", []) or cl.get("master", []))
+                      + list(cl.get("worker", [])))
+            if len(ranked) == old.num_processes:
+                hosts = [ranked[r] for r in survivors]
+                if rebind:
+                    hosts[0] = _bump_port(hosts[0])
+                # all survivors are plain workers in the new spec: rank 0
+                # of the dense re-ranking is the chief by position
+                # (cluster._rank_from_tf_config normalizes worker 0 with
+                # no chief entry to the chief role)
+                os.environ["TF_CONFIG"] = json.dumps({
+                    "cluster": {"worker": hosts},
+                    "task": {"type": "worker", "index": new_rank},
+                })
+                if os.environ.get("CLUSTER_SPEC"):
+                    os.environ["CLUSTER_SPEC"] = json.dumps({"worker": hosts})
+                    os.environ["TASK_INDEX"] = str(new_rank)
+                    os.environ["JOB_NAME"] = "worker"
+
+    if os.environ.get("TFDE_NUM_PROCESSES"):
+        os.environ["TFDE_NUM_PROCESSES"] = str(new_world)
+        os.environ["TFDE_PROCESS_ID"] = str(new_rank)
+        if rebind and os.environ.get("TFDE_COORDINATOR"):
+            os.environ["TFDE_COORDINATOR"] = _bump_port(
+                os.environ["TFDE_COORDINATOR"])
+        if 0 in lost and os.environ.get("TFDE_COORDINATOR"):
+            if new_world == 1:
+                os.environ.pop("TFDE_COORDINATOR", None)
+            else:
+                log.warning(
+                    "lost rank 0 under the bare TFDE_* contract with %d "
+                    "survivors: no host list to re-elect a coordinator "
+                    "from — keeping the stale TFDE_COORDINATOR and hoping "
+                    "the scheduler rewrites it", new_world)
+
+    from tfde_tpu.observability import flightrec
+
+    flightrec.record("env_shrunk", old_world=old.num_processes,
+                     new_world=new_world, new_rank=new_rank,
+                     lost_ranks=lost)
+    log.warning("cluster env shrunk: world %d -> %d (lost ranks %s; this "
+                "process re-ranked %d -> %d)",
+                old.num_processes, new_world, lost, old.process_id, new_rank)
+    return new_world, new_rank
+
+
+# -- re-bootstrap --------------------------------------------------------------
+def rebootstrap(cfg: ElasticConfig, cause: str = "") -> cluster.ClusterInfo:
+    """Tear down the dead topology and come back up at the surviving world
+    size. Called by the supervisor at the TOP of the next attempt (after
+    the failed Estimator closed), never inside the failure handler.
+
+    Sequence: consume suspects -> cluster.shutdown() -> fresh env resolve
+    (a scheduler rewrite wins; otherwise shrink around the suspects, or —
+    with no evidence and `presume_lost_without_evidence` — around
+    everyone but self) -> clear backends iff a distributed runtime was
+    actually up -> cluster.bootstrap() at the new N.
+    """
+    t0 = time.monotonic()
+    was_up = cluster.initialized()
+    # the topology the failed run was ACTUALLY using: the live runtime's
+    # when one is up, else the env contract (a stale last_info() from an
+    # earlier unrelated bootstrap must not shadow the current spec)
+    old = (cluster.last_info() if was_up else None) or cluster.resolve_cluster()
+    lost = suspects()
+    # abandon, don't bid farewell: the graceful protocol's cluster-wide
+    # shutdown barrier can never complete once a peer died
+    cluster.shutdown(abandon=True)
+    fresh = cluster.resolve_cluster()
+    if fresh == old and old.is_distributed:
+        if not lost and cfg.presume_lost_without_evidence:
+            lost = {r: "presumed lost (no evidence)"
+                    for r in range(old.num_processes) if r != old.process_id}
+        if lost:
+            shrink_env(old, lost.keys())
+    elif fresh != old:
+        log.warning("cluster env changed under the failure (%s -> %s): "
+                    "the scheduler's rewrite wins over local suspicion",
+                    old, fresh)
+    clear_suspects()
+
+    if was_up:
+        # executables and arrays are bound to the dead process group's
+        # runtime; clearing forces re-creation against the new one. Never
+        # done when no distributed runtime was up: a single-process drill
+        # shares its backend with every live array in the process.
+        import jax
+
+        jax.extend.backend.clear_backends()
+
+    info = cluster.bootstrap()
+    if info.num_processes < cfg.min_world:
+        raise RuntimeError(
+            f"elastic re-bootstrap resolved world {info.num_processes} < "
+            f"min_world {cfg.min_world}; refusing to resume")
+    dt = time.monotonic() - t0
+    counters.incr("resilience/topology_changes")
+    # pure restart tax: the goodput ledger folds this into restart_loss
+    counters.incr("resilience/rebootstrap_seconds", dt)
+    metrics.gauge("cluster/world_size").set(info.num_processes)
+    from tfde_tpu.observability import flightrec
+
+    flightrec.record("topology_change", old_world=old.num_processes,
+                     new_world=info.num_processes,
+                     process_id=info.process_id,
+                     lost_ranks=sorted(lost), cause=str(cause),
+                     seconds=round(dt, 3))
+    log.warning("elastic re-bootstrap: world %d -> %d (rank %d, %.2fs%s)",
+                old.num_processes, info.num_processes, info.process_id, dt,
+                f", cause: {cause}" if cause else "")
+    return info
+
+
+def refresh_if_changed() -> Optional[cluster.ClusterInfo]:
+    """Re-read the cluster env and force a re-bootstrap when it no longer
+    matches the running topology. The supervisor calls this once per
+    restart attempt, so a scheduler that rewrites TF_CONFIG / TFDE_*
+    between attempts (replacement hosts, a grown slice) is picked up
+    instead of silently ignored. Returns the new ClusterInfo on change,
+    None when unchanged or never bootstrapped."""
+    old = cluster.last_info()
+    if old is None:
+        return None
+    fresh = cluster.resolve_cluster()
+    # compare only the fields that place processes — a job-type label
+    # drift ("chief" vs "local" for the same 1-process world) is not a
+    # topology change and must not force a re-bootstrap
+    if (fresh.num_processes == old.num_processes
+            and fresh.process_id == old.process_id
+            and fresh.coordinator_address == old.coordinator_address):
+        return None
+    log.warning("cluster spec changed between attempts (%s -> %s); "
+                "re-bootstrapping", old, fresh)
+    was_up = cluster.initialized()
+    cluster.shutdown()
+    if was_up:
+        import jax
+
+        jax.extend.backend.clear_backends()
+    info = cluster.bootstrap()
+    counters.incr("resilience/topology_changes")
+    from tfde_tpu.observability import flightrec
+
+    flightrec.record("topology_change", old_world=old.num_processes,
+                     new_world=info.num_processes,
+                     process_id=info.process_id, lost_ranks=[],
+                     cause="env_rewrite", seconds=0.0)
+    return info
+
+
+# -- semantic continuity -------------------------------------------------------
+_LAST_SEGMENT: Optional[Tuple[int, int]] = None  # (world, per-process batch)
+
+
+def per_process_batch(global_batch: int, world: Optional[int] = None) -> int:
+    """The re-tuned per-process batch that preserves `global_batch` at the
+    current (or given) world size — the caller-side half of semantic
+    continuity: same global batch => same loss trajectory and the same LR
+    schedule position per optimizer step."""
+    if world is None:
+        info = cluster.last_info() or cluster.resolve_cluster()
+        world = info.num_processes
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if global_batch % world:
+        raise ValueError(
+            f"global batch {global_batch} does not divide over world "
+            f"{world}; pick a global batch divisible by every world size "
+            f"the run may shrink to")
+    return global_batch // world
+
+
+def note_batch(per_process: int, world: int) -> None:
+    """Record the (world, per-process batch) of a starting train segment.
+    Sets the `cluster/world_size` gauge; when the world changed since the
+    previous segment, logs the re-tune line and drops a `batch_retune`
+    flight breadcrumb stating whether the global batch was preserved.
+    The caller (training/lifecycle.py) computes the per-process size —
+    only it knows whether the host batch is per-host (DATA policy) or the
+    full global batch each host slices from (OFF policy)."""
+    global _LAST_SEGMENT
+    per_proc = int(per_process)
+    world = int(world)
+    metrics.gauge("cluster/world_size").set(world)
+    prev, _LAST_SEGMENT = _LAST_SEGMENT, (world, per_proc)
+    if prev is None or prev[0] == world:
+        return
+    old_world, old_per = prev
+    preserved = per_proc > 0 and old_per * old_world == per_proc * world
+    log.warning(
+        "elastic batch re-tune: world %d -> %d, per-process batch %d -> %d "
+        "(global batch %d %s)", old_world, world, old_per, per_proc,
+        per_proc * world,
+        "preserved" if preserved
+        else "CHANGED — loss trajectory and LR schedule position may shift")
+    from tfde_tpu.observability import flightrec
+
+    flightrec.record("batch_retune", old_world=old_world, new_world=world,
+                     old_per_process=old_per, new_per_process=per_proc,
+                     global_batch=per_proc * world, preserved=preserved)

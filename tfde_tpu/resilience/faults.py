@@ -79,7 +79,28 @@ class SignalFault:
         os.kill(os.getpid(), self.signum)
 
 
-Action = Union[RaiseFault, DelayFault, SignalFault]
+@dataclasses.dataclass(frozen=True)
+class PeerLossFault:
+    """Simulate losing a peer rank — the elastic-path drill. Registers the
+    suspicion with the elastic layer (as the heartbeat staleness detector
+    would) and then fails the way the survivor's next collective does:
+    with a `PeerLostError` the supervisor classifies as TOPOLOGY. Makes
+    shrink-and-resume drillable in a single process, not only in the
+    2-process kill test."""
+
+    rank: int = 1
+    reason: str = "injected peer loss"
+
+    def fire(self, where: str) -> None:
+        counters.incr("resilience/faults_injected")
+        log.info("fault injection: peer rank %d lost at %s", self.rank, where)
+        from tfde_tpu.resilience import elastic
+
+        elastic.note_peer_lost(self.rank, self.reason)
+        raise elastic.PeerLostError(self.rank, f"{self.reason} [{where}]")
+
+
+Action = Union[RaiseFault, DelayFault, SignalFault, PeerLossFault]
 
 
 # -- schedules ---------------------------------------------------------------

@@ -97,6 +97,15 @@ def note_stale_host(host: int, age_seconds: float) -> None:
 
     flightrec.record("stale_host", host=int(host),
                      age_seconds=round(float(age_seconds), 3))
+    # elastic suspicion: a host silent past the detect threshold becomes a
+    # topology suspect, so the next TOPOLOGY-classified failure shrinks
+    # around *evidence* instead of presumption (resilience/elastic.py)
+    from tfde_tpu.resilience import elastic
+
+    ecfg = elastic.resolve(None)
+    if ecfg is not None and float(age_seconds) >= ecfg.detect_timeout_secs:
+        elastic.note_peer_lost(
+            int(host), f"no metric pushes for {float(age_seconds):.1f}s")
 
 
 @dataclasses.dataclass

@@ -13,7 +13,9 @@ restart loop:
   error — an assertion, a shape mismatch — that would recur on every
   restart and must abort), or NUMERICS (a sentry-reported NaN/blow-up —
   poison with a better error message: the replayed steps are
-  deterministic, so restarting from the pre-NaN checkpoint re-trips);
+  deterministic, so restarting from the pre-NaN checkpoint re-trips), or
+  a TOPOLOGY change (a peer died — restartable only after an elastic
+  re-bootstrap at the surviving world size, see resilience/elastic.py);
 - **restart**: restartable kinds rebuild a fresh Estimator from the
   factory; resume-by-default restores the latest *committed* step, so the
   restart replays at most save_checkpoints_steps-1 steps;
@@ -68,15 +70,24 @@ class FailureKind(enum.Enum):
     #: Non-restartable like POISON: resume-by-default restores the pre-NaN
     #: checkpoint and the blow-up deterministically replays.
     NUMERICS = "numerics"
+    #: a peer process died (resilience/elastic.py PeerLostError, or a
+    #: connection-shaped error in a distributed run with elastic enabled).
+    #: Restartable, but only after an elastic re-bootstrap at the
+    #: surviving world size — a same-world restart would deadlock in
+    #: jax.distributed.initialize waiting for the dead host.
+    TOPOLOGY = "topology"
 
 
 def classify_failure(exc: BaseException) -> FailureKind:
     """Map a failure to its restart semantics. KeyboardInterrupt is NOT
     classified here — operator intent aborts before classification."""
     from tfde_tpu.observability.sentry import NumericsError
+    from tfde_tpu.resilience.elastic import PeerLostError
 
     if isinstance(exc, Preempted):
         return FailureKind.PREEMPTION
+    if isinstance(exc, PeerLostError):
+        return FailureKind.TOPOLOGY
     if isinstance(exc, NumericsError):
         return FailureKind.NUMERICS
     if isinstance(exc, StallError):
@@ -126,6 +137,11 @@ class SupervisorConfig:
     #: progress — an advancing run may be preempted forever and keep
     #: making progress; one that cannot advance is effectively poison
     no_progress_limit: int = 2
+    #: elastic topology-change handling (resilience/elastic.py): an
+    #: ElasticConfig enables with that policy, True enables with the
+    #: env-tuned config, False disables, None (default) defers to the
+    #: TFDE_ELASTIC knob (off by default)
+    elastic: object = None
     #: deterministic restart-backoff jitter
     seed: int = 0
 
@@ -242,10 +258,36 @@ class Supervisor:
         # run-level ledger: spans EVERY attempt, so restart backoff and
         # replayed steps show up as restart_loss in one goodput fraction
         from tfde_tpu.observability.goodput import GoodputLedger
+        from tfde_tpu.resilience import elastic as elastic_lib
 
         ledger = GoodputLedger()
+        ecfg = elastic_lib.resolve(cfg.elastic)
+        topology_changes = 0
+        pending_topology: Optional[str] = None
 
         while True:
+            if pending_topology is not None:
+                # deferred to the TOP of the next attempt on purpose: the
+                # failed attempt's finally (heartbeat stop, est.close) ran
+                # against the old runtime before it is torn down here
+                cause, pending_topology = pending_topology, None
+                try:
+                    elastic_lib.rebootstrap(ecfg, cause=cause)
+                except BaseException as te:
+                    raise SupervisorAborted(
+                        f"elastic re-bootstrap failed after {self.restarts} "
+                        f"restart(s): {type(te).__name__}: {te}",
+                        restarts=self.restarts,
+                    ) from te
+            elif self.restarts:
+                # re-read the cluster env per attempt: a scheduler that
+                # rewrote the spec between attempts must win over the
+                # topology the first bootstrap resolved
+                try:
+                    elastic_lib.refresh_if_changed()
+                except Exception:
+                    log.warning("cluster env refresh failed (continuing at "
+                                "the old topology)", exc_info=True)
             est = self.factory()
             restore_handler = self._outer_sigterm()
             heartbeat = None
@@ -273,6 +315,13 @@ class Supervisor:
                 raise
             except BaseException as e:
                 kind = classify_failure(e)
+                if (ecfg is not None and kind is not FailureKind.TOPOLOGY
+                        and elastic_lib.looks_like_peer_loss(e)
+                        and elastic_lib.in_distributed_run()):
+                    # untyped connection-shaped error in a distributed run:
+                    # a same-world restart would hang on the dead host, so
+                    # treat it as a topology change
+                    kind = FailureKind.TOPOLOGY
                 committed = self._committed_step(est)
                 reached = heartbeat.last_step if heartbeat is not None else None
                 lost = max(0, (reached or 0) - (committed or 0))
@@ -321,6 +370,18 @@ class Supervisor:
                         f"last failure: {type(e).__name__}: {e}",
                         restarts=self.restarts,
                     ) from e
+
+                if kind is FailureKind.TOPOLOGY and ecfg is not None:
+                    if topology_changes >= ecfg.max_topology_changes:
+                        self._abort_dump(flightrec, kind)
+                        raise SupervisorAborted(
+                            f"topology-change budget "
+                            f"({ecfg.max_topology_changes}) exhausted; "
+                            f"last failure: {type(e).__name__}: {e}",
+                            restarts=self.restarts,
+                        ) from e
+                    topology_changes += 1
+                    pending_topology = f"{type(e).__name__}: {e}"
 
                 self.restarts += 1
                 counters.incr("resilience/restarts")

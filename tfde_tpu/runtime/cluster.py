@@ -35,6 +35,11 @@ from tfde_tpu import knobs
 log = logging.getLogger(__name__)
 
 _INITIALIZED = False
+#: the ClusterInfo the last bootstrap() resolved — what the running
+#: process group was actually built from. The elastic layer diffs a fresh
+#: resolve_cluster() against this to detect a scheduler that rewrote the
+#: spec (TF_CONFIG / TFDE_*) between supervisor attempts.
+_LAST_INFO: Optional["ClusterInfo"] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -226,15 +231,204 @@ def metrics_push_url(info: Optional[ClusterInfo] = None,
     return f"http://{host}:{port}/push"
 
 
-def bootstrap(coordinator_port: int = 8476) -> ClusterInfo:
+def last_info() -> Optional[ClusterInfo]:
+    """The ClusterInfo the last `bootstrap()` call resolved (None before
+    the first bootstrap). This is the *running* topology, as opposed to
+    `resolve_cluster()` which re-reads the environment fresh."""
+    return _LAST_INFO
+
+
+def initialized() -> bool:
+    """True while a `jax.distributed` runtime this module started is up."""
+    return _INITIALIZED
+
+
+#: True when _initialize_resilient built the runtime client itself (with
+#: shutdown_on_destruction=False) — only then can an abandon-teardown
+#: safely drop the client object without its destructor entering the
+#: shutdown barrier
+_RESILIENT_CLIENT = False
+#: runtime clients/services abandoned by an elastic teardown — once a peer
+#: died, neither can be shut down or destroyed without terminating the
+#: survivor, so they are made immortal (permanent incref) and listed here
+#: for introspection; the OS reclaims them at process exit
+_ZOMBIE_CLIENTS: list = []
+
+
+#: heartbeat window under which the coordination service never declares a
+#: task dead on its own: peer-death detection belongs to the resilience
+#: layer (health staleness -> elastic.note_peer_lost, collective errors),
+#: which can actually survive it — the stock runtime's reaction to a dead
+#: peer is LOG(FATAL) in every process, the exact opposite of elastic
+#: training. ~12 days: effectively never, without integer-overflow risk.
+_HEARTBEAT_INTERVAL_S = 1_000
+_MAX_MISSING_HEARTBEATS = 1_000
+
+
+def _initialize_resilient(coord: str, info: "ClusterInfo",
+                          policy) -> bool:
+    """Build the jax.distributed runtime with survivor-safe options the
+    public `initialize()` does not expose: heartbeat windows long enough
+    that the coordination service never declares a peer dead (the default
+    reaction is process termination), and no graceful shutdown from the
+    client destructor — so an abandon-teardown after a peer death cannot
+    enter the doomed cluster-wide shutdown barrier. Returns False when
+    this jax version's internals don't match — the caller falls back to
+    the vanilla path."""
+    global _RESILIENT_CLIENT
+    try:
+        from jax._src import distributed as jdist
+        from jax._src.lib import xla_extension as xe
+
+        state = jdist.global_state
+        if state.client is not None:
+            return True  # already up (re-entrant bootstrap)
+
+        def build_and_connect():
+            if info.process_id == 0 and state.service is None:
+                bind = "[::]:" + coord.rsplit(":", 1)[1]
+                state.service = xe.get_distributed_runtime_service(
+                    bind, info.num_processes,
+                    heartbeat_interval=_HEARTBEAT_INTERVAL_S,
+                    max_missing_heartbeats=_MAX_MISSING_HEARTBEATS)
+            client = xe.get_distributed_runtime_client(
+                coord, info.process_id,
+                heartbeat_interval=_HEARTBEAT_INTERVAL_S,
+                max_missing_heartbeats=_MAX_MISSING_HEARTBEATS,
+                shutdown_on_destruction=False,
+                use_compression=True,
+            )
+            try:
+                client.connect()
+            except Exception:
+                del client  # partial state must not leak into the retry
+                raise
+            state.client = client
+            state.coordinator_address = coord
+            state.process_id = info.process_id
+            state.num_processes = info.num_processes
+            if state.preemption_sync_manager is None:
+                state.initialize_preemption_sync_manager()
+
+        from tfde_tpu.resilience.policy import retry_call
+
+        retry_call(
+            build_and_connect,
+            policy=policy,
+            what="distributed runtime connect",
+            counter="resilience/bootstrap_retries",
+        )
+        _RESILIENT_CLIENT = True
+        return True
+    except (ImportError, AttributeError, TypeError):
+        # jax moved the internals: vanilla initialize still works, minus
+        # the survive-a-dead-peer teardown
+        log.warning("resilient distributed-runtime construction unavailable "
+                    "on this jax; falling back to jax.distributed.initialize",
+                    exc_info=True)
+        return False
+
+
+def shutdown(abandon: bool = False) -> None:
+    """Tear down the distributed runtime so `bootstrap()` can run again —
+    the first half of an elastic re-bootstrap (resilience/elastic.py).
+    Safe when nothing was initialized; failures during teardown are logged
+    and swallowed.
+
+    `abandon=True` is the peer-is-dead path: the graceful shutdown
+    protocol runs a cluster-wide barrier that can never complete once a
+    task died (and the stock runtime LOG(FATAL)s the surviving process
+    when it fails). Worse, ANY teardown of the old runtime is fatal: the
+    client's error-polling thread reacts to its poll RPC being cancelled
+    — which both `service.shutdown()` and client destruction cause — by
+    terminating the process (client.h: "Terminating process because the
+    JAX distributed service detected fatal errors"), and the Python
+    `missed_heartbeat_callback` escape hatch crashes with std::bad_cast
+    on this jaxlib (no Status caster). So abandoning PARKS the old
+    client and service in a module-level zombie list — alive but
+    disowned, their threads quiescent under the long heartbeat window —
+    and the re-bootstrap moves to a fresh coordination port (see
+    elastic.shrink_env) instead of re-binding the abandoned one."""
+    global _INITIALIZED, _RESILIENT_CLIENT
+    if not _INITIALIZED:
+        return
+    import jax
+
+    if abandon:
+        try:
+            from jax._src import distributed as jdist
+
+            state = jdist.global_state
+            client, service = state.client, state.service
+            state.client = None
+            state.service = None
+            state.preemption_sync_manager = None
+            # back to the class defaults: backend factories consult these
+            # (e.g. the CPU client wires gloo collectives through
+            # global_state.client) and stale world numbers would make a
+            # post-shrink world-1 backend demand a client we just parked
+            state.process_id = 0
+            state.num_processes = 1
+            state.coordinator_address = None
+            import ctypes
+
+            for obj in (client, service):
+                if obj is None:
+                    continue
+                # immortal, not merely parked: interpreter teardown would
+                # otherwise run the destructors in arbitrary order, and a
+                # dying service cancels the client's outstanding poll RPC
+                # — which the poll thread answers with LOG(FATAL). The OS
+                # reclaims both at process exit.
+                ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
+                _ZOMBIE_CLIENTS.append(obj)
+            log.warning(
+                "abandoned the distributed runtime of the old topology "
+                "(client%s parked; a dead peer makes any teardown fatal)",
+                "+service" if service is not None else "")
+        except Exception:
+            log.warning("abandon-teardown failed (continuing)",
+                        exc_info=True)
+    else:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            # a dead peer/coordinator makes the farewell barrier fail —
+            # that is exactly the situation an elastic teardown is for
+            log.warning("jax.distributed.shutdown failed (continuing "
+                        "teardown)", exc_info=True)
+    _INITIALIZED = False
+    _RESILIENT_CLIENT = False
+    from tfde_tpu.observability import flightrec
+
+    flightrec.record("distributed_shutdown", abandoned=bool(abandon))
+
+
+def bootstrap(coordinator_port: int = 8476, force: bool = False) -> ClusterInfo:
     """Resolve the cluster and initialize `jax.distributed` if multi-process.
 
     The TPU-native analog of the reference's cluster bootstrap + gRPC session
     construction (mnist_keras_distributed.py:221-233 + 165-189). Safe to call
-    multiple times; initialization happens once.
+    multiple times; initialization happens once. `force=True` is the
+    re-entrant path (elastic re-bootstrap after a topology change): it
+    tears down any prior runtime via `shutdown()` and re-initializes from
+    a FRESH read of the environment — the caller (resilience/elastic.py)
+    is responsible for having rewritten the env to the surviving hosts.
     """
-    global _INITIALIZED
+    global _INITIALIZED, _LAST_INFO
+    if force:
+        shutdown()
     info = resolve_cluster()
+    if not info.is_distributed:
+        # a world that shrank to one process must build its next CPU
+        # backend WITHOUT cross-process collectives (the gloo impl set on
+        # the way up would demand the distributed client we abandoned)
+        import jax
+
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "none")
+        except (AttributeError, ValueError):
+            pass
     if info.is_distributed and not _INITIALIZED:
         import jax
 
@@ -266,15 +460,19 @@ def bootstrap(coordinator_port: int = 8476) -> ClusterInfo:
         policy = _dc.replace(
             base, retryable=tuple(base.retryable) + (RuntimeError,)
         )
-        retry_call(
-            jax.distributed.initialize,
-            coordinator_address=coord,
-            num_processes=info.num_processes,
-            process_id=info.process_id,
-            policy=policy,
-            what="jax.distributed.initialize",
-            counter="resilience/bootstrap_retries",
-        )
+        # survivor-safe construction first (long heartbeat window + an
+        # abandonable client — the elastic teardown depends on both);
+        # vanilla initialize only when jax's internals moved
+        if not (coord and _initialize_resilient(coord, info, policy)):
+            retry_call(
+                jax.distributed.initialize,
+                coordinator_address=coord,
+                num_processes=info.num_processes,
+                process_id=info.process_id,
+                policy=policy,
+                what="jax.distributed.initialize",
+                counter="resilience/bootstrap_retries",
+            )
         _INITIALIZED = True
         from tfde_tpu.observability import flightrec
 
@@ -282,4 +480,8 @@ def bootstrap(coordinator_port: int = 8476) -> ClusterInfo:
             "bootstrap", num_processes=info.num_processes,
             process_id=info.process_id, coordinator=coord,
         )
+    _LAST_INFO = info
+    from tfde_tpu.observability import metrics
+
+    metrics.gauge("cluster/world_size").set(info.num_processes)
     return info

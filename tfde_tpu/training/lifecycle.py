@@ -476,6 +476,19 @@ class Estimator:
                          max_steps=max_steps,
                          resumed=bool(self._from_checkpoint),
                          sentry=scfg is not None)
+        # semantic-continuity bookkeeping: world-size gauge, and the batch
+        # re-tune log line + breadcrumb when this segment starts at a
+        # different world than the previous one (elastic shrink/grow)
+        from tfde_tpu.resilience import elastic as elastic_lib
+
+        _leaves = jax.tree_util.tree_leaves(first)
+        _n = (int(_leaves[0].shape[0])
+              if _leaves and getattr(_leaves[0], "shape", None) else 0)
+        if shard_policy is AutoShardPolicy.OFF and jax.process_count() > 1:
+            # under OFF every host yields the GLOBAL batch and the device
+            # feed takes its slice — per-process is the quotient
+            _n //= jax.process_count()
+        elastic_lib.note_batch(_n, jax.process_count())
         # recompile sentinel on the train step: the batch shapes are pinned
         # by the pipeline, so past the first compile (and one legitimate
         # swap, e.g. an int8/ZeRO step change) every miss is a bug

@@ -43,6 +43,14 @@
 #                                   eval_shape estimate —
 #                                   observability/memwatch.py; 'off'
 #                                   disables the ledger entirely)
+#        TFDE_ELASTIC=on tools/tier1.sh
+#                                  (re-run with elastic topology-change
+#                                   handling enabled by default in every
+#                                   Supervisor — resilience/elastic.py;
+#                                   the dedicated drills in
+#                                   tests/test_elastic.py and
+#                                   tests/test_multiprocess.py enable it
+#                                   explicitly either way)
 #
 # Also prints DOTS_DELTA (this run's DOTS_PASSED minus the previous
 # run's, from /tmp/_t1.passed) so a regression is visible at a glance
@@ -60,6 +68,7 @@ timeout -k 10 1440 env JAX_PLATFORMS=cpu \
     TFDE_PREFIX_CACHE="${TFDE_PREFIX_CACHE:-off}" \
     TFDE_TRACE="${TFDE_TRACE:-off}" \
     TFDE_MEMWATCH="${TFDE_MEMWATCH:-on}" \
+    TFDE_ELASTIC="${TFDE_ELASTIC:-off}" \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     --durations=10 \
