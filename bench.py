@@ -1911,6 +1911,25 @@ def _bench_serve_cluster(smoke: bool) -> dict:
             out["serve_cluster_ttft_p99_ms"] = round(
                 ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))], 2
             )
+        # overload accounting on the same pair run (no extra phase, so
+        # the trendgate series stay comparable): with TFDE_ADMIT_* caps
+        # unset these stay 0 and the columns just pin the orderly-exit
+        # taxonomy — completed / 429-rejected / deadline-shed
+        adm = [r for r in pair if r and "tokens" in r]
+        rej = [r for r in pair if r and "429" in r.get("error", "")]
+        sheds = [r for r in pair
+                 if r and "deadline_shed" in r.get("error", "")]
+        out["serve_cluster_rejected_429"] = len(rej)
+        out["serve_cluster_shed"] = len(sheds)
+        out["serve_cluster_reject_rate"] = round(
+            (len(rej) + len(sheds)) / max(len(pair), 1), 3)
+        adm_ttfts = sorted(r["ttft_s"] * 1e3 for r in adm
+                           if r.get("ttft_s") is not None)
+        if adm_ttfts:
+            out["serve_cluster_admitted_ttft_p99_ms"] = round(
+                adm_ttfts[min(len(adm_ttfts) - 1,
+                              int(0.99 * len(adm_ttfts)))], 2
+            )
 
         # kill drill: router with the aggregator attached (staleness is a
         # second down signal) and a flight ring to dump the post-mortem

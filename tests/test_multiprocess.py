@@ -371,7 +371,8 @@ _REPLICA_CHILD = textwrap.dedent(
     from tfde_tpu.inference.server import ContinuousBatcher
     from tfde_tpu.models.gpt import gpt_tiny_test
 
-    rid, port_file, push_url = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    rid, port_file = int(sys.argv[1]), sys.argv[2]
+    push_url = sys.argv[3] or None   # "" -> no metrics pusher
     model = gpt_tiny_test()
     params = model.init(
         jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
@@ -897,6 +898,189 @@ def test_killed_worker_leaves_flight_file_and_goes_stale(tmp_path):
         res = json.loads(out.strip().splitlines()[-1])
         assert res["hosts_stale"] == 1 and res["stale_hosts"] == [1]
     finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+
+def test_open_loop_poisson_overload_drill(tmp_path):
+    """The PR-14 acceptance drill: two REAL capped replica processes
+    (TFDE_ADMIT_MAX_QUEUE from env) behind the Router, driven with an
+    open-loop Poisson arrival stream at ~2x measured capacity. Every
+    request must end in exactly one of three orderly ways — completed
+    with tokens greedy-bit-identical to solo generate(), rejected with a
+    well-formed 429 + Retry-After, or deadline-shed in-band — with zero
+    in-flight drops, at least one well-formed rejection, and admitted
+    p99 TTFT holding near the unloaded baseline."""
+    import signal
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfde_tpu.inference.decode import generate
+    from tfde_tpu.inference.router import Router, request_generate
+    from tfde_tpu.models.gpt import gpt_tiny_test
+    from tfde_tpu.observability import metrics
+
+    model = gpt_tiny_test()
+    params = model.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+
+    def solo(prompt, n):
+        toks, lengths = generate(
+            model, params,
+            jnp.asarray(np.asarray(prompt)[None, :], jnp.int32),
+            max_new_tokens=n,
+        )
+        return np.asarray(toks)[0, len(prompt) : int(lengths[0])].tolist()
+
+    script = tmp_path / "child_replica.py"
+    script.write_text(_REPLICA_CHILD)
+    port_files = [str(tmp_path / f"port{i}") for i in range(2)]
+    reg = metrics.default_registry()
+    reg.reset("router/")
+
+    procs, router = [], None
+    try:
+        for i in range(2):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)
+            env["PYTHONPATH"] = os.pathsep.join(
+                [os.path.dirname(os.path.dirname(__file__))]
+                + env.get("PYTHONPATH", "").split(os.pathsep)
+            )
+            # the overload levers: tight queue cap per replica so ~2x
+            # load MUST overflow into 429s instead of unbounded queueing
+            env["TFDE_ADMIT_MAX_QUEUE"] = "2"
+            env.pop("TFDE_ADMIT_MAX_QUEUED_TOKENS", None)
+            env.pop("TFDE_ADMIT_TTFT_DEADLINE_MS", None)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(script), str(i), port_files[i],
+                     ""],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE, text=True,
+                )
+            )
+        deadline = time.time() + 240
+        while not all(os.path.exists(p) for p in port_files):
+            for p in procs:
+                assert p.poll() is None, p.communicate()[1][-3000:]
+            assert time.time() < deadline, "children never announced ports"
+            time.sleep(0.1)
+        urls = []
+        for pf in port_files:
+            with open(pf) as f:
+                urls.append(f"http://127.0.0.1:{int(f.read())}")
+        router = Router(urls).start()
+
+        rng = np.random.default_rng(14)
+        budget = 6
+        prompts = [rng.integers(1, 90, int(ln)).tolist()
+                   for ln in rng.integers(4, 7, 28)]
+        want = [solo(p, budget) for p in prompts]
+
+        # -- phase 1: unloaded baseline ---------------------------------
+        base_ttfts = []
+        t0 = time.perf_counter()
+        for p, w in zip(prompts[:6], want[:6]):
+            out = request_generate(router.url, p, budget)
+            assert out["tokens"] == w
+            base_ttfts.append(out["ttft_s"])
+        base_elapsed = time.perf_counter() - t0
+        base_p99 = float(np.percentile(base_ttfts, 99))
+        svc_rate = 6.0 / base_elapsed      # req/s at concurrency 1
+
+        # -- phase 2: open-loop Poisson at ~2x capacity -----------------
+        # capacity ~= concurrency-1 throughput x (2 replicas x batch 2);
+        # offer twice that so the capped queues must overflow
+        offered = 2.0 * svc_rate * 4.0
+        arrivals = np.cumsum(rng.exponential(1.0 / offered,
+                                             len(prompts) - 6))
+        results = [None] * len(arrivals)
+        classes = ["interactive", "batch", "best_effort"]
+        # admitted interactive work gets a TTFT deadline generous enough
+        # that only genuinely stuck requests shed
+        dl_ms = max(2000.0, base_p99 * 1e3 * 20.0)
+
+        def fire(k, prompt, at):
+            time.sleep(max(0.0, at - (time.perf_counter() - t_load)))
+            try:
+                out = request_generate(
+                    router.url, prompt, budget, timeout=120,
+                    priority=classes[k % 3], ttft_deadline_ms=dl_ms)
+                results[k] = ("ok", out)
+            except urllib.error.HTTPError as e:
+                body = e.read().decode(errors="replace")
+                results[k] = ("http", e.code,
+                              e.headers.get("Retry-After"), body)
+            except RuntimeError as e:
+                results[k] = ("runtime", str(e))
+            except Exception as e:   # anything else is a dropped request
+                results[k] = ("drop", repr(e))
+
+        t_load = time.perf_counter()
+        threads = [
+            threading.Thread(target=fire, args=(k, prompts[6 + k], at),
+                             daemon=True)
+            for k, at in enumerate(arrivals)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+            assert not t.is_alive(), "drill request never finished"
+
+        completed, rejected, shed = [], [], []
+        for k, res in enumerate(results):
+            assert res is not None, f"request {k} vanished"
+            kind = res[0]
+            if kind == "ok":
+                out = res[1]
+                # greedy bit-identity survives overload for every
+                # admitted request
+                assert out["tokens"] == want[6 + k], f"request {k}"
+                completed.append(out)
+            elif kind == "http":
+                _, code, retry_after, body = res
+                assert code == 429, res
+                assert retry_after is not None and int(retry_after) >= 1
+                parsed = json.loads(body)
+                assert parsed.get("retriable", True) in (True,)
+                assert float(parsed["retry_after_s"]) > 0
+                rejected.append(parsed)
+            elif kind == "runtime":
+                assert "deadline_shed" in res[1], res
+                shed.append(res)
+            else:
+                raise AssertionError(f"in-flight drop: {res}")
+
+        # the drill only proves something if the cluster actually both
+        # served and shed under the 2x offered load
+        assert completed, results
+        assert rejected, "2x overload produced no 429s"
+        # admitted latency holds: p99 TTFT within 1.5x the unloaded
+        # baseline plus absolute slack for CI scheduling noise
+        adm_p99 = float(np.percentile(
+            [o["ttft_s"] for o in completed], 99))
+        assert adm_p99 <= 1.5 * base_p99 + 0.75, (adm_p99, base_p99)
+
+        # recovery: once the wave passes, the cluster admits again and
+        # still decodes solo-correct
+        time.sleep(0.5)
+        out = request_generate(router.url, prompts[0], budget)
+        assert out["tokens"] == want[0]
+    finally:
+        if router is not None:
+            router.close()
         for p in procs:
             if p.poll() is None:
                 p.kill()

@@ -238,3 +238,216 @@ def test_stale_push_marks_down_never_pushed_stays_up(lm, rng):
     finally:
         for s in (router, r0, r1):
             s.close()
+
+
+# --------------------------------------------------------------------------
+# Overload protection: 429 + Retry-After, priority propagation, brownout
+# --------------------------------------------------------------------------
+
+def test_replica_queue_full_maps_to_429_with_retry_after(lm, rng,
+                                                         monkeypatch):
+    """A capped batcher's QueueFull must surface as HTTP 429 with an
+    integer Retry-After header and the pinned JSON schema — NOT the
+    generic 400 the RuntimeError clause would produce."""
+    from tfde_tpu.inference.admission import AdmissionController
+
+    model, params = lm
+    b = ContinuousBatcher(model, params, batch_size=1, max_len=64,
+                          admission_ctl=AdmissionController(max_queue=1))
+    rep = ReplicaServer(b, replica_id=0).start()
+    try:
+        # stall decode WITHOUT holding rep.lock (load() now takes it):
+        # a no-op step keeps every submit queued forever
+        monkeypatch.setattr(b, "step", lambda: time.sleep(0.01))
+        payload = {"prompt": rng.integers(1, 90, 4).tolist(),
+                   "max_new_tokens": 6}
+        req = urllib.request.Request(
+            rep.url + "/generate", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        first = urllib.request.urlopen(req, timeout=10)
+        first.readline()               # request #1 sits in the queue
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        e = ei.value
+        assert e.code == 429
+        retry_after = e.headers.get("Retry-After")
+        assert retry_after is not None and int(retry_after) >= 1
+        body = json.loads(e.read())
+        assert body["error"] == "queue full"
+        assert body["reason"] == "queue_depth"
+        assert body["queue_depth"] == 1
+        assert body["retry_after_s"] >= 0.5
+        # /load advertises the saturation the router's gate reads
+        load = json.loads(urllib.request.urlopen(
+            rep.url + "/load", timeout=5).read())
+        assert load["saturated"] is True
+        assert load["queued_tokens"] == 6
+        assert load["retry_after_s"] > 0
+        first.close()
+    finally:
+        rep.close()
+
+
+def test_router_rejects_fast_when_all_replicas_saturated(lm, rng,
+                                                         monkeypatch):
+    """With every live replica's /load reporting saturation, the router
+    answers 429 + Retry-After at the front door without spending a
+    replica round trip per doomed request."""
+    from tfde_tpu.inference.admission import AdmissionController
+    from tfde_tpu.observability import metrics
+
+    model, params = lm
+    b = ContinuousBatcher(model, params, batch_size=1, max_len=64,
+                          admission_ctl=AdmissionController(max_queue=1))
+    rep = ReplicaServer(b, replica_id=0).start()
+    router = Router([rep.url]).start()
+    reg = metrics.default_registry()
+    reg.reset("router/rejected")
+    try:
+        monkeypatch.setattr(b, "step", lambda: time.sleep(0.01))
+        p = rng.integers(1, 90, 4).tolist()
+        payload = {"prompt": p, "max_new_tokens": 6}
+        req = urllib.request.Request(
+            rep.url + "/generate", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        first = urllib.request.urlopen(req, timeout=10)
+        first.readline()               # the lone replica is now saturated
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            request_generate(router.url, p, 6)
+        e = ei.value
+        assert e.code == 429
+        assert int(e.headers.get("Retry-After")) >= 1
+        body = json.loads(e.read())
+        assert body["reason"] in ("saturated",)
+        assert body["retriable"] is True
+        assert reg.get("router/rejected_429").value >= 1
+        assert reg.get("router/rejected_saturated").value >= 1
+        first.close()
+    finally:
+        router.close()
+        rep.close()
+
+
+def test_priority_round_trip_and_validation(pair, rng):
+    """priority in the /v1/generate body (or the X-Tfde-Priority header)
+    must reach the replica's submit(); an unknown class 400s at the
+    front door."""
+    from tfde_tpu.inference.admission import PRIORITY_HEADER
+
+    model, params, r0, r1, router = pair
+    seen = []
+    for rep in (r0, r1):
+        b = rep.batcher
+        orig = b.submit
+
+        def spy(prompt, max_new_tokens, _orig=orig, **kw):
+            seen.append(kw.get("priority"))
+            return _orig(prompt, max_new_tokens, **kw)
+
+        rep.batcher.submit = spy
+    p = rng.integers(1, 90, 5).tolist()
+    out = request_generate(router.url, p, 6, priority="batch")
+    assert out["tokens"] == _solo(model, params, p, 6)
+    assert seen == ["batch"]
+    # header spelling, mixed case, no body field
+    req = urllib.request.Request(
+        router.url + "/v1/generate",
+        data=json.dumps({"prompt": p, "max_new_tokens": 4}).encode(),
+        headers={"Content-Type": "application/json",
+                 PRIORITY_HEADER: "Best_Effort"},
+    )
+    body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert body["tokens"] == _solo(model, params, p, 4)
+    assert seen[-1] == "best_effort"
+    # unknown class: loud 400, nothing submitted
+    n_before = len(seen)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        request_generate(router.url, p, 4, priority="urgent")
+    assert ei.value.code == 400
+    assert len(seen) == n_before
+
+
+def test_brownout_sheds_strictly_in_priority_order(lm, rng):
+    """Under fast-window SLO burn past the thresholds the router sheds
+    best_effort first, then batch, and never interactive — each rejected
+    class gets a well-formed 429 while interactive still decodes with
+    solo parity."""
+    from tfde_tpu.observability import metrics
+    from tfde_tpu.observability.slo import SLOTracker
+
+    model, params = lm
+    rep = _mk_replica(model, params, 0)
+
+    def burned_tracker():
+        t = SLOTracker(ttft_target_ms=1.0, objective=0.99)
+        for _ in range(10):            # >= MIN_BURN_SAMPLES, all missed
+            t.record(ttft_ms=1000.0)
+        return t                       # fast-window burn == 100
+
+    p = rng.integers(1, 90, 5).tolist()
+
+    def expect_429(router, priority):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            request_generate(router.url, p, 4, priority=priority)
+        assert ei.value.code == 429
+        body = json.loads(ei.value.read())
+        assert body["reason"] == "brownout"
+        assert int(ei.value.headers.get("Retry-After")) >= 1
+
+    reg = metrics.default_registry()
+    # level 2: burn 100 >= both thresholds -> best_effort AND batch shed
+    router = Router([rep.url], slo=burned_tracker(),
+                    brownout_burn=8.0, brownout_burn_batch=16.0).start()
+    try:
+        expect_429(router, "best_effort")
+        expect_429(router, "batch")
+        out = request_generate(router.url, p, 4)   # interactive: never shed
+        assert out["tokens"] == _solo(model, params, p, 4)
+        assert reg.get("router/brownout_level").value == 2
+    finally:
+        router.close()
+    # level 1: burn 100 >= 8 but < the (huge) batch threshold -> only
+    # best_effort sheds; batch passes. This IS the strict ordering.
+    router = Router([rep.url], slo=burned_tracker(),
+                    brownout_burn=8.0, brownout_burn_batch=1e9).start()
+    try:
+        expect_429(router, "best_effort")
+        out = request_generate(router.url, p, 4, priority="batch")
+        assert out["tokens"] == _solo(model, params, p, 4)
+        assert reg.get("router/brownout_level").value == 1
+    finally:
+        router.close()
+        rep.close()
+
+
+def test_deadline_shed_surfaces_as_inband_sse_error(lm, rng,
+                                                    monkeypatch):
+    """A request shed at dequeue AFTER the SSE stream opened cannot
+    become a 429 — it must surface as an in-band retriable
+    `deadline_shed` event, which request_generate raises."""
+    model, params = lm
+    rep = _mk_replica(model, params, 0, batch=1)
+    router = Router([rep.url]).start()
+    b = rep.batcher
+    try:
+        p = rng.integers(1, 90, 4).tolist()
+        real_step = b.step
+        # hold the queue for a few steps so the 1ms deadline expires
+        # before the shed check runs at dequeue
+        state = {"n": 0}
+
+        def slow_step(*a, **kw):
+            state["n"] += 1
+            if state["n"] < 4:
+                time.sleep(0.02)
+                return []
+            return real_step(*a, **kw)
+
+        monkeypatch.setattr(b, "step", slow_step)
+        with pytest.raises(RuntimeError, match="deadline_shed"):
+            request_generate(router.url, p, 6, ttft_deadline_ms=1.0)
+    finally:
+        router.close()
+        rep.close()

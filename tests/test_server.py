@@ -528,3 +528,154 @@ def test_cancel_frees_row_and_queue(lm, rng):
     rid = srv.submit(p, 6)
     done = dict(srv.run())
     np.testing.assert_array_equal(done[rid], _solo(model, params, p, 6))
+
+
+# --------------------------------------------------------------------------
+# Admission control: caps, priority classes, deadline shedding (PR 14)
+# --------------------------------------------------------------------------
+
+def test_admission_depth_cap_rejects_with_queue_full(lm, rng):
+    """max_queue bounds QUEUED requests: the overflow submit raises a
+    typed QueueFull carrying depth + drain estimate, and everything that
+    WAS admitted still decodes bit-identical to solo."""
+    from tfde_tpu.inference.admission import (
+        AdmissionController, QueueFull, MIN_RETRY_AFTER_S,
+    )
+
+    model, params = lm
+    srv = ContinuousBatcher(
+        model, params, batch_size=1, max_len=48,
+        admission_ctl=AdmissionController(max_queue=1),
+    )
+    p = rng.integers(1, 90, 4).astype(np.int64)
+    admitted = srv.submit(p, 6)        # queue depth 0 -> in
+    with pytest.raises(QueueFull) as ei:
+        srv.submit(p, 6)               # queue depth 1 >= cap
+    e = ei.value
+    assert e.reason == "queue_depth"
+    assert e.queue_depth == 1 and e.queued_tokens == 6
+    assert e.retry_after_s >= MIN_RETRY_AFTER_S
+    # QueueFull is a RuntimeError: overload-unaware callers stay correct
+    assert isinstance(e, RuntimeError)
+    body = e.as_json()
+    assert set(body) == {"error", "reason", "queue_depth",
+                         "queued_tokens", "retry_after_s"}
+    done = dict(srv.run())
+    np.testing.assert_array_equal(done[admitted],
+                                  _solo(model, params, p, 6))
+    # the queue drained: the same submit is admitted now
+    rid = srv.submit(p, 4)
+    np.testing.assert_array_equal(dict(srv.run())[rid],
+                                  _solo(model, params, p, 4))
+
+
+def test_admission_token_budget_cap(lm, rng):
+    """max_queued_tokens bounds the queued OUTPUT-token backlog — the
+    unit the drain rate is measured in, so the Retry-After estimate
+    derived from it is honest."""
+    from tfde_tpu.inference.admission import AdmissionController, QueueFull
+
+    model, params = lm
+    srv = ContinuousBatcher(
+        model, params, batch_size=1, max_len=48,
+        admission_ctl=AdmissionController(max_queued_tokens=10),
+    )
+    p = rng.integers(1, 90, 3).astype(np.int64)
+    srv.submit(p, 8)                   # backlog 8 <= 10
+    with pytest.raises(QueueFull) as ei:
+        srv.submit(p, 8)               # 8 + 8 > 10
+    assert ei.value.reason == "queued_tokens"
+    srv.submit(p, 2)                   # 8 + 2 == 10: exactly at cap is in
+    done = dict(srv.run())
+    assert len(done) == 2
+
+
+def test_priority_ordered_dequeue(lm, rng):
+    """The queue drains interactive > batch > best_effort regardless of
+    submission order (FIFO within a class), and every admitted request
+    still matches its solo run."""
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    p = rng.integers(1, 90, 4).astype(np.int64)
+    blocker = srv.submit(p, 8)
+    srv.step()                         # blocker occupies the single row
+    r_be = srv.submit(p, 3, priority="best_effort")
+    r_ba = srv.submit(p, 3, priority="batch")
+    r_in = srv.submit(p, 3)            # unlabeled == interactive
+    assert srv._queue.depths() == {
+        "interactive": 1, "batch": 1, "best_effort": 1}
+    order = []
+    while not srv.idle:
+        for rid, _toks in srv.step():
+            order.append(rid)
+    assert order == [blocker, r_in, r_ba, r_be]
+    # parity rode along: re-run one of each against solo
+    srv2 = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    rid = srv2.submit(p, 3, priority="best_effort")
+    np.testing.assert_array_equal(dict(srv2.run())[rid],
+                                  _solo(model, params, p, 3))
+
+
+def test_expired_deadline_shed_before_prefill(lm, rng):
+    """A queued request whose wait already blew its TTFT deadline is
+    dropped AT DEQUEUE — no prefill is spent on it, was_shed() answers
+    exactly once, and the shed counters tick."""
+    import time as _time
+
+    from tfde_tpu.observability import metrics
+
+    model, params = lm
+    reg = metrics.default_registry()
+    reg.reset("serving/shed")
+    srv = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    srv.enable_progress()
+    p = rng.integers(1, 90, 4).astype(np.int64)
+    blocker = srv.submit(p, 6)
+    doomed = srv.submit(p, 5, priority="batch", ttft_deadline_ms=1.0)
+    _time.sleep(0.01)                  # the deadline expires in queue
+    done = dict(srv.run())
+    assert blocker in done and doomed not in done
+    np.testing.assert_array_equal(done[blocker],
+                                  _solo(model, params, p, 6))
+    toks, fin = srv.take_progress(doomed)
+    assert toks == [] and fin is True
+    assert srv.was_shed(doomed) is True
+    assert srv.was_shed(doomed) is False   # answers once
+    assert reg.get("serving/shed_expired").value == 1
+    assert reg.get("serving/shed_batch").value == 1
+    assert reg.get("serving/shed_tokens").value == 5
+    assert srv.idle and not srv._deadline_at and not srv._priority
+
+
+def test_forced_overload_fault_rejects_then_recovers(lm, rng):
+    """resilience.OverloadFault arms the module-wide saturation lever:
+    while armed every submit is rejected as forced_overload; after
+    clear_overload the same batcher admits again."""
+    from tfde_tpu.inference import admission
+    from tfde_tpu.inference.admission import QueueFull
+    from tfde_tpu.resilience.faults import OverloadFault
+
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    p = rng.integers(1, 90, 3).astype(np.int64)
+    OverloadFault(seconds=30.0).fire("test")
+    try:
+        with pytest.raises(QueueFull) as ei:
+            srv.submit(p, 4)
+        assert ei.value.reason == "forced_overload"
+    finally:
+        admission.clear_overload()
+    rid = srv.submit(p, 4)
+    np.testing.assert_array_equal(dict(srv.run())[rid],
+                                  _solo(model, params, p, 4))
+
+
+def test_unknown_priority_rejected_loudly(lm, rng):
+    """A typo'd priority class must raise, not silently become
+    best_effort (which would get it brownout-shed in production)."""
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    p = rng.integers(1, 90, 3).astype(np.int64)
+    with pytest.raises(ValueError, match="priority"):
+        srv.submit(p, 4, priority="urgent")
+    assert len(srv._queue) == 0

@@ -283,3 +283,40 @@ def test_lintgate_baseline_is_committed_and_covers_the_matrix(lg):
     for prog in base["programs"].values():
         assert prog["violations"] == []
     assert base["project"]["violations"] == []
+
+
+def test_guarded_attrs_flag_unlocked_reads(tl, tmp_path):
+    """The PR-14 regression shape: router.load() read `self.batcher._queue`
+    without the replica lock. `guarded_attrs` makes the lock rule flag ANY
+    access — reads included — to the named attributes outside the lock."""
+    src = textwrap.dedent("""
+        import threading
+
+        class Rep:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.batcher = object()       # __init__ is exempt
+
+            def bad_read(self):
+                return len(self.batcher._queue)   # unlocked read
+
+            def bad_alias(self):
+                b = self.batcher                  # unlocked alias grab
+                return b
+
+            def good(self):
+                with self._lock:
+                    return len(self.batcher._queue)
+    """)
+    root = _write_pkg(tmp_path, "pkg/rep.py", src)
+    table = {("pkg/rep.py", "Rep"): tl.LockSpec(
+        lock="_lock", guarded_attrs=("batcher",))}
+    violations, audit = tl.lint_locks(root, table=table)
+    assert audit["pkg/rep.py::Rep"] == "checked"
+    assert len(violations) == 2, violations
+    for v in violations:
+        assert "access to self.batcher" in v
+    # without the guard, plain reads stay legal (writes-only rule)
+    table = {("pkg/rep.py", "Rep"): tl.LockSpec(lock="_lock")}
+    violations, _ = tl.lint_locks(root, table=table)
+    assert violations == []

@@ -37,6 +37,7 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import math
 import socket
 import threading
 import time
@@ -47,6 +48,8 @@ from typing import Optional
 
 import numpy as np
 
+from tfde_tpu import knobs
+from tfde_tpu.inference import admission as _admission
 from tfde_tpu.observability import flightrec, metrics
 from tfde_tpu.observability import trace as _trace
 from tfde_tpu.observability.slo import SLOTracker
@@ -218,6 +221,25 @@ class ReplicaServer:
                         srv._handle_profile(self, body)
                     else:
                         self.send_error(404)
+                except _admission.QueueFull as e:
+                    # typed overload rejection — MUST precede the
+                    # RuntimeError clause below or it degrades to a 400
+                    # that tells the client to fix a request that was
+                    # fine. Retry-After is the drain-rate estimate,
+                    # integer-seconds per the HTTP spec (the precise
+                    # float rides the JSON body).
+                    metrics.default_registry().counter(
+                        "serving/rejected_429").incr()
+                    flightrec.record("admission_reject",
+                                     replica=srv.replica_id,
+                                     reason=e.reason,
+                                     queue_depth=e.queue_depth,
+                                     retry_after_s=e.retry_after_s)
+                    srv._send_json(
+                        self, 429, e.as_json(),
+                        headers={"Retry-After":
+                                 str(max(1, math.ceil(e.retry_after_s)))},
+                    )
                 except (ValueError, RuntimeError) as e:
                     srv._send_json(self, 400, {"error": str(e)})
 
@@ -273,14 +295,27 @@ class ReplicaServer:
         })
 
     def load(self) -> dict:
-        b = self.batcher
-        return {
-            "replica": self.replica_id,
-            "role": b.role,
-            "outstanding_tokens": b.outstanding_tokens,
-            "queue_depth": len(b._queue),
-            "free_rows": b.free_rows,
-        }
+        # the batcher's contract is "single-threaded under the external
+        # ReplicaServer.lock"; reading its queue while the step loop
+        # mutates it is the exact race tfdelint's guarded_attrs audit
+        # exists to flag
+        with self.lock:
+            b = self.batcher
+            depth = len(b._queue)
+            queued_tokens = b.queued_tokens
+            return {
+                "replica": self.replica_id,
+                "role": b.role,
+                "outstanding_tokens": b.outstanding_tokens,
+                "queue_depth": depth,
+                "queue_depths": b._queue.depths(),
+                "queued_tokens": queued_tokens,
+                "free_rows": b.free_rows,
+                "drain_rate_tps": b.admission.drain_rate_tps,
+                "retry_after_s": b.admission.retry_after_s(queued_tokens),
+                "saturated": b.admission.would_reject(
+                    depth, queued_tokens) is not None,
+            }
 
     # -- internals ----------------------------------------------------------
     def _loop(self) -> None:
@@ -313,14 +348,23 @@ class ReplicaServer:
 
     def _handle_generate(self, handler, body: dict, primed: bool) -> None:
         tid = handler.headers.get(_trace.HEADER)
+        # the header wins over the body field: a primed hand-off's body
+        # is the K/V payload, so the class can only ride the header there
+        pr = _admission.validate_priority(
+            handler.headers.get(_admission.PRIORITY_HEADER)
+            or body.get("priority"))
+        dl = body.get("ttft_deadline_ms")
+        dl = float(dl) if dl is not None else None
         t_req = time.perf_counter()
         with self.lock:
             if primed:
-                rid = self.batcher.submit_primed(primed_from_json(body),
-                                                 trace=tid)
+                rid = self.batcher.submit_primed(
+                    primed_from_json(body), trace=tid,
+                    priority=pr, ttft_deadline_ms=dl)
             else:
                 rid = self.batcher.submit(
-                    body["prompt"], int(body["max_new_tokens"]), trace=tid
+                    body["prompt"], int(body["max_new_tokens"]), trace=tid,
+                    priority=pr, ttft_deadline_ms=dl,
                 )
         try:
             handler.send_response(200)
@@ -336,9 +380,23 @@ class ReplicaServer:
             while True:
                 with self.lock:
                     toks, done = self.batcher.take_progress(rid)
+                    shed = done and self.batcher.was_shed(rid)
                 for t in toks:
                     _sse_write(handler.wfile, {"token": int(t)})
                     sent += 1
+                if shed:
+                    # deadline-shed at dequeue: the SSE headers already
+                    # went out when we accepted the submit, so the 429
+                    # moment has passed — report the shed in-band as a
+                    # retriable error instead of a silent empty `done`
+                    with self.lock:
+                        ra = self.batcher.admission.retry_after_s(
+                            self.batcher.queued_tokens)
+                    _sse_write(handler.wfile,
+                               {"error": "deadline_shed", "shed": True,
+                                "retriable": True,
+                                "retry_after_s": round(ra, 3)})
+                    return
                 if done:
                     _sse_write(handler.wfile, {"done": True, "n": sent})
                     if tid is not None and _trace.active():
@@ -402,7 +460,9 @@ class Router:
                  model_dir: Optional[str] = None,
                  prefill_min_tokens: int = 0,
                  request_timeout: float = 120.0,
-                 slo: Optional[SLOTracker] = None):
+                 slo: Optional[SLOTracker] = None,
+                 brownout_burn: Optional[float] = None,
+                 brownout_burn_batch: Optional[float] = None):
         if not replicas:
             raise ValueError("need at least one replica URL")
         self._reps = [_Replica(u, i) for i, u in enumerate(replicas)]
@@ -413,6 +473,23 @@ class Router:
         self._lock = threading.Lock()
         self._reg = metrics.default_registry()
         self._slo = slo if slo is not None else SLOTracker()
+        # brownout: fast-window TTFT burn past `brownout_burn` sheds
+        # best_effort; past `brownout_burn_batch` sheds batch too.
+        # interactive is never brownout-shed — past that point the
+        # admission caps are the backstop.
+        self._brownout_burn = float(
+            brownout_burn if brownout_burn is not None
+            else knobs.env_float("TFDE_BROWNOUT_BURN", 8.0))
+        self._brownout_burn_batch = float(
+            brownout_burn_batch if brownout_burn_batch is not None
+            else knobs.env_float("TFDE_BROWNOUT_BURN_BATCH", 16.0))
+        self._brownout_level = 0   # 0 off, 1 shed best_effort, 2 + batch
+        # /load snapshot cache: saturation is polled per request but the
+        # GETs go out at most once per TTL — overload is exactly when a
+        # per-request fan-out would make things worse
+        self._loads: dict = {}
+        self._loads_at = 0.0
+        self._load_ttl = 0.25
         # trace id -> replica idx currently relaying it; read by
         # _mark_down so a replica_down flight breadcrumb names the
         # in-flight traces it stranded
@@ -674,6 +751,75 @@ class Router:
             )
             g(f"router/replica{rep.idx}/served").set(rep.served)
 
+    # -- overload protection -------------------------------------------------
+    def _brownout_shed_rank(self) -> int:
+        """The minimum PRIORITY_RANK this router currently sheds: 3 when
+        brownout is off (no class has rank 3 — nothing sheds), 2 at
+        level 1 (best_effort), 1 at level 2 (batch too). interactive
+        (rank 0) is never brownout-shed. Level changes are edge-detected
+        into a gauge + flight breadcrumb, the ProfileTrigger idiom."""
+        level = 0
+        count, att = self._slo.window_stats("ttft", self._slo.windows[0])
+        if count >= 8 and att is not None:  # slo.MIN_BURN_SAMPLES
+            burn = (1.0 - att) / (1.0 - self._slo.objective)
+            if self._brownout_burn > 0 and burn >= self._brownout_burn:
+                level = 1
+            if (self._brownout_burn_batch > 0
+                    and burn >= self._brownout_burn_batch):
+                level = 2
+        with self._lock:
+            changed = level != self._brownout_level
+            self._brownout_level = level
+        if changed:
+            self._reg.gauge("router/brownout_level").set(level)
+            flightrec.record("brownout", level=level,
+                             burn_threshold=self._brownout_burn)
+            log.warning("brownout level -> %d", level)
+        return 3 - level
+
+    def _load_snapshot(self) -> dict:
+        """replica idx -> its /load JSON, for live decode replicas,
+        refreshed at most once per `_load_ttl`. A replica that fails the
+        GET is simply absent (liveness is _pick's job, not this path's)."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._loads_at < self._load_ttl:
+                return self._loads
+        loads = {}
+        for rep in self._reps:
+            if not rep.up or rep.drained:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        rep.url + "/load", timeout=2.0) as resp:
+                    loads[rep.idx] = json.loads(resp.read())
+            except Exception:  # noqa: BLE001 — absent, not dead
+                continue
+        with self._lock:
+            self._loads = loads
+            self._loads_at = now
+        return loads
+
+    def _reject(self, handler, headers_sent: bool, reason: str,
+                retry_after_s: float, tid: Optional[str]) -> None:
+        """One well-formed 429 (or in-band SSE error when the stream is
+        already open): counted per reason, breadcrumbed, Retry-After in
+        integer seconds with the precise float in the body."""
+        self._reg.counter("router/rejected_429").incr()
+        self._reg.counter(f"router/rejected_{reason}").incr()
+        flightrec.record("router_reject", reason=reason,
+                         retry_after_s=round(retry_after_s, 3))
+        body = {"error": "overloaded", "reason": reason,
+                "retriable": True,
+                "retry_after_s": round(retry_after_s, 3)}
+        if headers_sent:
+            _sse_write(handler.wfile, body)
+        else:
+            headers = {"Retry-After": str(max(1, math.ceil(retry_after_s)))}
+            if tid:
+                headers[_trace.HEADER] = tid
+            ReplicaServer._send_json(handler, 429, body, headers=headers)
+
     # -- request path --------------------------------------------------------
     def _maybe_prime(self, body: dict, tid: Optional[str] = None):
         """Run the prefill on the prefill tier when configured; returns
@@ -725,6 +871,14 @@ class Router:
                 handler, 400, {"error": "need prompt + max_new_tokens"}
             )
             return
+        try:
+            priority = _admission.validate_priority(
+                handler.headers.get(_admission.PRIORITY_HEADER)
+                or body.get("priority"))
+        except ValueError as e:
+            ReplicaServer._send_json(handler, 400, {"error": str(e)})
+            return
+        ttft_deadline_ms = body.get("ttft_deadline_ms")
         stream = bool(body.get("stream", False))
         # every session has a trace id (honor the caller's, else mint):
         # propagation + echo-back are unconditional and cheap; span
@@ -734,14 +888,41 @@ class Router:
         self._reg.counter("router/requests").incr()
         if _trace.active():
             _trace.event("router/request", trace=tid,
-                         prompt_tokens=len(prompt), budget=budget)
+                         prompt_tokens=len(prompt), budget=budget,
+                         priority=priority)
+        # brownout gate: under sustained SLO burn, the lowest classes
+        # are turned away at the front door before any replica spends a
+        # prefill on them
+        if (_admission.PRIORITY_RANK[priority]
+                >= self._brownout_shed_rank()):
+            self._reject(handler, False, "brownout",
+                         _admission.MIN_RETRY_AFTER_S * 4, tid)
+            return
+        # saturation gate: when EVERY live replica's /load snapshot says
+        # its admission controller would reject, fail fast here with the
+        # fleet's best Retry-After instead of bouncing off each replica
+        loads = self._load_snapshot()
+        sat = [ld for ld in loads.values() if ld.get("saturated")]
+        if loads and len(sat) == len(loads):
+            self._reject(handler, False, "saturated",
+                         min(ld.get("retry_after_s", 1.0) for ld in sat),
+                         tid)
+            return
         primed_payload = self._maybe_prime(body, tid)
         headers_sent = False
         exclude: list = []
+        sat429: list = []   # Retry-After estimates from per-replica 429s
         while True:
             try:
                 rep = self._pick(self._reps, exclude)
             except LookupError:
+                if sat429:
+                    # every live replica answered 429: the cluster is
+                    # saturated, not down — tell the client to back off,
+                    # with the most optimistic replica's estimate
+                    self._reject(handler, headers_sent, "saturated",
+                                 min(sat429), tid)
+                    return
                 if headers_sent:
                     _sse_write(handler.wfile,
                                {"error": "no live replicas",
@@ -768,16 +949,22 @@ class Router:
             t_first = None
             finished = False
             try:
+                fwd_headers = {_trace.HEADER: tid,
+                               _admission.PRIORITY_HEADER: priority}
                 if primed_payload is not None:
                     req = _post_json(rep.url + "/generate_primed",
                                      primed_payload, self._timeout,
-                                     headers={_trace.HEADER: tid})
+                                     headers=fwd_headers)
                 else:
+                    fwd_body = {"prompt": prompt,
+                                "max_new_tokens": budget,
+                                "priority": priority}
+                    if ttft_deadline_ms is not None:
+                        fwd_body["ttft_deadline_ms"] = float(
+                            ttft_deadline_ms)
                     req = _post_json(
-                        rep.url + "/generate",
-                        {"prompt": prompt, "max_new_tokens": budget},
-                        self._timeout,
-                        headers={_trace.HEADER: tid},
+                        rep.url + "/generate", fwd_body, self._timeout,
+                        headers=fwd_headers,
                     )
                 with req as resp:
                     if stream and not headers_sent:
@@ -798,6 +985,24 @@ class Router:
                                 _sse_write(handler.wfile,
                                            {"token": ev["token"]})
                                 relayed += 1
+                        elif ev.get("shed"):
+                            # the replica shed this request at dequeue
+                            # (TTFT deadline) — retriable, and the
+                            # replica itself is healthy. Relay the
+                            # in-band error when streaming; for a
+                            # buffered client the 429 moment has not
+                            # passed yet, so map it back to one.
+                            ra = float(ev.get(
+                                "retry_after_s",
+                                _admission.MIN_RETRY_AFTER_S))
+                            if stream:
+                                metrics.default_registry().counter(
+                                    "router/relayed_shed").incr()
+                                _sse_write(handler.wfile, ev)
+                            else:
+                                self._reject(handler, headers_sent,
+                                             "deadline_shed", ra, tid)
+                            return
                         elif ev.get("done"):
                             finished = True
                             break
@@ -812,6 +1017,19 @@ class Router:
                 # send_response would corrupt the stream — report
                 # in-band instead
                 detail = e.read().decode(errors="replace")
+                if e.code == 429 and not headers_sent:
+                    # this replica's admission gate said no — another
+                    # may still have room (the /load snapshot is a TTL
+                    # cache; it can lag). Remember its drain estimate
+                    # and try the next one.
+                    try:
+                        ra = float(json.loads(detail)["retry_after_s"])
+                    except (json.JSONDecodeError, KeyError, TypeError,
+                            ValueError):
+                        ra = _admission.MIN_RETRY_AFTER_S
+                    sat429.append(ra)
+                    exclude.append(rep.idx)
+                    continue
                 if headers_sent:
                     _sse_write(handler.wfile,
                                {"error": detail, "retriable": False})
@@ -868,18 +1086,26 @@ class Router:
 
 # -- blocking client (tests / bench / examples) ------------------------------
 def request_generate(router_url: str, prompt, max_new_tokens: int,
-                     stream: bool = False, timeout: float = 120.0) -> dict:
+                     stream: bool = False, timeout: float = 120.0,
+                     priority: Optional[str] = None,
+                     ttft_deadline_ms: Optional[float] = None) -> dict:
     """POST one generation to a Router (or directly to a ReplicaServer's
     /generate). Returns {"tokens": [...], "replica": idx|None,
     "ttft_s": seconds-to-first-token, "events": n, "trace": id|None —
     the session's X-Tfde-Trace id for /trace/<id> lookups}. Raises the
-    underlying urllib error on transport failure and RuntimeError on an
-    in-stream retriable error."""
+    underlying urllib error on transport failure (a pre-stream overload
+    rejection surfaces as HTTPError 429 with Retry-After) and
+    RuntimeError on an in-stream retriable error (a deadline-shed
+    mid-stream reads "deadline_shed")."""
     url = router_url.rstrip("/")
     path = "/v1/generate" if "/generate" not in url else ""
     t0 = time.perf_counter()
     payload = {"prompt": list(np.asarray(prompt).tolist()),
                "max_new_tokens": int(max_new_tokens), "stream": True}
+    if priority is not None:
+        payload["priority"] = str(priority)
+    if ttft_deadline_ms is not None:
+        payload["ttft_deadline_ms"] = float(ttft_deadline_ms)
     tokens: list = []
     ttft = None
     replica = None

@@ -70,6 +70,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tfde_tpu.inference import admission as _admission
 from tfde_tpu.inference.decode import (
     _decode_clone,
     init_cache,
@@ -385,6 +386,53 @@ def _ladder_depth(cap: int, bound: int) -> int:
     return k
 
 
+class _PriorityDeque:
+    """The batcher's request queue: one FIFO lane per priority class,
+    drained highest-priority-first (`interactive` > `batch` >
+    `best_effort`, FIFO within a class). Presents the deque surface the
+    admission/accounting code already speaks — truthiness, `len`,
+    iteration (in drain order), `popleft` — so single-class traffic
+    behaves exactly like the plain deque it replaces."""
+
+    def __init__(self):
+        self._lanes = collections.OrderedDict(
+            (p, collections.deque()) for p in _admission.PRIORITIES
+        )
+
+    def append(self, item,
+               priority: str = _admission.DEFAULT_PRIORITY) -> None:
+        self._lanes[priority].append(item)
+
+    def popleft(self):
+        for lane in self._lanes.values():
+            if lane:
+                return lane.popleft()
+        raise IndexError("pop from an empty priority queue")
+
+    def remove_rid(self, rid: int) -> bool:
+        """Drop the queued item with request id `rid` (cancel path)."""
+        for lane in self._lanes.values():
+            for i, item in enumerate(lane):
+                if item[0] == rid:
+                    del lane[i]
+                    return True
+        return False
+
+    def depths(self) -> dict:
+        """Per-class queue depth (the /load snapshot detail)."""
+        return {p: len(lane) for p, lane in self._lanes.items()}
+
+    def __len__(self) -> int:
+        return sum(len(lane) for lane in self._lanes.values())
+
+    def __bool__(self) -> bool:
+        return any(self._lanes.values())
+
+    def __iter__(self):
+        for lane in self._lanes.values():
+            yield from lane
+
+
 def _pad_wave(r: int, cap: int) -> int:
     """Admission wave sizes ride their own power-of-two ladder (capped at
     the batch size) so `_prefill_rows` compiles O(log B) per bucket, not
@@ -412,7 +460,7 @@ class _BatcherBase:
 
     def __init__(self, model, params, batch_size: int, max_len: int,
                  eos_id, pad_id: int, rng, prompt_buckets,
-                 role: str = "both"):
+                 role: str = "both", admission_ctl=None):
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         if role not in ("both", "prefill", "decode"):
@@ -435,8 +483,16 @@ class _BatcherBase:
         self._committed = np.zeros(batch_size, np.int64)
         self._tok = np.full(batch_size, pad_id, np.int64)
         # queue items: (rid, prompt [P] np.int64, budget, primed|None) —
-        # `primed` set only for submit_primed() entries (K/V in hand)
-        self._queue: collections.deque = collections.deque()
+        # `primed` set only for submit_primed() entries (K/V in hand).
+        # Drained highest-priority-first; FIFO within a class.
+        self._queue: _PriorityDeque = _PriorityDeque()
+        # admission policy: caps + drain-rate estimate (defaults read
+        # TFDE_ADMIT_*; everything off unless configured)
+        self._admission = (admission_ctl if admission_ctl is not None
+                           else _admission.AdmissionController())
+        self._priority: dict = {}       # rid -> priority class
+        self._deadline_at: dict = {}    # rid -> absolute TTFT deadline
+        self._shed: set = set()         # rids deadline-shed at dequeue
         self._submitted_at: dict = {}   # rid -> submit wall time (TTFT)
         self._first_at: dict = {}       # rid -> first-token time (TPOT)
         # rid -> request trace id; populated ONLY while the trace ring is
@@ -498,22 +554,56 @@ class _BatcherBase:
         )
         return active + sum(int(b) for _rid, _p, b, _pr in self._queue)
 
+    @property
+    def queued_tokens(self) -> int:
+        """Output-token backlog of QUEUED requests only (active rows are
+        already paid for) — the admission cap's and the drain-rate
+        estimate's unit."""
+        return sum(int(b) for _rid, _p, b, _pr in self._queue)
+
+    @property
+    def admission(self) -> "_admission.AdmissionController":
+        return self._admission
+
+    def was_shed(self, rid: int) -> bool:
+        """True exactly once for a request that was deadline-shed at
+        dequeue — the HTTP layer reads this to turn the empty completion
+        into an explicit shed event on the stream."""
+        if rid in self._shed:
+            self._shed.discard(rid)
+            return True
+        return False
+
     def submit(self, prompt, max_new_tokens: int,
-               trace: Optional[str] = None) -> int:
+               trace: Optional[str] = None,
+               priority: Optional[str] = None,
+               ttft_deadline_ms: Optional[float] = None) -> int:
         """Queue a request; returns its id. prompt: 1-D int token ids.
         `trace`: the request's distributed-trace id (X-Tfde-Trace),
-        recorded on every span event the request generates."""
+        recorded on every span event the request generates.
+        `priority`: admission class ('interactive' > 'batch' >
+        'best_effort'; default interactive) — the queue drains
+        highest-priority-first. `ttft_deadline_ms`: shed the request at
+        dequeue if its queue wait alone already blew this budget
+        (default: the controller's TFDE_ADMIT_TTFT_DEADLINE_MS).
+        Raises `admission.QueueFull` when a configured cap is hit."""
         if self._role == "prefill":
             raise RuntimeError(
                 "prefill-only replica: use prime() and hand the result to "
                 "a decode replica's submit_primed()"
             )
         prompt = self._check_request(prompt, max_new_tokens)
-        rid = self._enqueue(prompt, int(max_new_tokens), None, trace)
+        pr = _admission.validate_priority(priority)
+        self._admission.check(len(self._queue), self.queued_tokens,
+                              int(max_new_tokens))
+        rid = self._enqueue(prompt, int(max_new_tokens), None, trace,
+                            priority=pr, ttft_deadline_ms=ttft_deadline_ms)
         return rid
 
     def submit_primed(self, primed: PrimedRequest,
-                      trace: Optional[str] = None) -> int:
+                      trace: Optional[str] = None,
+                      priority: Optional[str] = None,
+                      ttft_deadline_ms: Optional[float] = None) -> int:
         """Queue a request whose prefill already ran on a prefill-role
         replica (`prime()`); only the K/V scatter and decode happen
         here. Returns the local request id."""
@@ -524,8 +614,12 @@ class _BatcherBase:
         if self._role == "prefill":
             raise RuntimeError("prefill-only replica cannot decode")
         prompt = self._check_request(primed.prompt, primed.max_new_tokens)
+        pr = _admission.validate_priority(priority)
+        self._admission.check(len(self._queue), self.queued_tokens,
+                              int(primed.max_new_tokens))
         return self._enqueue(prompt, int(primed.max_new_tokens), primed,
-                             trace)
+                             trace, priority=pr,
+                             ttft_deadline_ms=ttft_deadline_ms)
 
     def enable_progress(self) -> None:
         """Track per-request incremental tokens for `take_progress` (the
@@ -559,13 +653,14 @@ class _BatcherBase:
         self._stream.pop(rid, None)
         self._submitted_at.pop(rid, None)
         self._first_at.pop(rid, None)
+        self._priority.pop(rid, None)
+        self._deadline_at.pop(rid, None)
+        self._shed.discard(rid)
         tid = self._trace_ids.pop(rid, None)
         if tid is not None:
             _trace.event("serve/cancelled", trace=tid, rid=rid)
-        for i, item in enumerate(self._queue):
-            if item[0] == rid:
-                del self._queue[i]
-                return True
+        if self._queue.remove_rid(rid):
+            return True
         for r in range(self._b):
             if self._req[r] == rid:
                 self._req[r] = None
@@ -598,18 +693,26 @@ class _BatcherBase:
         return prompt
 
     def _enqueue(self, prompt: np.ndarray, budget: int, primed,
-                 trace: Optional[str] = None) -> int:
+                 trace: Optional[str] = None,
+                 priority: str = _admission.DEFAULT_PRIORITY,
+                 ttft_deadline_ms: Optional[float] = None) -> int:
         rid = self._next_id
         self._next_id += 1
-        self._queue.append((rid, prompt, budget, primed))
-        self._submitted_at[rid] = time.perf_counter()
+        self._queue.append((rid, prompt, budget, primed), priority=priority)
+        now = time.perf_counter()
+        self._submitted_at[rid] = now
+        self._priority[rid] = priority
+        dl = (float(ttft_deadline_ms) if ttft_deadline_ms is not None
+              else self._admission.ttft_deadline_ms)
+        if dl and dl > 0:
+            self._deadline_at[rid] = now + dl / 1e3
         if self._track_progress:
             self._stream[rid] = {"tokens": [], "taken": 0, "done": False}
         if trace is not None and _trace.active():
             self._trace_ids[rid] = trace
             _trace.event("serve/queued", trace=trace, rid=rid,
                          prompt_tokens=int(prompt.size), budget=int(budget),
-                         primed=primed is not None,
+                         primed=primed is not None, priority=priority,
                          queue_depth=len(self._queue))
         return rid
 
@@ -632,6 +735,12 @@ class _BatcherBase:
         reg.gauge(f"{self._metrics_prefix}/free_rows").set(self.free_rows)
         reg.gauge(f"{self._metrics_prefix}/outstanding_tokens").set(
             self.outstanding_tokens
+        )
+        reg.gauge(f"{self._metrics_prefix}/queued_tokens").set(
+            self.queued_tokens
+        )
+        reg.gauge(f"{self._metrics_prefix}/drain_rate_tps").set(
+            self._admission.drain_rate_tps
         )
 
     # -- hooks --------------------------------------------------------------
@@ -678,6 +787,8 @@ class _BatcherBase:
                 _trace.event("serve/done", trace=tid, rid=rid, tokens=n,
                              eos=bool(self._eos is not None
                                       and t == self._eos))
+            self._priority.pop(rid, None)
+            self._deadline_at.pop(rid, None)
             done = (rid, np.asarray(self._out[r], np.int32))
             self._req[r] = None
             self._out[r] = []
@@ -786,7 +897,14 @@ class _BatcherBase:
             free = [r for r in range(self._b) if self._req[r] is None]
             wave = []
             while self._queue and len(wave) < len(free):
-                wave.append(self._queue.popleft())
+                item = self._queue.popleft()
+                # deadline shed happens HERE, at dequeue: a request whose
+                # queue wait alone already blew its TTFT budget is dead
+                # on arrival to the client — prefilling it would spend a
+                # wave on tokens nobody is waiting for
+                if self._maybe_shed(item):
+                    continue
+                wave.append(item)
             taken = 0
             for kind, key, group in self._plan_wave(wave):
                 n = len(group)
@@ -849,6 +967,41 @@ class _BatcherBase:
             self._mark_dirty()
         return finished
 
+    def _maybe_shed(self, item) -> bool:
+        """Deadline/TTL shedding: True when `item`'s queue wait already
+        exceeds its TTFT deadline — the request is dropped (no prefill),
+        its stream entry flips to done+shed, and `was_shed` answers once
+        so the HTTP layer can report it explicitly."""
+        rid, _prompt, budget, _pr = item
+        dl = self._deadline_at.get(rid)
+        if dl is None or time.perf_counter() <= dl:
+            return False
+        pr = self._priority.pop(rid, _admission.DEFAULT_PRIORITY)
+        self._deadline_at.pop(rid, None)
+        t0 = self._submitted_at.pop(rid, None)
+        self._first_at.pop(rid, None)
+        waited_ms = ((time.perf_counter() - t0) * 1e3
+                     if t0 is not None else None)
+        self._shed.add(rid)
+        ent = self._stream.get(rid)
+        if ent is not None:
+            ent["done"] = True
+            ent["shed"] = True
+        reg = metrics.default_registry()
+        reg.counter("serving/shed_expired").incr()
+        reg.counter(f"serving/shed_{pr}").incr()
+        reg.counter("serving/shed_tokens").incr(int(budget))
+        tid = self._trace_ids.pop(rid, None)
+        if tid is not None:
+            _trace.event("serve/shed", trace=tid, rid=rid, priority=pr,
+                         waited_ms=round(waited_ms, 3)
+                         if waited_ms is not None else None)
+        from tfde_tpu.observability import flightrec
+
+        flightrec.record("shed", rid=rid, priority=pr,
+                         waited_ms=waited_ms, budget=int(budget))
+        return True
+
     def _mark_dirty(self) -> None:
         """Admission invalidated the device-resident loop state (if the
         subclass keeps any)."""
@@ -907,6 +1060,7 @@ class ContinuousBatcher(_BatcherBase):
         scan_depth: int = 4,
         prefix_cache=None,
         role: str = "both",
+        admission_ctl=None,
     ):
         if repetition_penalty <= 0.0:
             raise ValueError(
@@ -916,7 +1070,8 @@ class ContinuousBatcher(_BatcherBase):
         if scan_depth < 1:
             raise ValueError(f"scan_depth must be >= 1, got {scan_depth}")
         super().__init__(model, params, batch_size, max_len, eos_id,
-                         pad_id, rng, prompt_buckets, role=role)
+                         pad_id, rng, prompt_buckets, role=role,
+                         admission_ctl=admission_ctl)
         self._decode_model = _decode_clone(model)
         self._sampling = dict(
             temperature=float(temperature),
@@ -1063,6 +1218,7 @@ class ContinuousBatcher(_BatcherBase):
             metrics.default_registry().histogram(
                 "serving/ms_per_token"
             ).observe(dt * 1e3 / n_emitted)
+            self._admission.note_drain(n_emitted, dt)
         self._publish_stats()
         return finished
 
@@ -1611,5 +1767,6 @@ class SpeculativeContinuousBatcher(_BatcherBase):
             metrics.default_registry().histogram(
                 "serving/ms_per_token"
             ).observe(dt * 1e3 / n_emitted)
+            self._admission.note_drain(n_emitted, dt)
         self._publish_stats()
         return finished

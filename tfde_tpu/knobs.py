@@ -230,6 +230,34 @@ _register(
          "Serving prefix-KV cache default for every ContinuousBatcher: "
          "off (default), on (default budget), or an integer byte budget.",
          "inference/prefix_cache.py"),
+    Knob("TFDE_ADMIT_", "spec", None, (),
+         "Serving admission-control family prefix (see members below); "
+         "all caps default off, so admission control is opt-in.",
+         "inference/admission.py", prefix=True),
+    Knob("TFDE_ADMIT_MAX_QUEUE", "int", 0, (),
+         "Max QUEUED requests per batcher before submit() answers "
+         "QueueFull/429 (0 = unlimited; active rows don't count).",
+         "inference/admission.py"),
+    Knob("TFDE_ADMIT_MAX_QUEUED_TOKENS", "int", 0, (),
+         "Max queued output-token backlog per batcher before submit() "
+         "answers QueueFull/429 (0 = unlimited).",
+         "inference/admission.py"),
+    Knob("TFDE_ADMIT_TTFT_DEADLINE_MS", "float", 0.0, (),
+         "Default TTFT deadline applied to requests that don't bring "
+         "their own: a request still queued past it is shed at dequeue "
+         "instead of prefilled (0 = no deadline shedding).",
+         "inference/admission.py"),
+    Knob("TFDE_BROWNOUT_", "spec", None, (),
+         "Router brownout family prefix (see members below).",
+         "inference/router.py", prefix=True),
+    Knob("TFDE_BROWNOUT_BURN", "float", 8.0, (),
+         "Fast-window TTFT burn rate at which the router starts shedding "
+         "best_effort traffic (0 = brownout off).",
+         "inference/router.py"),
+    Knob("TFDE_BROWNOUT_BURN_BATCH", "float", 16.0, (),
+         "Fast-window TTFT burn rate at which the router also sheds "
+         "batch traffic; interactive is never brownout-shed.",
+         "inference/router.py"),
     # --- static analysis / gates -----------------------------------------
     Knob("TFDE_HLOLINT", "flag", False, (),
          "Arm the lowered-program linter's collection seam: programs "

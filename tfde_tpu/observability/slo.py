@@ -130,6 +130,18 @@ class SLOTracker:
             return None
         return (1.0 - att) / (1.0 - self.objective)
 
+    def window_stats(self, metric: str, window: float):
+        """(in-window sample count, attainment or None) — callers that
+        act on a burn rate (the router's brownout) need the count to
+        apply the same MIN_BURN_SAMPLES guard the capture trigger uses:
+        one slow request at cold start is not an incident."""
+        with self._lock:
+            cut = self._clock() - window
+            rows = [ok for (t, ok) in self._samples[metric] if t >= cut]
+        if not rows:
+            return 0, None
+        return len(rows), sum(rows) / len(rows)
+
     def summary(self) -> dict:
         """The /replicas embed: targets, lifetime attainment, and burn
         per window for both latency SLOs."""

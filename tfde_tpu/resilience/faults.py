@@ -100,7 +100,29 @@ class PeerLossFault:
         raise elastic.PeerLostError(self.rank, f"{self.reason} [{where}]")
 
 
-Action = Union[RaiseFault, DelayFault, SignalFault, PeerLossFault]
+@dataclasses.dataclass(frozen=True)
+class OverloadFault:
+    """Force the serving admission layer into saturation for `seconds` —
+    the overload drill's lever. Unlike the other actions it does not
+    raise or stall the wrapped call: it arms a process-wide switch
+    (`inference/admission.force_overload`) that makes every
+    AdmissionController reject with QueueFull, so the full 429 /
+    Retry-After / brownout path is exercised without generating
+    2x-capacity load."""
+
+    seconds: float = 5.0
+
+    def fire(self, where: str) -> None:
+        counters.incr("resilience/faults_injected")
+        log.info("fault injection: forced overload for %.1fs at %s",
+                 self.seconds, where)
+        from tfde_tpu.inference import admission
+
+        admission.force_overload(self.seconds)
+
+
+Action = Union[RaiseFault, DelayFault, SignalFault, PeerLossFault,
+               OverloadFault]
 
 
 # -- schedules ---------------------------------------------------------------

@@ -26,6 +26,14 @@ the first two):
    the audit output, so the exemption is a reviewable line here, not
    silence.
 
+   A spec may additionally name ``guarded_attrs``: attributes where ANY
+   access — reads included — must happen under the lock, because the
+   object behind the attribute is only single-threaded by virtue of
+   that lock (``ReplicaServer.batcher``: the step loop mutates the
+   batcher's queue under ``self.lock``, so even ``len(b._queue)`` from
+   a handler thread is a race — the exact bug the PR 14 review fixed in
+   the Router's /load path).
+
 2. **Greedy-path `jax.random.split` ban** — in `tfde_tpu/inference/`,
    every ``jax.random.split`` call must be lexically inside an ``if``
    whose condition mentions ``temperature`` or ``greedy``: splitting on
@@ -70,6 +78,10 @@ class LockSpec:
     exempt_methods: Tuple[str, ...] = ()
     #: self-attributes writable without the lock (documented reasons)
     exempt_attrs: Tuple[str, ...] = ()
+    #: self-attributes where ANY access (reads included) must hold the
+    #: lock: the attribute is a handle to an object that is only
+    #: single-threaded under this lock
+    guarded_attrs: Tuple[str, ...] = ()
     #: set when the class is serialized by a lock its OWNER holds; the
     #: class is skipped and the reason surfaces in the audit output
     external: Optional[str] = None
@@ -97,6 +109,14 @@ LOCKED_CLASSES: Dict[Tuple[str, str], LockSpec] = {
         external="ReplicaServer.lock — the HTTP server holds its RLock "
                  "around every submit/step/take_progress/cancel call; the "
                  "batcher itself is single-threaded by contract",
+    ),
+    ("tfde_tpu/inference/router.py", "ReplicaServer"): LockSpec(
+        lock="lock",
+        # the batcher is the object _BatcherBase's external-lock entry
+        # above points at: it is only single-threaded while this
+        # server's lock is held, so even READING through self.batcher
+        # from a handler thread races the step loop
+        guarded_attrs=("batcher",),
     ),
 }
 
@@ -208,6 +228,16 @@ class _LockVisitor(ast.NodeVisitor):
                     name = self._attr_name(t)
                     if name not in self.spec.exempt_attrs:
                         self._flag(node, f"write to self.{name}")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # guarded attrs: reads count too — the attribute is a handle to
+        # an object whose thread-safety IS this lock
+        if (self._lock_depth == 0
+                and node.attr in self.spec.guarded_attrs
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            self._flag(node, f"access to self.{node.attr}")
         self.generic_visit(node)
 
 

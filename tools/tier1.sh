@@ -51,6 +51,21 @@
 #                                   tests/test_elastic.py and
 #                                   tests/test_multiprocess.py enable it
 #                                   explicitly either way)
+#        TFDE_ADMIT_MAX_QUEUE=8 tools/tier1.sh
+#                                  (re-run the whole suite with serving
+#                                   admission caps armed by default —
+#                                   inference/admission.py; 0 = off.
+#                                   TFDE_ADMIT_MAX_QUEUED_TOKENS and
+#                                   TFDE_ADMIT_TTFT_DEADLINE_MS forward
+#                                   the same way; the overload drills in
+#                                   tests/test_server.py and
+#                                   tests/test_multiprocess.py arm them
+#                                   explicitly either way)
+#        TFDE_BROWNOUT_BURN=2 tools/tier1.sh
+#                                  (router brownout burn-rate thresholds
+#                                   — inference/router.py; _BATCH is the
+#                                   level-2 threshold that also sheds
+#                                   the batch class)
 #
 # Also prints DOTS_DELTA (this run's DOTS_PASSED minus the previous
 # run's, from /tmp/_t1.passed) so a regression is visible at a glance
@@ -69,6 +84,11 @@ timeout -k 10 1440 env JAX_PLATFORMS=cpu \
     TFDE_TRACE="${TFDE_TRACE:-off}" \
     TFDE_MEMWATCH="${TFDE_MEMWATCH:-on}" \
     TFDE_ELASTIC="${TFDE_ELASTIC:-off}" \
+    TFDE_ADMIT_MAX_QUEUE="${TFDE_ADMIT_MAX_QUEUE:-0}" \
+    TFDE_ADMIT_MAX_QUEUED_TOKENS="${TFDE_ADMIT_MAX_QUEUED_TOKENS:-0}" \
+    TFDE_ADMIT_TTFT_DEADLINE_MS="${TFDE_ADMIT_TTFT_DEADLINE_MS:-0}" \
+    TFDE_BROWNOUT_BURN="${TFDE_BROWNOUT_BURN:-8}" \
+    TFDE_BROWNOUT_BURN_BATCH="${TFDE_BROWNOUT_BURN_BATCH:-16}" \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     --durations=10 \
