@@ -1497,13 +1497,24 @@ def _bench_serve(clock: _Clock, smoke: bool) -> dict:
             rng.integers(0, model.vocab_size, lens[i % len(lens)]), new
         )
     t0 = _time.perf_counter()
-    done = srv.run()
+    # step (rather than run) so slab occupancy can be sampled per decode
+    # round — kv_stats is the same host-side read _publish_stats already
+    # does every step, so the timed path is unchanged
+    done = []
+    occ_samples = []
+    min_headroom = batch
+    while not srv.idle:
+        done.extend(srv.step())
+        kv = srv.kv_stats()
+        occ_samples.append(1.0 - kv["waste_frac"])
+        min_headroom = min(min_headroom, kv["headroom_rows"])
     total = sum(len(t) for _, t in done)
     # the loop's own host round-trips are part of what's measured; the
-    # final host sync is implicit in run()'s per-step bundled fetch
+    # final host sync is implicit in the per-step bundled fetch
     dt = _time.perf_counter() - t0
     stats = srv.stats()
     serve_tps = total / max(dt, 1e-9)
+    pad = srv._ledger.pad_stats()
     out = {
         "serve_tokens_per_sec": round(serve_tps, 1),
         "serve_requests": len(done),
@@ -1517,6 +1528,17 @@ def _bench_serve(clock: _Clock, smoke: bool) -> dict:
             stats["dispatches_per_token"], 3
         ),
         "serve_syncs_per_token": round(stats["syncs_per_token"], 3),
+        # capacity ledger columns (observability/capacity.py): the
+        # paged-KV PR's before/after baseline. waste_frac is the
+        # pad-ladder fraction (prefill cells computed beyond the true
+        # prompt); occupancy is mean committed/allocated slab fraction
+        # across decode rounds; headroom_rows is the tightest admission
+        # headroom the run saw
+        "serve_kv_waste_frac": round(
+            pad["pad_waste_tokens"] / max(pad["pad_alloc_tokens"], 1), 4),
+        "serve_kv_occupancy": round(
+            sum(occ_samples) / max(len(occ_samples), 1), 4),
+        "serve_headroom_rows": int(min_headroom),
     }
     # memory + compile columns: peak bytes over every serve/* program the
     # ledger registered (prefill buckets + decode depths) and the serve
@@ -1930,6 +1952,22 @@ def _bench_serve_cluster(smoke: bool) -> dict:
                 adm_ttfts[min(len(adm_ttfts) - 1,
                               int(0.99 * len(adm_ttfts)))], 2
             )
+        # fleet KV capacity after the pair run: the replicas pushed their
+        # kv/* gauges with every metrics push, so the chief's rollup has
+        # the allocation-weighted waste and summed headroom (the cluster
+        # face of the paged-KV baseline)
+        roll = agg.rollup()
+        if "kv_waste_frac" in roll:
+            out["serve_cluster_kv_waste_frac"] = round(
+                roll["kv_waste_frac"], 4)
+            out["serve_cluster_kv_headroom_rows"] = int(
+                roll["kv_headroom_rows"])
+        flat_hosts = agg.host_metrics(("kv/",))
+        occ = [1.0 - h["kv/waste_frac"] for h in flat_hosts.values()
+               if "kv/waste_frac" in h]
+        if occ:
+            out["serve_cluster_kv_occupancy"] = round(
+                sum(occ) / len(occ), 4)
 
         # kill drill: router with the aggregator attached (staleness is a
         # second down signal) and a flight ring to dump the post-mortem

@@ -1,0 +1,316 @@
+"""Capacity observability (observability/capacity.py): the KV occupancy
+ledger's arithmetic is pinned against hand-computed admission waves, its
+used-bytes figure against memwatch's measured bytes over the live cache
+cells, the headroom model against the budget math, and the usage meter's
+token totals bit-exact against the per-request outputs."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.inference.decode import generate
+from tfde_tpu.inference.server import ContinuousBatcher
+from tfde_tpu.models.gpt import gpt_tiny_test
+from tfde_tpu.observability import capacity, metrics
+
+
+@pytest.fixture(scope="module")
+def lm():
+    m = gpt_tiny_test()
+    params = m.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+def _solo(model, params, prompt, n, **kw):
+    toks, lengths = generate(
+        model, params, jnp.asarray(prompt[None, :], jnp.int32),
+        max_new_tokens=n, **kw,
+    )
+    p = prompt.size
+    return np.asarray(toks)[0, p : int(lengths[0])]
+
+
+# --------------------------------------------------------------------------
+# CapacityLedger: occupancy + pad-waste arithmetic against hand computation
+# --------------------------------------------------------------------------
+
+def test_ledger_observe_hand_computed():
+    """A synthetic 4-row/32-cell slab of 1024 bytes: per-cell cost is
+    8 bytes, and every gauge follows from the committed counts alone."""
+    reg = metrics.Registry()
+    led = capacity.CapacityLedger(4, 32, 1024, registry=reg)
+    assert led.cell_bytes == pytest.approx(8.0)
+    assert led.row_bytes == pytest.approx(256.0)
+    s = led.observe(np.asarray([5, 0, 12, 7]), [1, None, 3, 4])
+    assert s["used_cells"] == 24                 # 5 + 12 + 7; idle row 1 out
+    assert s["used_bytes"] == pytest.approx(24 * 8.0)
+    assert s["rows_active"] == 3 and s["rows_free"] == 1
+    assert s["waste_frac"] == pytest.approx(1.0 - 24 / 128.0)
+    assert reg.get("kv/allocated_bytes").value == 1024
+    assert reg.get("kv/used_bytes").value == pytest.approx(192.0)
+    assert reg.get("kv/rows_free").value == 1
+    # empty slab: zero used, full waste
+    s = led.observe(np.zeros(4, np.int64), [None] * 4)
+    assert s["used_cells"] == 0 and s["waste_frac"] == pytest.approx(1.0)
+
+
+def test_ledger_pad_waste_hand_computed_waves():
+    """Three admission waves with known bucket/prompt shapes: the
+    cumulative and per-bucket pad counters match the hand sums, and the
+    waste histogram saw one observation per admitted request."""
+    reg = metrics.Registry()
+    led = capacity.CapacityLedger(4, 64, 4096, registry=reg)
+    # wave 1 (cold, bucket 8): prompts of 5 and 8 -> waste 3 + 0
+    led.note_admission("cold", 8, 5)
+    led.note_admission("cold", 8, 8)
+    # wave 2 (cold, bucket 16): prompt of 9 -> waste 7
+    led.note_admission("cold", 16, 9)
+    # wave 3 (warm, suffix bucket 8): 3 suffix tokens -> waste 5
+    led.note_admission("warm", 8, 3)
+    p = led.pad_stats()
+    assert p["pad_alloc_tokens"] == 8 + 8 + 16 + 8
+    assert p["pad_waste_tokens"] == 3 + 0 + 7 + 5
+    assert p["per_bucket"] == {
+        8: {"alloc": 24, "waste": 8},
+        16: {"alloc": 16, "waste": 7},
+    }
+    assert reg.get("kv/pad_alloc_tokens").value == 40
+    assert reg.get("kv/pad_waste_tokens").value == 15
+    assert reg.get("kv/pad_alloc_tokens/bucket_8").value == 24
+    assert reg.get("kv/pad_waste_tokens/bucket_16").value == 7
+    h = reg.get("kv/pad_waste_frac")
+    assert h.count == 4
+    assert h.sum == pytest.approx(3 / 8 + 0.0 + 7 / 16 + 5 / 8)
+
+
+def test_ledger_tracks_batcher_waves(lm, rng):
+    """The real batcher feeds the ledger: a wave of known prompt lengths
+    on the default power-of-two ladder lands in the hand-computed
+    buckets, and after the run the slab drains back to zero occupancy."""
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=4, max_len=64)
+    # buckets default to (8, 16, 32, 64); prompts 5, 6, 12 -> 8, 8, 16
+    plens = (5, 6, 12)
+    for plen in plens:
+        srv.submit(rng.integers(0, 97, plen).astype(np.int64), 4)
+    srv.run()
+    p = srv._ledger.pad_stats()
+    assert p["pad_alloc_tokens"] == 8 + 8 + 16
+    assert p["pad_waste_tokens"] == 3 + 2 + 4
+    assert p["per_bucket"][8] == {"alloc": 16, "waste": 5}
+    assert p["per_bucket"][16] == {"alloc": 16, "waste": 4}
+    s = srv.kv_stats()
+    assert s["rows_active"] == 0 and s["used_cells"] == 0
+    assert s["headroom_rows"] == 4
+    assert s["allocated_bytes"] == srv._ledger.slab_bytes
+
+
+def test_ledger_used_bytes_matches_memwatch_device_bytes(lm, rng):
+    """The acceptance pin: mid-flight, `kv/used_bytes` is within 20% of
+    memwatch.device_bytes measured over the LIVE cache cells (each
+    active row's committed slice of every K/V leaf). The ledger's
+    per-cell cost comes from the slab's own leaf bytes, so on the CPU
+    mesh the two agree to rounding."""
+    from tfde_tpu.inference.prefix_cache import is_index_leaf
+    from tfde_tpu.observability import memwatch
+
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=3, max_len=48)
+    for plen, n in [(5, 24), (9, 20), (3, 28)]:
+        srv.submit(rng.integers(0, 97, plen).astype(np.int64), n)
+    for _ in range(2):
+        srv.step()
+    s = srv.kv_stats()
+    assert s["rows_active"] == 3 and s["used_cells"] > 0
+    live = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(srv._cache):
+        if is_index_leaf(path):
+            continue
+        for r in range(3):
+            if srv._req[r] is not None and srv._committed[r]:
+                live.append(leaf[r : r + 1, : int(srv._committed[r])])
+    measured = memwatch.device_bytes(live)
+    assert measured > 0
+    assert s["used_bytes"] == pytest.approx(measured, rel=0.2)
+    srv.run()
+
+
+# --------------------------------------------------------------------------
+# CapacityModel: headroom math, budget on and off
+# --------------------------------------------------------------------------
+
+def test_capacity_model_headroom_math():
+    reg = metrics.Registry()
+    led = capacity.CapacityLedger(4, 32, 1024, registry=reg)  # row: 256 B
+    occ = led.observe(np.asarray([10, 0, 0, 0]), [1, None, None, None])
+    # budget off: headroom is simply the free slab rows/cells
+    free = capacity.CapacityModel(led, budget_bytes=0, registry=reg)
+    hd = free.headroom(occ)
+    assert hd == {"headroom_rows": 3, "headroom_tokens": 96}
+    assert reg.get("kv/headroom_rows").value == 3
+    # budget binding: 10 cells * 8 B = 80 B used; 600 B budget leaves
+    # 520 B spare -> 2 rows (520 // 256), 65 tokens (520 // 8)
+    tight = capacity.CapacityModel(led, budget_bytes=600, registry=reg)
+    hd = tight.headroom(occ)
+    assert hd == {"headroom_rows": 2, "headroom_tokens": 65}
+    # budget exhausted: clamps to zero, never negative
+    broke = capacity.CapacityModel(led, budget_bytes=64, registry=reg)
+    hd = broke.headroom(occ)
+    assert hd == {"headroom_rows": 0, "headroom_tokens": 0}
+
+
+def test_capacity_model_env_budget(monkeypatch):
+    monkeypatch.setenv("TFDE_CAPACITY_BUDGET_BYTES", "600")
+    reg = metrics.Registry()
+    led = capacity.CapacityLedger(4, 32, 1024, registry=reg)
+    occ = led.observe(np.asarray([10, 0, 0, 0]), [1, None, None, None])
+    model = capacity.CapacityModel(led, registry=reg)
+    assert model.budget_bytes == 600
+    assert model.headroom(occ)["headroom_rows"] == 2
+
+
+# --------------------------------------------------------------------------
+# UsageLog: bounded JSONL with oldest-first compaction
+# --------------------------------------------------------------------------
+
+def test_usage_log_bounded_compaction(tmp_path):
+    path = str(tmp_path / "usage.jsonl")
+    log = capacity.UsageLog(path, max_bytes=400)
+    for i in range(50):
+        log.write({"rid": i, "prompt_tokens": 7})
+    log.close()
+    with open(path) as f:
+        lines = f.readlines()
+    assert sum(len(ln) for ln in lines) <= 400
+    recs = [json.loads(ln) for ln in lines]
+    # newest records survive, in order, and the latest is always present
+    assert recs[-1]["rid"] == 49
+    rids = [r["rid"] for r in recs]
+    assert rids == sorted(rids)
+    # reopening appends (the restart path) and stays bounded
+    log = capacity.UsageLog(path, max_bytes=400)
+    log.write({"rid": 50, "prompt_tokens": 7})
+    log.close()
+    with open(path) as f:
+        assert json.loads(f.readlines()[-1])["rid"] == 50
+
+
+def test_resolve_usage_log_spec(tmp_path, monkeypatch):
+    monkeypatch.delenv("TFDE_USAGE_LOG", raising=False)
+    assert capacity.resolve_usage_log(str(tmp_path)) is None
+    monkeypatch.setenv("TFDE_USAGE_LOG", "off")
+    assert capacity.resolve_usage_log(str(tmp_path)) is None
+    monkeypatch.setenv("TFDE_USAGE_LOG", "on")
+    assert capacity.resolve_usage_log(None) is None  # nothing to anchor
+    log = capacity.resolve_usage_log(str(tmp_path))
+    assert log is not None
+    assert log.path.startswith(str(tmp_path))
+    assert "metrics/usage_" in log.path.replace("\\", "/")
+    log.close()
+    explicit = str(tmp_path / "explicit.jsonl")
+    monkeypatch.setenv("TFDE_USAGE_LOG", explicit)
+    log = capacity.resolve_usage_log(None)
+    assert log.path == explicit
+    log.close()
+
+
+# --------------------------------------------------------------------------
+# UsageMeter: per-request accounting, bit-exact totals, outcome stamps
+# --------------------------------------------------------------------------
+
+def test_usage_meter_residency_and_outcomes():
+    import time as _time
+
+    reg = metrics.Registry()
+    meter = capacity.UsageMeter(registry=reg)
+    meter.begin(1, 10, "interactive")
+    meter.admitted(1)
+    _time.sleep(0.02)    # a real resident window, well above the 1e-6
+    rec = meter.finish(1, 6)       # rounding in the journal record
+    # trapezoid: 10 cells at admit, 16 at finish, over the resident window
+    assert rec["kv_token_seconds"] == pytest.approx(
+        13.0 * rec["resident_s"], rel=1e-3)
+    assert rec["prompt_tokens"] == 10 and rec["generated_tokens"] == 6
+    assert rec["outcome"] == "ok" and rec["priority"] == "interactive"
+    # queue-side shed: never admitted -> zero residency, outcome stamped
+    meter.begin(2, 4, "batch")
+    rec = meter.finish(2, 0, outcome="shed")
+    assert rec["kv_token_seconds"] == 0.0 and rec["resident_s"] == 0.0
+    # idempotent: closing an unknown/closed rid is a no-op
+    assert meter.finish(2, 0) is None
+    assert meter.totals() == {
+        "requests": 2, "prompt_tokens": 14, "generated_tokens": 6,
+        "kv_token_seconds": pytest.approx(
+            reg.get("usage/kv_token_seconds").value),
+    }
+    assert reg.get("usage/requests").value == 2
+    assert reg.get("usage/requests/interactive").value == 1
+    assert reg.get("usage/requests/batch").value == 1
+    assert reg.get("usage/requests/ok").value == 1
+    assert reg.get("usage/requests/shed").value == 1
+    assert reg.get("usage/prompt_tokens").value == 14
+    assert reg.get("usage/generated_tokens").value == 6
+
+
+def test_usage_totals_bit_exact_vs_solo_staggered(lm, rng, tmp_path,
+                                                  monkeypatch):
+    """The acceptance pin: under a staggered-admission parity sweep the
+    usage log's per-request prompt/generated token counts sum bit-exact
+    to the solo-generate references — metering never invents or drops a
+    token, even across mid-flight admission on recycled rows."""
+    monkeypatch.setenv("TFDE_USAGE_LOG", str(tmp_path / "usage.jsonl"))
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48)
+    reqs = [(rng.integers(0, 97, plen).astype(np.int64), n)
+            for plen, n in [(3, 9), (5, 4), (2, 12), (7, 1), (4, 7)]]
+    rids = [srv.submit(p, max_new_tokens=n) for p, n in reqs[:3]]
+    done = dict(srv.step())          # late arrivals land on recycled rows
+    rids += [srv.submit(p, max_new_tokens=n) for p, n in reqs[3:]]
+    done.update(srv.run())
+    solos = [_solo(model, params, p, n) for p, n in reqs]
+    for rid, ref in zip(rids, solos):
+        np.testing.assert_array_equal(done[rid], ref)
+    totals = srv.usage.totals()
+    assert totals["requests"] == len(reqs)
+    assert totals["prompt_tokens"] == sum(p.size for p, _ in reqs)
+    assert totals["generated_tokens"] == sum(len(s) for s in solos)
+    assert totals["kv_token_seconds"] > 0.0
+    # and the JSONL journal carries the same sums, record for record
+    srv.usage.close()
+    with open(srv.usage.log_path or str(tmp_path / "usage.jsonl")) as f:
+        recs = [json.loads(ln) for ln in f]
+    assert len(recs) == len(reqs)
+    assert {r["rid"] for r in recs} == set(rids)
+    assert sum(r["prompt_tokens"] for r in recs) == totals["prompt_tokens"]
+    assert (sum(r["generated_tokens"] for r in recs)
+            == totals["generated_tokens"])
+    assert all(r["outcome"] == "ok" for r in recs)
+    by_rid = {r["rid"]: r for r in recs}
+    for rid, ref in zip(rids, solos):
+        assert by_rid[rid]["generated_tokens"] == len(ref)
+
+
+def test_usage_meter_stamps_cancel_and_shed(lm, rng):
+    """Queue-side cancels meter zero residency; row-side cancels meter
+    the tokens actually emitted; shed requests stamp their outcome."""
+    import time as _time
+
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    p = rng.integers(1, 90, 4).astype(np.int64)
+    active = srv.submit(p, 20)
+    queued = srv.submit(p, 6)
+    doomed = srv.submit(p, 5, priority="batch", ttft_deadline_ms=1.0)
+    srv.step()                        # admits `active`
+    srv.cancel(queued)                # still queued: zero tokens
+    _time.sleep(0.01)                 # `doomed`'s deadline expires in queue
+    srv.cancel(active)                # mid-flight: emitted tokens metered
+    srv.run()                         # the freed row dequeues -> shed fires
+    totals = srv.usage.totals()
+    assert totals["requests"] == 3
+    reg = metrics.default_registry()
+    assert reg.get("usage/requests/cancelled").value >= 2
+    assert reg.get("usage/requests/shed").value >= 1

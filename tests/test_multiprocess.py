@@ -373,6 +373,7 @@ _REPLICA_CHILD = textwrap.dedent(
 
     rid, port_file = int(sys.argv[1]), sys.argv[2]
     push_url = sys.argv[3] or None   # "" -> no metrics pusher
+    model_dir = sys.argv[4] if len(sys.argv) > 4 else None
     model = gpt_tiny_test()
     params = model.init(
         jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
@@ -383,7 +384,7 @@ _REPLICA_CHILD = textwrap.dedent(
         b.submit(rng.integers(1, 90, ln), 6)
     b.run()
     srv = ReplicaServer(b, replica_id=rid, push_url=push_url,
-                        push_interval=0.3).start()
+                        push_interval=0.3, model_dir=model_dir).start()
     with open(port_file + ".tmp", "w") as f:
         f.write(str(srv.port))
     os.replace(port_file + ".tmp", port_file)
@@ -456,6 +457,7 @@ def test_killed_replica_drains_to_survivor(tmp_path):
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("XLA_FLAGS", None)   # children run 1 device, not 8
             env["TFDE_TRACE"] = "on"     # replicas record their rings
+            env["TFDE_USAGE_LOG"] = "on"  # journal per-request usage
             env["PYTHONPATH"] = os.pathsep.join(
                 [os.path.dirname(os.path.dirname(__file__))]
                 + env.get("PYTHONPATH", "").split(os.pathsep)
@@ -463,7 +465,7 @@ def test_killed_replica_drains_to_survivor(tmp_path):
             procs.append(
                 subprocess.Popen(
                     [sys.executable, str(script), str(i), port_files[i],
-                     push],
+                     push, str(tmp_path / f"rep{i}")],
                     env=env, stdout=subprocess.PIPE,
                     stderr=subprocess.PIPE, text=True,
                 )
@@ -549,6 +551,31 @@ def test_killed_replica_drains_to_survivor(tmp_path):
             router.url + "/replicas", timeout=5).read())
         assert rep_body["slo"]["ttft_requests"] >= 3
         assert rep_body["slo"]["ttft_attainment"] is not None
+
+        # capacity rode the same pushes: /replicas carries the per-
+        # replica kv table and the chief rollup folds the fleet's
+        # waste/headroom — the survivor's slab is visible end to end
+        assert rep_body["kv"]["1"]["allocated_bytes"] > 0
+        assert rep_body["kv"]["1"]["headroom_rows"] is not None
+        roll = agg.rollup()
+        assert "kv_waste_frac" in roll and 0.0 <= roll["kv_waste_frac"] <= 1.0
+        assert roll["kv_headroom_rows"] >= 0
+
+        # both replicas journaled per-request usage to their model_dir —
+        # replica 0's records survived the SIGKILL because the log
+        # flushes at finish, and the warmup requests (pre-arm) are
+        # absent, so each file holds exactly its two served requests
+        for i in (0, 1):
+            uf = os.path.join(str(tmp_path / f"rep{i}"),
+                              "metrics", "usage_0.jsonl")
+            assert os.path.exists(uf), f"replica {i} left no usage journal"
+            with open(uf) as f:
+                recs = [json.loads(ln) for ln in f]
+            assert len(recs) == 2, (i, recs)
+            assert all(r["prompt_tokens"] == 5 for r in recs)
+            assert all(r["generated_tokens"] == 6 for r in recs)
+            assert all(r["outcome"] == "ok" for r in recs)
+            assert all(r["kv_token_seconds"] > 0 for r in recs)
 
         # host-up flips once the dead replica's pushes go stale
         body = scrape()

@@ -679,3 +679,85 @@ def test_unknown_priority_rejected_loudly(lm, rng):
     with pytest.raises(ValueError, match="priority"):
         srv.submit(p, 4, priority="urgent")
     assert len(srv._queue) == 0
+
+
+# --------------------------------------------------------------------------
+# KV-headroom admission: reject on memory before queue depth collapses
+# --------------------------------------------------------------------------
+
+def test_kv_headroom_gate_rejects_with_kv_payload(lm, rng):
+    """min_headroom_rows armed: once the slab's free rows fall below the
+    floor the submit is rejected as kv_headroom, the QueueFull carries
+    the ledger's kv block, and Retry-After falls back to the drain-rate
+    estimate over the OUTSTANDING tokens (the queue is empty — queued
+    backlog alone would undersell the wait). Draining restores
+    admission; everything admitted still matches solo."""
+    from tfde_tpu.inference.admission import (
+        AdmissionController, QueueFull, MIN_RETRY_AFTER_S,
+    )
+
+    model, params = lm
+    srv = ContinuousBatcher(
+        model, params, batch_size=2, max_len=48,
+        admission_ctl=AdmissionController(min_headroom_rows=2),
+    )
+    p = rng.integers(1, 90, 4).astype(np.int64)
+    admitted = srv.submit(p, 6)        # 2 free rows == floor: in
+    srv.step()                         # admitted to a row: 1 free < 2
+    with pytest.raises(QueueFull) as ei:
+        srv.submit(p, 6)
+    e = ei.value
+    assert e.reason == "kv_headroom"
+    assert e.kv is not None
+    assert e.kv["headroom_rows"] == 1 and e.kv["rows_active"] == 1
+    assert e.kv["used_bytes"] > 0
+    body = e.as_json()
+    assert body["reason"] == "kv_headroom"
+    assert body["kv"]["headroom_rows"] == 1
+    assert e.retry_after_s >= MIN_RETRY_AFTER_S
+    done = dict(srv.run())
+    np.testing.assert_array_equal(done[admitted],
+                                  _solo(model, params, p, 6))
+    rid = srv.submit(p, 4)             # slab drained: admitted again
+    np.testing.assert_array_equal(dict(srv.run())[rid],
+                                  _solo(model, params, p, 4))
+
+
+def test_kv_headroom_env_knob_and_low_budget_drill(lm, rng, monkeypatch):
+    """The forced low-budget drill: TFDE_ADMIT_KV_HEADROOM armed via env
+    with a TFDE_CAPACITY_BUDGET_BYTES far below one row's cost — every
+    submit 429s with the kv payload showing zero headroom BEFORE any
+    request can stall waiting on a row that memory could never back."""
+    from tfde_tpu.inference.admission import QueueFull
+
+    monkeypatch.setenv("TFDE_ADMIT_KV_HEADROOM", "1")
+    monkeypatch.setenv("TFDE_CAPACITY_BUDGET_BYTES", "64")
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48)
+    assert srv._cap_model.budget_bytes == 64
+    assert srv._ledger.row_bytes > 64   # the budget can't back one row
+    p = rng.integers(1, 90, 4).astype(np.int64)
+    with pytest.raises(QueueFull) as ei:
+        srv.submit(p, 6)               # rejected with all rows still free
+    e = ei.value
+    assert e.reason == "kv_headroom"
+    assert e.kv["headroom_rows"] == 0 and e.kv["rows_free"] == 2
+    assert len(srv._queue) == 0 and srv.idle
+
+
+def test_kv_headroom_default_off_admits_identically(lm, rng, monkeypatch):
+    """Default-off parity: with the knob unset the gate never consults
+    the ledger, and a full batch plus a deep queue admits exactly as
+    before this PR — memory pressure alone must not reject."""
+    monkeypatch.delenv("TFDE_ADMIT_KV_HEADROOM", raising=False)
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    assert srv._admission.min_headroom_rows == 0
+    assert not srv._admission.enabled
+    p = rng.integers(1, 90, 4).astype(np.int64)
+    rids = [srv.submit(p, 4) for _ in range(4)]  # 1 row, 3 queued: all in
+    done = dict(srv.run())
+    assert set(done) == set(rids)
+    for rid in rids:
+        np.testing.assert_array_equal(done[rid],
+                                      _solo(model, params, p, 4))

@@ -79,11 +79,15 @@ class QueueFull(RuntimeError):
     """
 
     def __init__(self, reason: str, queue_depth: int, queued_tokens: int,
-                 retry_after_s: float):
+                 retry_after_s: float, kv: Optional[dict] = None):
         self.reason = str(reason)
         self.queue_depth = int(queue_depth)
         self.queued_tokens = int(queued_tokens)
         self.retry_after_s = float(retry_after_s)
+        #: KV-capacity snapshot (the batcher's kv_stats()) when the
+        #: memory gate was consulted — tells a rejected client WHICH
+        #: resource is scarce, not just that one is
+        self.kv = dict(kv) if kv else None
         super().__init__(
             f"queue full ({self.reason}): depth={self.queue_depth}, "
             f"queued_tokens={self.queued_tokens}, retry in "
@@ -92,13 +96,16 @@ class QueueFull(RuntimeError):
 
     def as_json(self) -> dict:
         """The 429 response body schema (pinned by tests/test_router.py)."""
-        return {
+        out = {
             "error": "queue full",
             "reason": self.reason,
             "queue_depth": self.queue_depth,
             "queued_tokens": self.queued_tokens,
             "retry_after_s": round(self.retry_after_s, 3),
         }
+        if self.kv is not None:
+            out["kv"] = self.kv
+        return out
 
 
 # -- forced overload (fault injection) ----------------------------------------
@@ -144,7 +151,8 @@ class AdmissionController:
 
     def __init__(self, max_queue: Optional[int] = None,
                  max_queued_tokens: Optional[int] = None,
-                 ttft_deadline_ms: Optional[float] = None):
+                 ttft_deadline_ms: Optional[float] = None,
+                 min_headroom_rows: Optional[int] = None):
         if max_queue is None:
             max_queue = knobs.env_int("TFDE_ADMIT_MAX_QUEUE", 0)
         if max_queued_tokens is None:
@@ -153,8 +161,14 @@ class AdmissionController:
         if ttft_deadline_ms is None:
             ttft_deadline_ms = knobs.env_float(
                 "TFDE_ADMIT_TTFT_DEADLINE_MS", 0.0)
+        if min_headroom_rows is None:
+            min_headroom_rows = knobs.env_int("TFDE_ADMIT_KV_HEADROOM", 0)
         self.max_queue = int(max_queue or 0)
         self.max_queued_tokens = int(max_queued_tokens or 0)
+        #: memory gate: reject while the capacity model's headroom_rows
+        #: is below this floor (0 = off) — admission fails on *memory*
+        #: before the queue-depth proxy ever collapses
+        self.min_headroom_rows = int(min_headroom_rows or 0)
         #: default TTFT deadline applied to every request that does not
         #: bring its own (0 = no deadline shedding)
         self.ttft_deadline_ms = float(ttft_deadline_ms or 0.0)
@@ -163,7 +177,8 @@ class AdmissionController:
 
     @property
     def enabled(self) -> bool:
-        return bool(self.max_queue or self.max_queued_tokens)
+        return bool(self.max_queue or self.max_queued_tokens
+                    or self.min_headroom_rows)
 
     # -- drain rate ---------------------------------------------------------
     def note_drain(self, n_tokens: int, dt_s: float,
@@ -191,10 +206,13 @@ class AdmissionController:
 
     # -- the gate -----------------------------------------------------------
     def would_reject(self, queue_depth: int, queued_tokens: int,
-                     budget: int = 1) -> Optional[str]:
+                     budget: int = 1,
+                     headroom_rows: Optional[int] = None) -> Optional[str]:
         """The reason a request with `budget` new tokens would be
         rejected right now, or None when it would be admitted — the
-        /load snapshot's `saturated` signal and `check`'s core."""
+        /load snapshot's `saturated` signal and `check`'s core.
+        `headroom_rows` is the capacity model's current estimate (None =
+        no ledger wired, memory gate silently inert)."""
         if overload_active():
             return "forced_overload"
         if self.max_queue and queue_depth >= self.max_queue:
@@ -202,13 +220,27 @@ class AdmissionController:
         if self.max_queued_tokens and (
                 queued_tokens + budget > self.max_queued_tokens):
             return "queued_tokens"
+        if (self.min_headroom_rows and headroom_rows is not None
+                and headroom_rows < self.min_headroom_rows):
+            return "kv_headroom"
         return None
 
     def check(self, queue_depth: int, queued_tokens: int,
-              budget: int) -> None:
+              budget: int, headroom_rows: Optional[int] = None,
+              kv: Optional[dict] = None,
+              drain_tokens: Optional[int] = None) -> None:
         """Admit or raise QueueFull. Called by the batcher before
-        enqueue, under its external lock."""
-        reason = self.would_reject(queue_depth, queued_tokens, budget)
+        enqueue, under its external lock. `kv` (the batcher's capacity
+        snapshot) rides on the rejection; `drain_tokens` is the
+        outstanding decode backlog, the Retry-After basis when the
+        memory gate — not queue depth — is binding (headroom frees up
+        as ACTIVE rows finish, which the queued backlog alone can't
+        estimate: the queue may well be empty)."""
+        reason = self.would_reject(queue_depth, queued_tokens, budget,
+                                   headroom_rows=headroom_rows)
         if reason is not None:
+            backlog = queued_tokens + budget
+            if reason == "kv_headroom" and drain_tokens:
+                backlog = max(backlog, int(drain_tokens))
             raise QueueFull(reason, queue_depth, queued_tokens,
-                            self.retry_after_s(queued_tokens + budget))
+                            self.retry_after_s(backlog), kv=kv)

@@ -294,6 +294,26 @@ class PrefixCache:
         g("serving/prefix_bytes_saved").set(self._bytes_saved)
         g("serving/prefix_segments").set(self._segments)
         g("serving/prefix_evictions").set(self._evictions)
+        # trie-side KV residency (observability/capacity.py's second
+        # slab): how much of the trie the CURRENT op actually touched
+        # (referenced) and how much eviction could reclaim right now
+        # (childless segments outside the op stamp). One O(segments)
+        # walk per insert/lookup — the same cost class as _evict.
+        ref = evictable = 0
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node.op == self._op:
+                ref += node.nbytes
+            elif not node.children:
+                evictable += node.nbytes
+        g("kv/trie_blocks").set(self._segments)
+        g("kv/trie_bytes").set(self._bytes)
+        g("kv/trie_referenced_frac").set(
+            ref / self._bytes if self._bytes else 0.0
+        )
+        g("kv/trie_evictable_bytes").set(evictable)
 
 
 def resolve(spec) -> Optional[PrefixCache]:

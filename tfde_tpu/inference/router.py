@@ -159,6 +159,9 @@ class ReplicaServer:
         if model_dir is not None:
             flightrec.arm(model_dir)
             _trace.arm(model_dir)
+        # usage metering JSONL (TFDE_USAGE_LOG=on) anchors to the same
+        # model_dir as the flight ring and trace dumps
+        batcher.arm_usage_log(model_dir)
         # label this process's trace events (a lone replica per process
         # in the cluster deployment — the stitched waterfall's row name)
         _trace.set_process(f"replica{self.replica_id}")
@@ -303,6 +306,17 @@ class ReplicaServer:
             b = self.batcher
             depth = len(b._queue)
             queued_tokens = b.queued_tokens
+            kv = b.kv_stats()
+            reason = b.admission.would_reject(
+                depth, queued_tokens,
+                headroom_rows=kv.get("headroom_rows"))
+            # Retry-After basis: the queued backlog — unless the MEMORY
+            # gate is what binds, where headroom frees as ACTIVE rows
+            # finish, so the outstanding decode backlog is the honest
+            # drain estimate (the queue may well be empty)
+            backlog = queued_tokens
+            if reason == "kv_headroom":
+                backlog = max(backlog, b.outstanding_tokens)
             return {
                 "replica": self.replica_id,
                 "role": b.role,
@@ -312,9 +326,9 @@ class ReplicaServer:
                 "queued_tokens": queued_tokens,
                 "free_rows": b.free_rows,
                 "drain_rate_tps": b.admission.drain_rate_tps,
-                "retry_after_s": b.admission.retry_after_s(queued_tokens),
-                "saturated": b.admission.would_reject(
-                    depth, queued_tokens) is not None,
+                "retry_after_s": b.admission.retry_after_s(backlog),
+                "saturated": reason is not None,
+                "kv": kv,
             }
 
     # -- internals ----------------------------------------------------------
@@ -518,7 +532,8 @@ class Router:
                         self, 200,
                         {"replicas": router.table(),
                          "slo": router.slo.summary(),
-                         "mem": router.mem_table()},
+                         "mem": router.mem_table(),
+                         "kv": router.kv_table()},
                     )
                 elif self.path.startswith("/trace/"):
                     tid = self.path[len("/trace/"):]
@@ -722,6 +737,39 @@ class Router:
                     if name.startswith("compile/")
                     and name.endswith("/misses")),
                 "compile_seconds": flat.get("compile/seconds_total"),
+            }
+        return out
+
+    def kv_table(self) -> dict:
+        """Per-replica KV occupancy/headroom snapshot from the pushed
+        metrics (the kv block on /replicas and obs_dump --capacity):
+        how full each replica's dense slab is, what pad-ladder waste it
+        carries, and how many more rows fit — the fleet's capacity
+        picture without scraping each replica."""
+        if self._agg is None:
+            return {}
+        out = {}
+        for hid, flat in self._agg.host_metrics(("kv/",)).items():
+            if "kv/allocated_bytes" not in flat:
+                continue
+            # worst pad-ladder cell: the bucket whose cumulative pad
+            # waste is largest — the cells paged-KV would reclaim first
+            pre = "kv/pad_waste_tokens/bucket_"
+            buckets = {int(name[len(pre):]): v for name, v in flat.items()
+                       if name.startswith(pre)}
+            top = max(buckets.items(), key=lambda kv: kv[1], default=None)
+            out[str(hid)] = {
+                "allocated_bytes": flat.get("kv/allocated_bytes"),
+                "used_bytes": flat.get("kv/used_bytes"),
+                "waste_frac": flat.get("kv/waste_frac"),
+                "rows_active": flat.get("kv/rows_active"),
+                "rows_free": flat.get("kv/rows_free"),
+                "headroom_rows": flat.get("kv/headroom_rows"),
+                "headroom_tokens": flat.get("kv/headroom_tokens"),
+                "trie_bytes": flat.get("kv/trie_bytes"),
+                "pad_waste_tokens": flat.get("kv/pad_waste_tokens"),
+                "top_waste_bucket": top[0] if top else None,
+                "top_waste_bucket_tokens": top[1] if top else None,
             }
         return out
 

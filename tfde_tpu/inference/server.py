@@ -84,6 +84,7 @@ from tfde_tpu.inference.prefix_cache import (
 )
 from tfde_tpu.inference.speculative import _set_index_counters
 from tfde_tpu.analysis import hlolint as _hlolint
+from tfde_tpu.observability import capacity as _capacity
 from tfde_tpu.observability import memwatch as _memwatch
 from tfde_tpu.observability import metrics
 from tfde_tpu.observability import recompile as _recompile
@@ -514,6 +515,14 @@ class _BatcherBase:
         # pad-ladder bucket, not per wave)
         self._rc_tag = next(_BATCHER_TAGS)
         self._mem_programs: set = set()
+        # KV-capacity observability (observability/capacity.py): the
+        # ledger/headroom pair is built by the subclass once its slab
+        # exists (`_init_capacity`); the usage meter is per-batcher and
+        # live immediately (its JSONL log arms lazily via TFDE_USAGE_LOG
+        # or the owning ReplicaServer's model_dir)
+        self._ledger = None
+        self._cap_model = None
+        self._usage = _capacity.UsageMeter()
         # serving-side bounded capture: armed via attach_profiler /
         # POST /profile, driven once per step from the decode-round hook
         self._profiler = None
@@ -565,6 +574,38 @@ class _BatcherBase:
     def admission(self) -> "_admission.AdmissionController":
         return self._admission
 
+    @property
+    def usage(self) -> "_capacity.UsageMeter":
+        return self._usage
+
+    def arm_usage_log(self, model_dir=None) -> None:
+        """Late-bind the usage JSONL log (TFDE_USAGE_LOG=on needs a
+        model_dir to anchor the file; the ReplicaServer calls this with
+        its own)."""
+        self._usage.arm(model_dir)
+
+    def _init_capacity(self, cache, cells_per_row: Optional[int] = None
+                       ) -> None:
+        """Build the KV occupancy ledger + headroom model from the
+        freshly-initialized dense slab (subclass constructors call this
+        once the cache exists). `cells_per_row` defaults to max_len;
+        the speculative batcher's slab carries draft slack beyond it."""
+        cells = int(cells_per_row if cells_per_row is not None
+                    else self._max_len)
+        self._ledger = _capacity.CapacityLedger.from_cache(
+            cache, self._b, cells)
+        self._cap_model = _capacity.CapacityModel(self._ledger)
+
+    def kv_stats(self) -> dict:
+        """Current KV occupancy + headroom (the /load and 429 `kv`
+        block); refreshes the kv/* gauges as a side effect. Empty dict
+        until a subclass wired its slab."""
+        if self._ledger is None:
+            return {}
+        s = self._ledger.observe(self._committed, self._req)
+        s.update(self._cap_model.headroom(s))
+        return s
+
     def was_shed(self, rid: int) -> bool:
         """True exactly once for a request that was deadline-shed at
         dequeue — the HTTP layer reads this to turn the empty completion
@@ -594,8 +635,7 @@ class _BatcherBase:
             )
         prompt = self._check_request(prompt, max_new_tokens)
         pr = _admission.validate_priority(priority)
-        self._admission.check(len(self._queue), self.queued_tokens,
-                              int(max_new_tokens))
+        self._admission_check(int(max_new_tokens))
         rid = self._enqueue(prompt, int(max_new_tokens), None, trace,
                             priority=pr, ttft_deadline_ms=ttft_deadline_ms)
         return rid
@@ -615,11 +655,27 @@ class _BatcherBase:
             raise RuntimeError("prefill-only replica cannot decode")
         prompt = self._check_request(primed.prompt, primed.max_new_tokens)
         pr = _admission.validate_priority(priority)
-        self._admission.check(len(self._queue), self.queued_tokens,
-                              int(primed.max_new_tokens))
+        self._admission_check(int(primed.max_new_tokens))
         return self._enqueue(prompt, int(primed.max_new_tokens), primed,
                              trace, priority=pr,
                              ttft_deadline_ms=ttft_deadline_ms)
+
+    def _admission_check(self, budget: int) -> None:
+        """One admission gate for both submit paths: queue caps plus —
+        when a ledger is wired and TFDE_ADMIT_KV_HEADROOM set — the
+        memory gate, with the kv snapshot riding any rejection and the
+        outstanding decode backlog as the Retry-After basis when
+        headroom (not queue depth) binds."""
+        if (self._ledger is not None
+                and self._admission.min_headroom_rows):
+            kv = self.kv_stats()
+            self._admission.check(
+                len(self._queue), self.queued_tokens, budget,
+                headroom_rows=kv.get("headroom_rows"), kv=kv,
+                drain_tokens=self.outstanding_tokens)
+        else:
+            self._admission.check(len(self._queue), self.queued_tokens,
+                                  budget)
 
     def enable_progress(self) -> None:
         """Track per-request incremental tokens for `take_progress` (the
@@ -660,9 +716,12 @@ class _BatcherBase:
         if tid is not None:
             _trace.event("serve/cancelled", trace=tid, rid=rid)
         if self._queue.remove_rid(rid):
+            self._usage.finish(rid, 0, outcome="cancelled")
             return True
         for r in range(self._b):
             if self._req[r] == rid:
+                self._usage.finish(rid, len(self._out[r]),
+                                   outcome="cancelled")
                 self._req[r] = None
                 self._out[r] = []
                 self._budget[r] = 0
@@ -702,6 +761,7 @@ class _BatcherBase:
         now = time.perf_counter()
         self._submitted_at[rid] = now
         self._priority[rid] = priority
+        self._usage.begin(rid, int(prompt.size), priority)
         dl = (float(ttft_deadline_ms) if ttft_deadline_ms is not None
               else self._admission.ttft_deadline_ms)
         if dl and dl > 0:
@@ -742,6 +802,9 @@ class _BatcherBase:
         reg.gauge(f"{self._metrics_prefix}/drain_rate_tps").set(
             self._admission.drain_rate_tps
         )
+        # occupancy + headroom ride every stats publication (including
+        # idle steps), so the kv/* gauges track the slab per round
+        self.kv_stats()
 
     # -- hooks --------------------------------------------------------------
     def _validate_submit(self, prompt: np.ndarray,
@@ -789,6 +852,7 @@ class _BatcherBase:
                                       and t == self._eos))
             self._priority.pop(rid, None)
             self._deadline_at.pop(rid, None)
+            self._usage.finish(rid, n, outcome="ok")
             done = (rid, np.asarray(self._out[r], np.int32))
             self._req[r] = None
             self._out[r] = []
@@ -936,12 +1000,24 @@ class _BatcherBase:
                             key=list(key) if isinstance(key, tuple)
                             else int(key),
                         )
+                # pad-ladder accounting: the prefill program computed/
+                # wrote `alloc` cells per row (the group's bucket; for
+                # warm groups only the SUFFIX bucket — the prefix K/V
+                # landed unpadded), of which each request's true token
+                # count is real — the rest is the transient pad waste
+                # the ledger sizes paged-KV's win by
+                alloc = key[1] if kind == "warm" else int(key)
                 for i, (rid, prompt, budget, _pr, _x) in enumerate(group):
                     r = rows[i]
                     self._req[r] = rid
                     self._out[r] = []
                     self._budget[r] = budget
                     self._committed[r] = prompt.size
+                    if self._ledger is not None:
+                        used = (prompt.size - key[0] if kind == "warm"
+                                else prompt.size)
+                        self._ledger.note_admission(kind, alloc, int(used))
+                    self._usage.admitted(rid)
                     t0 = self._submitted_at.pop(rid, None)
                     self._first_at[rid] = now
                     if t0 is not None:
@@ -991,6 +1067,7 @@ class _BatcherBase:
         reg.counter("serving/shed_expired").incr()
         reg.counter(f"serving/shed_{pr}").incr()
         reg.counter("serving/shed_tokens").incr(int(budget))
+        self._usage.finish(rid, 0, outcome="shed")
         tid = self._trace_ids.pop(rid, None)
         if tid is not None:
             _trace.event("serve/shed", trace=tid, rid=rid, priority=pr,
@@ -1126,6 +1203,7 @@ class ContinuousBatcher(_BatcherBase):
         # device-resident loop state (tok/idx/budget/done); rebuilt from
         # host bookkeeping whenever admission desyncs it
         self._dev = None
+        self._init_capacity(self._cache)
 
     # -- public -------------------------------------------------------------
     def stats(self) -> dict:
@@ -1621,6 +1699,10 @@ class SpeculativeContinuousBatcher(_BatcherBase):
         self._tgt_cache = init_cache(model, batch_size, self._cache_len)
         self._drf_cache = init_cache(draft_model, batch_size,
                                      self._cache_len)
+        # the ledger tracks the TARGET slab (the draft cache is a cost
+        # of speculation, not serving capacity)
+        self._init_capacity(self._tgt_cache,
+                            cells_per_row=self._cache_len)
         self._tgt_templates: dict = {}
         self._drf_templates: dict = {}
         self._round_tokens = 0   # tokens produced by speculative rounds

@@ -258,6 +258,29 @@ _register(
          "Fast-window TTFT burn rate at which the router also sheds "
          "batch traffic; interactive is never brownout-shed.",
          "inference/router.py"),
+    Knob("TFDE_ADMIT_KV_HEADROOM", "int", 0, (),
+         "Minimum KV headroom, in rows, admission requires: submit() "
+         "answers QueueFull/429 with a kv payload when the capacity "
+         "model's headroom_rows falls below it (0 = memory gate off).",
+         "inference/admission.py"),
+    Knob("TFDE_USAGE_LOG", "spec", None, ("off", "on", "<path>"),
+         "Per-request usage metering JSONL: off (default), on (write "
+         "model_dir/metrics/usage_<host>.jsonl on each ReplicaServer), "
+         "or an explicit file path.",
+         "observability/capacity.py"),
+    Knob("TFDE_CAPACITY_", "spec", None, (),
+         "KV-capacity observability family prefix (see members below).",
+         "observability/capacity.py", prefix=True),
+    Knob("TFDE_CAPACITY_BUDGET_BYTES", "int", 0, (),
+         "KV memory budget the headroom model folds against (0 = derive "
+         "capacity from the dense slab itself: headroom is the free "
+         "rows and their cells).",
+         "observability/capacity.py"),
+    Knob("TFDE_CAPACITY_USAGE_LOG_BYTES", "int", 8388608, (),
+         "Byte bound on one usage JSONL log; an append that would "
+         "overflow it drops the oldest records so the newest half of "
+         "the bound survives.",
+         "observability/capacity.py"),
     # --- static analysis / gates -----------------------------------------
     Knob("TFDE_HLOLINT", "flag", False, (),
          "Arm the lowered-program linter's collection seam: programs "

@@ -384,6 +384,27 @@ class ClusterAggregator:
                     )
                 except Exception:
                     log.exception("straggler profile trigger failed")
+
+        # fleet KV capacity (observability/capacity.py): waste is the
+        # allocation-weighted mean — a big idle replica's waste should
+        # dominate a small busy one's — and headroom is the plain sum of
+        # rows the fleet could still admit
+        alloc = waste_weighted = headroom = 0.0
+        seen_kv = False
+        for h in live.values():
+            a = h.flat.get("kv/allocated_bytes")
+            if a is None:
+                continue
+            seen_kv = True
+            alloc += a
+            waste_weighted += a * h.flat.get("kv/waste_frac", 0.0)
+            headroom += h.flat.get("kv/headroom_rows", 0.0)
+        if seen_kv:
+            waste = waste_weighted / alloc if alloc else 0.0
+            g("cluster/kv_waste_frac").set(waste)
+            g("cluster/kv_headroom_rows").set(headroom)
+            out["kv_waste_frac"] = waste
+            out["kv_headroom_rows"] = headroom
         return out
 
     # -- exposition ----------------------------------------------------------

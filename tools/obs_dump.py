@@ -30,6 +30,11 @@ surfaces, composable in one invocation:
   memwatch ledger, per-site jit-cache hit/miss counters from the
   recompile sentinel, the live-device-buffer trend across snapshots,
   and the top-K largest buffers from ``debug/memwatch.json``.
+- ``python tools/obs_dump.py --capacity [--router URL | <model_dir>]``
+  — the KV-capacity view (WORKFLOWS.md §20): per-replica slab
+  occupancy, pad-ladder waste, and headroom from the capacity ledger,
+  the top-waste-bucket callout (the cells paged-KV would reclaim), and
+  per-host ``metrics/usage_*.jsonl`` summaries.
 - ``--tail N`` — how many trailing flight events to print (default 10).
 
 Reads only; stdlib only — safe to run against a production model_dir
@@ -57,7 +62,8 @@ _HEADLINE_KINDS = (
 #: metric-name prefixes worth printing from the last JSONL snapshot
 _SNAPSHOT_PREFIXES = ("train/", "goodput/", "cluster/", "resilience/",
                       "sentry/", "checkpoint/", "serving/", "slo/",
-                      "router/", "mem/", "compile/", "opt/")
+                      "router/", "mem/", "compile/", "opt/", "kv/",
+                      "usage/")
 
 _LABELLED = re.compile(r'^(\w+)\{host="(\d+)"\}\s+(\S+)$')
 
@@ -309,6 +315,119 @@ def dump_mem(model_dir: str) -> int:
     return 0
 
 
+def _capacity_row(hid, kv: dict) -> str:
+    def _mb(v):
+        return f"{v / 1e6:.1f}" if v is not None else "-"
+
+    def _i(v):
+        return str(int(v)) if v is not None else "-"
+
+    wf = kv.get("waste_frac")
+    return (f"  {str(hid):>7} {_mb(kv.get('allocated_bytes')):>9} "
+            f"{_mb(kv.get('used_bytes')):>9} "
+            f"{(f'{wf:.3f}' if wf is not None else '-'):>7} "
+            f"{_i(kv.get('rows_active')):>6} {_i(kv.get('rows_free')):>5} "
+            f"{_i(kv.get('headroom_rows')):>9} "
+            f"{_i(kv.get('headroom_tokens')):>10} "
+            f"{_mb(kv.get('trie_bytes')):>8}")
+
+
+_CAPACITY_HEADER = (f"  {'host':>7} {'alloc_mb':>9} {'used_mb':>9} "
+                    f"{'waste':>7} {'active':>6} {'free':>5} "
+                    f"{'hd_rows':>9} {'hd_tokens':>10} {'trie_mb':>8}")
+
+
+def _capacity_callout(per_bucket: dict) -> None:
+    """Name the worst pad-ladder cell: the bucket whose cumulative pad
+    waste is largest — the dense cells a paged-KV slab would reclaim."""
+    if not per_bucket:
+        return
+    top = max(per_bucket, key=per_bucket.get)
+    total = sum(per_bucket.values())
+    if total <= 0:
+        return
+    print(f"  top waste bucket: {top} ({per_bucket[top]:.0f} of "
+          f"{total:.0f} pad-waste tokens, "
+          f"{per_bucket[top] / total:.0%}) — the pad-ladder cells a "
+          f"paged-KV slab reclaims (ROADMAP item 1)")
+
+
+def dump_capacity(model_dir=None, router_url=None) -> int:
+    """``--capacity``: the KV occupancy / pad-waste / headroom view —
+    per replica from a LIVE router's /replicas kv table, or from the
+    last metrics snapshot(s) under a model_dir (WORKFLOWS.md §20)."""
+    if router_url:
+        target = router_url.rstrip("/")
+        if not target.endswith("/replicas"):
+            target += "/replicas"
+        body = json.loads(urllib.request.urlopen(target, timeout=5).read())
+        kv = body.get("kv") or {}
+        print(f"== capacity: {target} ({len(kv)} replicas reporting)")
+        if not kv:
+            print("  (no kv/* metrics pushed yet — are the replicas "
+                  "constructed with push_url and past their first step?)")
+            return 1
+        print(_CAPACITY_HEADER)
+        for hid in sorted(kv):
+            print(_capacity_row(hid, kv[hid]))
+        per_bucket = {
+            str(h["top_waste_bucket"]): h.get("top_waste_bucket_tokens", 0)
+            for h in kv.values() if h.get("top_waste_bucket") is not None
+        }
+        _capacity_callout(per_bucket)
+        return 0
+
+    logs = sorted(glob.glob(os.path.join(model_dir, "metrics", "*.jsonl")))
+    logs = [p for p in logs
+            if not os.path.basename(p).startswith("usage_")]
+    shown = 0
+    print(f"== capacity: {model_dir}")
+    print(_CAPACITY_HEADER)
+    per_bucket: dict = collections.Counter()
+    for p in logs:
+        rows = _load_jsonl(p)
+        if not rows:
+            continue
+        flat = rows[-1].get("metrics", {})
+        if "kv/allocated_bytes" not in flat:
+            continue
+        shown += 1
+        host = os.path.basename(p).rsplit(".", 1)[0]
+        if host.startswith("metrics-"):
+            host = host[len("metrics-"):]
+        print(_capacity_row(host, {
+            "allocated_bytes": flat.get("kv/allocated_bytes"),
+            "used_bytes": flat.get("kv/used_bytes"),
+            "waste_frac": flat.get("kv/waste_frac"),
+            "rows_active": flat.get("kv/rows_active"),
+            "rows_free": flat.get("kv/rows_free"),
+            "headroom_rows": flat.get("kv/headroom_rows"),
+            "headroom_tokens": flat.get("kv/headroom_tokens"),
+            "trie_bytes": flat.get("kv/trie_bytes"),
+        }))
+        pre = "kv/pad_waste_tokens/bucket_"
+        for name, v in flat.items():
+            if name.startswith(pre):
+                per_bucket[name[len(pre):]] += v
+    if not shown:
+        print(f"  (no kv/* metrics in any snapshot under "
+              f"{model_dir}/metrics — serving run without the ledger?)")
+    else:
+        _capacity_callout(dict(per_bucket))
+
+    usage = sorted(glob.glob(
+        os.path.join(model_dir, "metrics", "usage_*.jsonl")))
+    for p in usage:
+        recs = _load_jsonl(p)
+        prompt = sum(r.get("prompt_tokens", 0) for r in recs)
+        gen = sum(r.get("generated_tokens", 0) for r in recs)
+        res = sum(r.get("kv_token_seconds", 0.0) for r in recs)
+        print(f"  usage {os.path.basename(p)}: {len(recs)} requests, "
+              f"{prompt} prompt + {gen} generated tokens, "
+              f"{res:.1f} KV token-seconds")
+    return 0 if (shown or usage) else 1
+
+
 def _fmt_trace_event(e: dict, t0: float) -> str:
     extra = {k: v for k, v in e.items()
              if k not in ("ts", "dur", "name", "proc", "pid", "trace",
@@ -419,6 +538,11 @@ def main(argv=None) -> int:
                     help="list the triggered-capture index under "
                          "<model_dir>/debug/profiles: trigger reason, "
                          "step/round window, in-flight trace ids")
+    ap.add_argument("--capacity", action="store_true",
+                    help="KV occupancy/waste/headroom table per replica "
+                         "(live via --router, or from a model_dir's last "
+                         "metrics snapshots) + top-waste-bucket callout "
+                         "and usage-log summaries")
     args = ap.parse_args(argv)
     if not args.model_dir and not args.url and not args.router:
         ap.error("give a model_dir, --url, --router, or a combination")
@@ -428,7 +552,13 @@ def main(argv=None) -> int:
         ap.error("--mem needs a model_dir")
     if args.profiles and not args.model_dir:
         ap.error("--profiles needs a model_dir")
+    if args.capacity and not (args.router or args.model_dir):
+        ap.error("--capacity needs --router (live) or a model_dir "
+                 "(snapshots)")
 
+    if args.capacity:
+        return dump_capacity(model_dir=args.model_dir,
+                             router_url=args.router)
     if args.profiles:
         return dump_profiles(args.model_dir)
     if args.mem:

@@ -118,6 +118,22 @@ LOCKED_CLASSES: Dict[Tuple[str, str], LockSpec] = {
         # from a handler thread races the step loop
         guarded_attrs=("batcher",),
     ),
+    # KV-capacity observability (PR 15): written from the batcher's step
+    # loop under ReplicaServer.lock but READ from handler threads
+    # (/load's kv block) and test threads, so each carries its own lock
+    ("tfde_tpu/observability/capacity.py", "CapacityLedger"): LockSpec(
+        lock="_lock",
+    ),
+    ("tfde_tpu/observability/capacity.py", "UsageMeter"): LockSpec(
+        lock="_lock",
+    ),
+    ("tfde_tpu/observability/capacity.py", "UsageLog"): LockSpec(
+        lock="_lock",
+        # called only from write() with the lock already held (the
+        # _locked suffix is the contract; the AST pass can't see a
+        # caller-held lock)
+        exempt_methods=("_compact_locked",),
+    ),
 }
 
 #: files whose jax.random.split calls must be temperature-guarded

@@ -66,6 +66,26 @@
 #                                   — inference/router.py; _BATCH is the
 #                                   level-2 threshold that also sheds
 #                                   the batch class)
+#        TFDE_ADMIT_KV_HEADROOM=2 tools/tier1.sh
+#                                  (re-run with the KV-headroom admission
+#                                   gate armed by default — reject with
+#                                   429 + a kv payload once the capacity
+#                                   model says fewer than N free rows
+#                                   remain; observability/capacity.py +
+#                                   inference/admission.py; 0 = off. The
+#                                   dedicated drills in
+#                                   tests/test_server.py arm it
+#                                   explicitly either way.)
+#        TFDE_USAGE_LOG=on tools/tier1.sh
+#                                  (re-run with per-request usage
+#                                   metering journaled to
+#                                   model_dir/metrics/usage_<host>.jsonl
+#                                   on every router replica —
+#                                   observability/capacity.py; counters
+#                                   publish either way, only the JSONL
+#                                   is gated. TFDE_CAPACITY_BUDGET_BYTES
+#                                   forwards the same way and pins the
+#                                   headroom model's memory budget.)
 #
 # Also prints DOTS_DELTA (this run's DOTS_PASSED minus the previous
 # run's, from /tmp/_t1.passed) so a regression is visible at a glance
@@ -89,6 +109,9 @@ timeout -k 10 1440 env JAX_PLATFORMS=cpu \
     TFDE_ADMIT_TTFT_DEADLINE_MS="${TFDE_ADMIT_TTFT_DEADLINE_MS:-0}" \
     TFDE_BROWNOUT_BURN="${TFDE_BROWNOUT_BURN:-8}" \
     TFDE_BROWNOUT_BURN_BATCH="${TFDE_BROWNOUT_BURN_BATCH:-16}" \
+    TFDE_ADMIT_KV_HEADROOM="${TFDE_ADMIT_KV_HEADROOM:-0}" \
+    TFDE_USAGE_LOG="${TFDE_USAGE_LOG:-off}" \
+    TFDE_CAPACITY_BUDGET_BYTES="${TFDE_CAPACITY_BUDGET_BYTES:-0}" \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     --durations=10 \
