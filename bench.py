@@ -1742,6 +1742,8 @@ def serve_replica_child_mode() -> None:
     port_file = sys.argv[i + 2]
     push_url = None if sys.argv[i + 3] == "-" else sys.argv[i + 3]
 
+    import pickle
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1749,12 +1751,34 @@ def serve_replica_child_mode() -> None:
     from tfde_tpu.inference.router import ReplicaServer
     from tfde_tpu.inference.server import ContinuousBatcher
     from tfde_tpu.models.gpt import GPT
+    from tfde_tpu.observability import boot as boot_lib
 
+    # the boot ledger narrates this child's cold start: init (backdated
+    # to process birth) -> restore (a real file round-trip, so the
+    # bandwidth gauge is a disk number) -> compile (the warm loop's XLA)
+    # -> warmup -> ready. The parent reads the phases off the push
+    # gauges for the serve_cluster_* cold-boot columns.
+    led = boot_lib.current()
+    led.begin("init")
     model = GPT(vocab_size=512, hidden_size=64, depth=2, num_heads=2,
                 mlp_dim=128, max_position=64, dtype=jnp.float32)
     params = model.init(
         jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
+    ckpt = port_file + ".ckpt"
+    with open(ckpt, "wb") as f:
+        pickle.dump(jax.device_get(params), f)
+    led.begin("restore")
+    t_r = time.perf_counter()
+    with open(ckpt, "rb") as f:
+        params = pickle.load(f)
+    led.note_restore_leaf(
+        "params",
+        sum(x.nbytes for x in jax.tree_util.tree_leaves(params)),
+        max(time.perf_counter() - t_r, 1e-9),
+    )
+    os.remove(ckpt)
+    led.begin("compile")
     # batch 2 on purpose: the cluster bench wants per-replica saturation
     # (queueing behind a small decode batch) so adding the second replica
     # shows up as throughput, not idle rows
@@ -1764,8 +1788,12 @@ def serve_replica_child_mode() -> None:
     for ln in (4, 8, 4, 8):
         b.submit(rng.integers(0, model.vocab_size, ln), 16)
     b.run()
+    led.begin("warmup")
+    b.submit(rng.integers(0, model.vocab_size, 4), 4)
+    b.run()
     srv = ReplicaServer(b, replica_id=rid, push_url=push_url,
-                        push_interval=0.5).start()
+                        push_interval=0.5, boot_ledger=led).start()
+    led.ready()
     with open(port_file + ".tmp", "w") as f:
         f.write(str(srv.port))
     os.replace(port_file + ".tmp", port_file)
@@ -1968,6 +1996,27 @@ def _bench_serve_cluster(smoke: bool) -> dict:
         if occ:
             out["serve_cluster_kv_occupancy"] = round(
                 sum(occ) / len(occ), 4)
+        # cold-boot columns (informational, gate:false): the children
+        # pushed their boot/* ledger gauges; report the slowest replica's
+        # time-to-ready, its boot-attributed compile wall, and the mean
+        # restore bandwidth — the serving face of WORKFLOWS.md §21
+        boot_hosts = agg.host_metrics(("boot/",))
+        ttrs = [h["boot/time_to_ready_seconds"]
+                for h in boot_hosts.values()
+                if "boot/time_to_ready_seconds" in h]
+        if ttrs:
+            out["serve_cluster_time_to_ready_s"] = round(max(ttrs), 3)
+        compiles = [h["boot/compile_wall_seconds"]
+                    for h in boot_hosts.values()
+                    if "boot/compile_wall_seconds" in h]
+        if compiles:
+            out["serve_cluster_boot_compile_s"] = round(max(compiles), 3)
+        bws = [h["boot/restore_bandwidth_bps"]
+               for h in boot_hosts.values()
+               if "boot/restore_bandwidth_bps" in h]
+        if bws:
+            out["serve_cluster_restore_bw_mbps"] = round(
+                sum(bws) / len(bws) / 1e6, 2)
 
         # kill drill: router with the aggregator attached (staleness is a
         # second down signal) and a flight ring to dump the post-mortem

@@ -360,7 +360,7 @@ _OBS_CHILD = textwrap.dedent(
 
 _REPLICA_CHILD = textwrap.dedent(
     """
-    import os, sys, time
+    import os, pickle, sys, time
     import jax
     jax.config.update("jax_platforms", "cpu")
     from tfde_tpu.utils.devices import request_cpu_devices
@@ -370,24 +370,61 @@ _REPLICA_CHILD = textwrap.dedent(
     from tfde_tpu.inference.router import ReplicaServer
     from tfde_tpu.inference.server import ContinuousBatcher
     from tfde_tpu.models.gpt import gpt_tiny_test
+    from tfde_tpu.observability import boot as boot_lib
 
     rid, port_file = int(sys.argv[1]), sys.argv[2]
     push_url = sys.argv[3] or None   # "" -> no metrics pusher
     model_dir = sys.argv[4] if len(sys.argv) > 4 else None
+    hold_file = sys.argv[5] if len(sys.argv) > 5 else ""
+    led = boot_lib.current()   # init phase backdates to process birth
+    led.begin("init")
     model = gpt_tiny_test()
     params = model.init(
         jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
     )["params"]
+    # a real (tiny) checkpoint round-trip so the restore phase and its
+    # bandwidth gauge carry measured numbers in the drill
+    ckpt = port_file + ".ckpt"
+    with open(ckpt, "wb") as f:
+        pickle.dump(jax.device_get(params), f)
+    led.begin("restore")
+    t0 = time.perf_counter()
+    with open(ckpt, "rb") as f:
+        params = pickle.load(f)
+    led.note_restore_leaf(
+        "params",
+        sum(x.nbytes for x in jax.tree_util.tree_leaves(params)),
+        max(time.perf_counter() - t0, 1e-9))
+    os.remove(ckpt)
+    led.begin("compile")
     b = ContinuousBatcher(model, params, batch_size=2, max_len=64)
     rng = np.random.default_rng(rid)
     for ln in (4, 6):   # warm the compiles before announcing the port
         b.submit(rng.integers(1, 90, ln), 6)
     b.run()
+    led.begin("warmup")
+    b.submit(rng.integers(1, 90, 4), 4)
+    b.run()
     srv = ReplicaServer(b, replica_id=rid, push_url=push_url,
-                        push_interval=0.3, model_dir=model_dir).start()
-    with open(port_file + ".tmp", "w") as f:
-        f.write(str(srv.port))
-    os.replace(port_file + ".tmp", port_file)
+                        push_interval=0.3, model_dir=model_dir,
+                        boot_ledger=led).start()
+
+    def announce():
+        with open(port_file + ".tmp", "w") as f:
+            f.write(str(srv.port))
+        os.replace(port_file + ".tmp", port_file)
+
+    if hold_file:
+        # joining-replica drill: announce while still warming so the
+        # router can observe a not-ready boot; become ready only when
+        # the parent releases the hold (the wait is warmup wall)
+        announce()
+        while not os.path.exists(hold_file):
+            time.sleep(0.05)
+        led.ready()
+    else:
+        led.ready()
+        announce()
     while True:
         time.sleep(3600)   # the parent SIGKILLs replica 0, SIGTERMs 1
     """
@@ -404,10 +441,14 @@ def test_killed_replica_drains_to_survivor(tmp_path):
     TFDE_TRACE=on): the re-routed request's stitched waterfall must show
     BOTH replicas in the routing story and the survivor's serve events,
     and the replica_down flight record must cross-reference the traces
-    stranded on the dead replica."""
+    stranded on the dead replica. Boot observability closes the loop: a
+    REPLACEMENT replica then rejoins, serves zero requests before its
+    readiness state is `ready`, and its boot-phase decomposition must
+    sum to the birth->first-token wall within 5%."""
     import glob
     import signal
     import time
+    import urllib.error
     import urllib.request
 
     import jax
@@ -446,7 +487,7 @@ def test_killed_replica_drains_to_survivor(tmp_path):
     ms = serve_metrics(host="127.0.0.1", aggregator=agg)
     push = f"http://127.0.0.1:{ms.port}/push"
 
-    procs, router = [], None
+    procs, router, router2 = [], None, None
     # the parent's ring carries the router half of the stitched waterfall
     trace_was_on = reqtrace.active()
     if not trace_was_on:
@@ -585,9 +626,97 @@ def test_killed_replica_drains_to_survivor(tmp_path):
             body = scrape()
         assert 'tfde_cluster_host_up{host="0"} 0' in body
         assert 'tfde_cluster_host_up{host="1"} 1' in body
+
+        # -- the rejoin drill: replica 0 comes back as a NEW process
+        # that announces its port while still warming (hold file), so
+        # the parent can observe the not-ready boot from outside. The
+        # acceptance bars: it serves ZERO requests before `ready`, its
+        # boot ledger arrives complete over /load and /replicas, and
+        # the phase decomposition sums to the wall from process birth
+        # to its first served token within 5%.
+        hold = str(tmp_path / "hold2")
+        port2 = str(tmp_path / "port2")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["TFDE_TRACE"] = "on"
+        env["TFDE_USAGE_LOG"] = "on"
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(__file__))]
+            + env.get("PYTHONPATH", "").split(os.pathsep)
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script), "2", port2, "",
+                 str(tmp_path / "rep2"), hold],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+        )
+        deadline = time.time() + 240
+        while not os.path.exists(port2):
+            assert procs[-1].poll() is None, \
+                procs[-1].communicate()[1][-3000:]
+            assert time.time() < deadline, "rejoiner never announced"
+            time.sleep(0.1)
+        with open(port2) as f:
+            url2 = f"http://127.0.0.1:{int(f.read())}"
+        # a fresh router epoch over [survivor, rejoiner]; no aggregator —
+        # the old host ids would not line up with the new replica indices
+        router2 = Router([urls[1], url2]).start()
+        router2._load_ttl = 0.05   # age snapshots fast: tight ready flip
+        # while the rejoiner warms, everything lands on the survivor...
+        outs = [request_generate(router2.url, prompts[0], 6)
+                for _ in range(3)]
+        assert all(o["replica"] == 0 for o in outs)
+        boot_blk = json.loads(urllib.request.urlopen(
+            router2.url + "/replicas", timeout=5).read())["boot"]["1"]
+        assert boot_blk["state"] in ("starting", "restoring",
+                                     "compiling", "warming")
+        assert boot_blk["time_to_ready_s"] is None
+        # ...and the gate is hard: with the survivor drained the router
+        # 503s rather than placing on the not-ready rejoiner
+        urllib.request.urlopen(urllib.request.Request(
+            router2.url + "/drain",
+            data=json.dumps({"replica": 0}).encode(),
+            headers={"Content-Type": "application/json"}), timeout=5)
+        with pytest.raises(urllib.error.HTTPError):
+            request_generate(router2.url, prompts[0], 6)
+        load2 = json.loads(urllib.request.urlopen(
+            url2 + "/load", timeout=5).read())
+        assert load2["boot"]["ttft_from_birth_ms"] is None  # zero served
+        # release the hold: the rejoiner flips ready and takes traffic
+        with open(hold, "w"):
+            pass
+        out2 = None
+        while out2 is None and time.time() < deadline:
+            try:
+                out2 = request_generate(router2.url, prompts[0], 6)
+            except urllib.error.HTTPError:
+                time.sleep(0.05)
+        assert out2 is not None, "rejoiner never became placeable"
+        assert out2["replica"] == 1
+        assert out2["tokens"] == solo(prompts[0], 6)
+        # the complete cold-start ledger, phase by phase
+        snap = json.loads(urllib.request.urlopen(
+            url2 + "/load", timeout=5).read())["boot"]
+        assert snap["state"] == "ready"
+        for ph in ("init", "restore", "compile", "warmup"):
+            assert snap["phases"].get(ph, 0.0) > 0.0, (ph, snap)
+        assert snap["restore"]["bytes"] > 0
+        assert snap["restore"]["bandwidth_bps"] > 0
+        assert snap["time_to_ready_s"] > 0
+        # the acceptance identity, cross-process: phases tile the wall
+        # from process birth to the first served token within 5% (the
+        # only untiled slack is the post-ready placement latency)
+        ttft_s = snap["ttft_from_birth_ms"] / 1e3
+        assert abs(sum(snap["phases"].values()) - ttft_s) \
+            <= 0.05 * ttft_s, snap
     finally:
         if not trace_was_on:
             reqtrace.disable()
+        if router2 is not None:
+            router2.close()
         if router is not None:
             router.close()
         ms.close()
@@ -844,6 +973,7 @@ def test_killed_worker_leaves_flight_file_and_goes_stale(tmp_path):
     import glob
     import signal
     import time
+    import urllib.error
     import urllib.request
 
     from tfde_tpu.observability import flightrec

@@ -422,6 +422,154 @@ def test_brownout_sheds_strictly_in_priority_order(lm, rng):
         rep.close()
 
 
+# --------------------------------------------------------------------------
+# Boot & readiness: the router places only on `ready` replicas
+# --------------------------------------------------------------------------
+
+def _mk_booting_replica(model, params, idx, phase="warmup"):
+    """A replica whose externally driven boot ledger has NOT reached
+    ready — the joining-replica shape (router.py boot_ledger param)."""
+    from tfde_tpu.observability import boot as boot_lib
+
+    led = boot_lib.BootLedger(registry=metrics.Registry(),
+                              compile_probe=lambda: (0, 0.0))
+    led.begin(phase)
+    b = ContinuousBatcher(model, params, batch_size=2, max_len=64)
+    return ReplicaServer(b, replica_id=idx, boot_ledger=led).start(), led
+
+
+def test_readiness_matrix_no_placement_until_ready_then_flip(lm, rng):
+    """The matrix: a warming replica gets ZERO placements while its
+    sibling serves everything; /healthz and /replicas carry its state;
+    once the ledger flips ready (and the router's load snapshot ages
+    out) placement resumes with solo parity; /drain walks the table row
+    to draining."""
+    model, params = lm
+    r0, led = _mk_booting_replica(model, params, 0)
+    r1 = _mk_replica(model, params, 1)
+    router = Router([r0.url, r1.url]).start()
+    try:
+        # liveness stays 200 while booting; readiness rides the body
+        hz = json.loads(urllib.request.urlopen(
+            r0.url + "/healthz", timeout=5).read())
+        assert hz == {"ok": False, "state": "warming", "replica": 0}
+        outs = [
+            request_generate(router.url, rng.integers(1, 90, 5).tolist(), 6)
+            for _ in range(4)
+        ]
+        assert all(o["replica"] == 1 for o in outs)
+        tab = {row["replica"]: row for row in router.table()}
+        assert tab[0]["state"] == "warming"
+        assert tab[0]["ready_seen"] is False
+        assert tab[0]["up"] is True          # not-ready is NOT down
+        body = json.loads(urllib.request.urlopen(
+            router.url + "/replicas", timeout=5).read())
+        assert body["boot"]["0"]["state"] == "warming"
+        assert body["boot"]["1"]["state"] == "ready"
+
+        led.ready()                          # the joiner finishes booting
+        time.sleep(router._load_ttl + 0.05)  # let the snapshot age out
+        hz = json.loads(urllib.request.urlopen(
+            r0.url + "/healthz", timeout=5).read())
+        assert hz["ok"] is True and hz["state"] == "ready"
+        # both idle -> least-outstanding tie breaks to replica 0 now
+        p = rng.integers(1, 90, 5).tolist()
+        placed = {request_generate(router.url, p, 6)["replica"]
+                  for _ in range(4)}
+        assert 0 in placed
+        out = request_generate(router.url, p, 6)
+        assert out["tokens"] == _solo(model, params, p, 6)
+        tab = {row["replica"]: row for row in router.table()}
+        assert tab[0]["state"] == "ready" and tab[0]["ready_seen"] is True
+
+        # drain transition: the table row walks to draining
+        req = urllib.request.Request(
+            router.url + "/drain",
+            data=json.dumps({"replica": 0}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=5).read()
+        tab = {row["replica"]: row for row in router.table()}
+        assert tab[0]["state"] == "draining"
+    finally:
+        for s in (router, r0, r1):
+            s.close()
+    assert led.state == "draining"           # close() walks the ledger
+
+
+def test_ready_require_off_restores_legacy_placement(lm, rng,
+                                                     monkeypatch):
+    """TFDE_BOOT_READY_REQUIRE=off: the pre-readiness behavior — a
+    still-booting replica is placeable (and decodes correctly; readiness
+    is a placement gate, not a capability)."""
+    monkeypatch.setenv("TFDE_BOOT_READY_REQUIRE", "off")
+    model, params = lm
+    r0, _led = _mk_booting_replica(model, params, 0, phase="compile")
+    r1 = _mk_replica(model, params, 1)
+    router = Router([r0.url, r1.url]).start()
+    try:
+        p = rng.integers(1, 90, 5).tolist()
+        outs = [request_generate(router.url, p, 6) for _ in range(4)]
+        assert any(o["replica"] == 0 for o in outs)
+        for o in outs:
+            assert o["tokens"] == _solo(model, params, p, 6)
+    finally:
+        for s in (router, r0, r1):
+            s.close()
+
+
+def test_boot_grace_shields_never_ready_from_staleness(lm, rng,
+                                                       monkeypatch):
+    """A never-ready replica whose pushes went stale is busy booting,
+    not dead: within TFDE_BOOT_READY_GRACE_S it stays up (and unplaced,
+    because it is not ready) instead of being marked down."""
+    monkeypatch.setenv("TFDE_BOOT_READY_GRACE_S", "60")
+    model, params = lm
+    agg = ClusterAggregator(stale_after=0.2)
+    agg.ingest({"host": 0, "metrics": {}})
+    r0, _led = _mk_booting_replica(model, params, 0, phase="compile")
+    r1 = _mk_replica(model, params, 1)
+    router = Router([r0.url, r1.url], aggregator=agg).start()
+    try:
+        time.sleep(0.3)                      # host 0's push is now stale
+        out = request_generate(router.url, rng.integers(1, 90, 5).tolist(), 6)
+        assert out["replica"] == 1
+        tab = {row["replica"]: row for row in router.table()}
+        assert tab[0]["up"] is True          # shielded by the grace
+        assert tab[1]["up"] is True
+    finally:
+        for s in (router, r0, r1):
+            s.close()
+
+
+def test_never_ready_death_books_separately_from_lost(lm, rng,
+                                                      monkeypatch):
+    """With the grace elapsed, a stale never-ready replica IS marked
+    down — but under router/replicas_never_ready (a failed boot), not
+    router/replicas_lost (lost serving capacity)."""
+    monkeypatch.setenv("TFDE_BOOT_READY_GRACE_S", "0")
+    model, params = lm
+    reg = metrics.default_registry()
+    reg.reset("router/")
+    agg = ClusterAggregator(stale_after=0.2)
+    agg.ingest({"host": 0, "metrics": {}})
+    r0, _led = _mk_booting_replica(model, params, 0, phase="restore")
+    r1 = _mk_replica(model, params, 1)
+    router = Router([r0.url, r1.url], aggregator=agg).start()
+    try:
+        time.sleep(0.3)
+        out = request_generate(router.url, rng.integers(1, 90, 5).tolist(), 6)
+        assert out["replica"] == 1
+        tab = {row["replica"]: row for row in router.table()}
+        assert tab[0]["up"] is False
+        assert reg.get("router/replicas_never_ready").value >= 1
+        lost = reg.get("router/replicas_lost")
+        assert lost is None or lost.value == 0
+    finally:
+        for s in (router, r0, r1):
+            s.close()
+
+
 def test_deadline_shed_surfaces_as_inband_sse_error(lm, rng,
                                                     monkeypatch):
     """A request shed at dequeue AFTER the SSE stream opened cannot

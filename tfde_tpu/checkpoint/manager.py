@@ -144,6 +144,9 @@ class CheckpointManager:
             # NOTE goodput accounting: restores run inside the train loop's
             # init span, so "checkpoint/restore" is observability-only and
             # the ledger's checkpoint category counts save+wait alone
+            import time as _time
+
+            t_restore = _time.perf_counter()
             with span("checkpoint/restore"):
                 restored = retry_call(
                     self._mngr.restore,
@@ -153,6 +156,8 @@ class CheckpointManager:
                     what=f"checkpoint restore(step={step})",
                     counter="resilience/checkpoint_retries",
                 )
+            self._note_boot_restore(
+                restored, _time.perf_counter() - t_restore)
         except ValueError as e:
             # Reword ONLY genuine structure mismatches: compare the saved
             # checkpoint's tree structure (orbax metadata) against the
@@ -195,6 +200,27 @@ class CheckpointManager:
             batch_stats=restored["batch_stats"],
             opt_state=restored["opt_state"],
         )
+
+    @staticmethod
+    def _note_boot_restore(restored, seconds: float) -> None:
+        """Feed the boot ledger's restore accounting: per-top-level-leaf
+        bytes of the restored tree plus the restore call's wall become
+        ``boot/restore_bandwidth_bps`` — the streamed-restore baseline a
+        joining replica's cold start is measured against. Best-effort:
+        a ledger failure must never fail a restore."""
+        try:
+            from tfde_tpu.observability import boot as boot_lib
+
+            leaves = {}
+            for name, sub in restored.items():
+                nb = sum(int(getattr(x, "nbytes", 0))
+                         for x in jax.tree_util.tree_leaves(sub))
+                if nb:
+                    leaves[str(name)] = nb
+            if leaves:
+                boot_lib.note_restore(leaves, seconds)
+        except Exception:
+            log.debug("boot restore accounting failed", exc_info=True)
 
     @staticmethod
     def _find_packed(node):

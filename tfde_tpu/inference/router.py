@@ -8,7 +8,13 @@ is the cluster layer that turns N of them into a service:
   loop produces them (a background thread drives `step()`; request
   handlers only `submit()` and poll `take_progress()`), plus /prime and
   /generate_primed for the prefill/decode role split, /load for the
-  router's placement signal, and /healthz. It optionally pushes its
+  router's placement signal, and /healthz. Each replica carries a boot
+  ledger (observability/boot.py) whose readiness state (starting ->
+  restoring -> compiling -> warming -> ready -> draining) rides
+  /healthz and /load; a conventionally constructed replica is ready at
+  start(), a cold-booting one passes its externally driven BootLedger
+  and the router withholds traffic until it reports ready
+  (TFDE_BOOT_READY_* knobs). It optionally pushes its
   serving gauges to the chief (`observability/aggregate.py`
   MetricsPusher), so the whole fleet shows up host-labelled in one
   scrape, and arms the flight recorder for post-mortems.
@@ -50,6 +56,7 @@ import numpy as np
 
 from tfde_tpu import knobs
 from tfde_tpu.inference import admission as _admission
+from tfde_tpu.observability import boot as _boot
 from tfde_tpu.observability import flightrec, metrics
 from tfde_tpu.observability import trace as _trace
 from tfde_tpu.observability.slo import SLOTracker
@@ -133,6 +140,18 @@ def _post_json(url: str, payload: dict, timeout: float, headers=None):
     return urllib.request.urlopen(req, timeout=timeout)
 
 
+class _FleetHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer tuned for a serving tier: socketserver's
+    default listen backlog of 5 silently drops SYNs under a request
+    burst — the client's kernel retransmits ~1s later, which shows up
+    as a phantom 1s TTFT tail (or a reset) that no server-side metric
+    explains. Overload policy belongs to the admission layer (429 +
+    Retry-After), so accept the burst and let it decide."""
+
+    daemon_threads = True
+    request_queue_size = 128
+
+
 # -- replica-side server -----------------------------------------------------
 class ReplicaServer:
     """One batcher replica behind HTTP/SSE (see the module docstring).
@@ -149,13 +168,21 @@ class ReplicaServer:
                  replica_id: int = 0, push_url: Optional[str] = None,
                  push_interval: float = 2.0,
                  model_dir: Optional[str] = None,
-                 poll_interval: float = 0.002):
+                 poll_interval: float = 0.002,
+                 boot_ledger=None):
         self.batcher = batcher
         batcher.enable_progress()
         self.replica_id = int(replica_id)
         self.lock = threading.RLock()
         self._poll = float(poll_interval)
         self._stop = threading.Event()
+        # readiness: an externally driven BootLedger (a cold-booting
+        # replica advances its phases and calls ready() itself); without
+        # one the replica is ready the moment start() returns — the
+        # conventional in-process construction has no boot to measure
+        self._boot_external = boot_ledger is not None
+        self.boot = (boot_ledger if boot_ledger is not None
+                     else _boot.BootLedger())
         if model_dir is not None:
             flightrec.arm(model_dir)
             _trace.arm(model_dir)
@@ -188,11 +215,15 @@ class ReplicaServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    body = b"ok\n"
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    # liveness stays a 200 (the process answers); the
+                    # READINESS state rides the body so pollers and the
+                    # router can tell "up" from "safe to place on"
+                    state = srv.state
+                    srv._send_json(self, 200, {
+                        "ok": state == "ready",
+                        "state": state,
+                        "replica": srv.replica_id,
+                    })
                 elif self.path == "/load":
                     srv._send_json(self, 200, srv.load())
                 elif self.path.startswith("/trace/"):
@@ -246,8 +277,7 @@ class ReplicaServer:
                 except (ValueError, RuntimeError) as e:
                     srv._send_json(self, 400, {"error": str(e)})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = _FleetHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self.url = f"http://{host}:{self.port}"
         self._http_thread = threading.Thread(
@@ -269,10 +299,22 @@ class ReplicaServer:
     def start(self) -> "ReplicaServer":
         self._http_thread.start()
         self._loop_thread.start()
-        log.info("replica %d serving on %s", self.replica_id, self.url)
+        if not self._boot_external:
+            # no external boot driver: the batcher was built (and warmed)
+            # before construction, so the replica is ready now
+            self.boot.ready()
+        log.info("replica %d serving on %s (state %s)",
+                 self.replica_id, self.url, self.state)
         return self
 
+    @property
+    def state(self) -> str:
+        """Readiness state surfaced on /healthz and /load: the boot
+        ledger's machine until ready, `draining` once close() begins."""
+        return self.boot.state
+
     def close(self) -> None:
+        self.boot.draining()
         self._stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -320,6 +362,8 @@ class ReplicaServer:
             return {
                 "replica": self.replica_id,
                 "role": b.role,
+                "state": self.state,
+                "boot": self.boot.snapshot(),
                 "outstanding_tokens": b.outstanding_tokens,
                 "queue_depth": depth,
                 "queue_depths": b._queue.depths(),
@@ -431,7 +475,8 @@ class ReplicaServer:
 
 # -- router ------------------------------------------------------------------
 class _Replica:
-    __slots__ = ("url", "idx", "up", "outstanding", "served", "drained")
+    __slots__ = ("url", "idx", "up", "outstanding", "served", "drained",
+                 "state", "ready_seen", "first_seen")
 
     def __init__(self, url: str, idx: int):
         self.url = url.rstrip("/")
@@ -440,6 +485,14 @@ class _Replica:
         self.drained = False
         self.outstanding = 0   # router-side in-flight token estimate
         self.served = 0
+        # readiness (observability/boot.py): last /load-reported state
+        # ("unknown" until the first snapshot — fail open), whether this
+        # replica has EVER reported ready (distinguishes a lost replica
+        # from one that never finished booting), and when the router
+        # first saw it (the boot-grace anchor)
+        self.state = "unknown"
+        self.ready_seen = False
+        self.first_seen = time.monotonic()
 
 
 class Router:
@@ -533,7 +586,8 @@ class Router:
                         {"replicas": router.table(),
                          "slo": router.slo.summary(),
                          "mem": router.mem_table(),
-                         "kv": router.kv_table()},
+                         "kv": router.kv_table(),
+                         "boot": router.boot_table()},
                     )
                 elif self.path.startswith("/trace/"):
                     tid = self.path[len("/trace/"):]
@@ -580,8 +634,7 @@ class Router:
                 else:
                     self.send_error(404)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = _FleetHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self.url = f"http://{host}:{self.port}"
         self._http_thread = threading.Thread(
@@ -644,20 +697,37 @@ class Router:
     def _refresh_liveness(self) -> None:
         """Fold the chief aggregator's staleness view into the routing
         table: a replica whose metric pushes went stale is down even if
-        the router has not yet hit a connection error on it."""
+        the router has not yet hit a connection error on it. A replica
+        that has never been ready gets TFDE_BOOT_READY_GRACE_S first —
+        a joiner mid-compile-storm pushes late because it is busy
+        booting, not because it died."""
         if self._agg is None:
             return
+        grace = _boot.ready_grace_s()
         hosts = self._agg.hosts()
+        now = time.monotonic()
         for rep in self._reps:
             info = hosts.get(rep.idx)
-            if info is not None and info["age"] > self._agg.stale_after:
-                self._mark_down(rep, f"stale push ({info['age']:.1f}s)")
+            if info is None or info["age"] <= self._agg.stale_after:
+                continue
+            if not rep.ready_seen and now - rep.first_seen < grace:
+                continue
+            self._mark_down(rep, f"stale push ({info['age']:.1f}s)")
+
+    def _placeable(self, rep: _Replica) -> bool:
+        """Readiness gate (decode tier): place only on replicas whose
+        last /load snapshot said `ready` — or that the router has never
+        snapshotted (fail open, the pre-readiness behavior for legacy
+        replicas and direct-`_pick` callers)."""
+        return rep.state in _boot.PLACEABLE_STATES
 
     def _pick(self, pool, exclude=()):
         self._refresh_liveness()
+        gate = pool is self._reps and _boot.ready_require()
         with self._lock:
             cands = [r for r in pool
-                     if r.up and not r.drained and r.idx not in exclude]
+                     if r.up and not r.drained and r.idx not in exclude
+                     and (not gate or self._placeable(r))]
             if not cands:
                 raise LookupError("no live replicas")
             return min(cands, key=lambda r: r.outstanding)
@@ -677,13 +747,23 @@ class Router:
             if not rep.up:
                 return
             rep.up = False
+            # fail open like placement does: a replica the router never
+            # snapshotted (state "unknown") gets legacy `lost`
+            # accounting; only an OBSERVED not-yet-ready boot books as
+            # never_ready
+            ever_ready = rep.ready_seen or rep.state == "unknown"
             # the traces this death strands — the flight dump's
             # cross-reference into the request-trace timeline
             stranded = sorted(
                 t for t, idx in self._inflight.items() if idx == rep.idx
             )
-        log.warning("replica %d (%s) down: %s", rep.idx, rep.url, reason)
-        self._reg.counter("router/replicas_lost").incr()
+        log.warning("replica %d (%s) down: %s%s", rep.idx, rep.url, reason,
+                    "" if ever_ready else " (never became ready)")
+        # a replica that died WITHOUT ever reaching ready is a failed
+        # boot, not lost serving capacity — the autoscaler reads these
+        # two counters very differently
+        self._reg.counter("router/replicas_lost" if ever_ready
+                          else "router/replicas_never_ready").incr()
         self._reg.gauge(f"router/replica{rep.idx}/up").set(0)
         from tfde_tpu.resilience.health import note_replica_down
 
@@ -691,7 +771,7 @@ class Router:
         # the dead replica can't dump its own flight ring (SIGKILL);
         # the router's ring carries the routing-side story for it
         flightrec.record("replica_down", replica=rep.idx, reason=reason,
-                         traces=stranded)
+                         never_ready=not ever_ready, traces=stranded)
         flightrec.dump("replica_down")
 
     def drain(self, idx: int, tier: str = "decode") -> bool:
@@ -784,11 +864,54 @@ class Router:
                 "url": rep.url,
                 "up": rep.up,
                 "drained": rep.drained,
+                "state": "draining" if rep.drained else rep.state,
+                "ready_seen": rep.ready_seen,
                 "outstanding_tokens": rep.outstanding,
                 "served": rep.served,
                 "push_age_s": info.get("age"),
             })
         return rows
+
+    def boot_table(self) -> dict:
+        """Per-replica boot ledger (the /replicas `boot` block and
+        obs_dump --boot surface): the cached /load snapshot's full
+        ledger when the router has one, back-filled from the pushed
+        boot/* gauges for replicas it has not snapshotted (e.g. a chief
+        aggregating hosts the router never placed on)."""
+        with self._lock:
+            loads = dict(self._loads)
+        out = {}
+        for idx, ld in loads.items():
+            if isinstance(ld, dict) and isinstance(ld.get("boot"), dict):
+                out[str(idx)] = ld["boot"]
+        if self._agg is not None:
+            for hid, flat in self._agg.host_metrics(("boot/",)).items():
+                if not flat or str(hid) in out:
+                    continue
+                phases = {
+                    name: flat[g] for name, g in (
+                        ("init", "boot/init_seconds"),
+                        ("bootstrap", "boot/bootstrap_seconds"),
+                        ("restore", "boot/restore_seconds"),
+                        ("compile", "boot/compile_wall_seconds"),
+                        ("warmup", "boot/warmup_seconds"),
+                    ) if g in flat
+                }
+                out[str(hid)] = {
+                    "state": None,   # gauges carry numbers, not the FSM
+                    "phases": phases,
+                    "time_to_ready_s": flat.get(
+                        "boot/time_to_ready_seconds"),
+                    "ttft_from_birth_ms": flat.get(
+                        "boot/ttft_from_birth_ms"),
+                    "restore": {"bandwidth_bps": flat.get(
+                        "boot/restore_bandwidth_bps")},
+                    "compile": {
+                        "boot_count": flat.get("boot/compile_count"),
+                        "boot_seconds": flat.get("boot/compile_seconds"),
+                    },
+                }
+        return out
 
     def _publish(self) -> None:
         for rep in self._reps:
@@ -846,6 +969,17 @@ class Router:
         with self._lock:
             self._loads = loads
             self._loads_at = now
+            # readiness refresh rides the same snapshot: every request
+            # path calls this before _pick, so placement always gates on
+            # a state at most _load_ttl old. A /load without `state` is
+            # a legacy replica — treat as ready.
+            for rep in self._reps:
+                ld = loads.get(rep.idx)
+                if ld is None:
+                    continue
+                rep.state = str(ld.get("state", "ready"))
+                if rep.state == "ready":
+                    rep.ready_seen = True
         return loads
 
     def _reject(self, handler, headers_sent: bool, reason: str,
@@ -946,10 +1080,16 @@ class Router:
             self._reject(handler, False, "brownout",
                          _admission.MIN_RETRY_AFTER_S * 4, tid)
             return
-        # saturation gate: when EVERY live replica's /load snapshot says
-        # its admission controller would reject, fail fast here with the
-        # fleet's best Retry-After instead of bouncing off each replica
-        loads = self._load_snapshot()
+        # saturation gate: when EVERY live PLACEABLE replica's /load
+        # snapshot says its admission controller would reject, fail fast
+        # here with the fleet's best Retry-After instead of bouncing off
+        # each replica (a warming joiner is not capacity yet, so it
+        # neither saves nor dooms the fleet here)
+        all_loads = self._load_snapshot()
+        gated = _boot.ready_require()
+        loads = {idx: ld for idx, ld in all_loads.items()
+                 if not gated
+                 or str(ld.get("state", "ready")) in _boot.PLACEABLE_STATES}
         sat = [ld for ld in loads.values() if ld.get("saturated")]
         if loads and len(sat) == len(loads):
             self._reject(handler, False, "saturated",

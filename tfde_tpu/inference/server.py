@@ -84,6 +84,7 @@ from tfde_tpu.inference.prefix_cache import (
 )
 from tfde_tpu.inference.speculative import _set_index_counters
 from tfde_tpu.analysis import hlolint as _hlolint
+from tfde_tpu.observability import boot as _boot
 from tfde_tpu.observability import capacity as _capacity
 from tfde_tpu.observability import memwatch as _memwatch
 from tfde_tpu.observability import metrics
@@ -758,6 +759,7 @@ class _BatcherBase:
         rid = self._next_id
         self._next_id += 1
         self._queue.append((rid, prompt, budget, primed), priority=priority)
+        _boot.note_first_admit()
         now = time.perf_counter()
         self._submitted_at[rid] = now
         self._priority[rid] = priority
@@ -1020,6 +1022,9 @@ class _BatcherBase:
                     self._usage.admitted(rid)
                     t0 = self._submitted_at.pop(rid, None)
                     self._first_at[rid] = now
+                    # cold-start edge: the boot ledger's first served
+                    # token (idempotent after the first request)
+                    _boot.note_first_token()
                     if t0 is not None:
                         # the TTFT decomposition the bench reports:
                         # queue_wait (submit -> wave start) + prefill

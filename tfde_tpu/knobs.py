@@ -263,6 +263,21 @@ _register(
          "answers QueueFull/429 with a kv payload when the capacity "
          "model's headroom_rows falls below it (0 = memory gate off).",
          "inference/admission.py"),
+    Knob("TFDE_BOOT_", "spec", None, (),
+         "Boot & readiness observability family prefix (see members "
+         "below).",
+         "observability/boot.py, inference/router.py", prefix=True),
+    Knob("TFDE_BOOT_READY_REQUIRE", "flag", True, (),
+         "Router readiness gate: place traffic only on replicas whose "
+         "/load reports state 'ready' (a replica the router has never "
+         "snapshotted fails open). 'off' restores pre-readiness "
+         "placement on any live replica.",
+         "inference/router.py"),
+    Knob("TFDE_BOOT_READY_GRACE_S", "float", 120.0, (),
+         "Seconds a never-ready (still booting) replica may push stale "
+         "or report not-ready before staleness is allowed to declare it "
+         "down; a booting replica mid-compile-storm is busy, not dead.",
+         "inference/router.py"),
     Knob("TFDE_USAGE_LOG", "spec", None, ("off", "on", "<path>"),
          "Per-request usage metering JSONL: off (default), on (write "
          "model_dir/metrics/usage_<host>.jsonl on each ReplicaServer), "

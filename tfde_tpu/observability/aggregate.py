@@ -405,6 +405,19 @@ class ClusterAggregator:
             g("cluster/kv_headroom_rows").set(headroom)
             out["kv_waste_frac"] = waste
             out["kv_headroom_rows"] = headroom
+
+        # fleet boot picture (observability/boot.py): how long a joining
+        # replica takes to become placeable — the autoscaler's scale-out
+        # lead-time signal — as the live hosts' time-to-ready p50/max
+        ttrs = [v for h in live.values()
+                if (v := h.flat.get("boot/time_to_ready_seconds"))
+                is not None]
+        if ttrs:
+            p50 = _median(ttrs)
+            g("cluster/boot_p50_seconds").set(p50)
+            g("cluster/boot_max_seconds").set(max(ttrs))
+            out["boot_p50_seconds"] = p50
+            out["boot_max_seconds"] = max(ttrs)
         return out
 
     # -- exposition ----------------------------------------------------------

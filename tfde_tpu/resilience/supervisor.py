@@ -271,8 +271,20 @@ class Supervisor:
                 # failed attempt's finally (heartbeat stop, est.close) ran
                 # against the old runtime before it is torn down here
                 cause, pending_topology = pending_topology, None
+                # the rejoin is a fresh boot epoch on the cold-start
+                # ledger (observability/boot.py): the re-bootstrap lands
+                # in its `bootstrap` phase and the attempt's checkpoint
+                # restore in `restore`, so training rejoin cost is
+                # measured by the same instrument as a serving replica's
+                # cold start — cross-checkable against the goodput
+                # ledger's restart_loss/init buckets
+                from tfde_tpu.observability import boot as boot_lib
+
+                boot_led = boot_lib.current()
+                boot_led.new_epoch(cause=cause)
                 try:
-                    elastic_lib.rebootstrap(ecfg, cause=cause)
+                    with boot_led.phase("bootstrap"):
+                        elastic_lib.rebootstrap(ecfg, cause=cause)
                 except BaseException as te:
                     raise SupervisorAborted(
                         f"elastic re-bootstrap failed after {self.restarts} "

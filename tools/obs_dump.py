@@ -35,6 +35,13 @@ surfaces, composable in one invocation:
   occupancy, pad-ladder waste, and headroom from the capacity ledger,
   the top-waste-bucket callout (the cells paged-KV would reclaim), and
   per-host ``metrics/usage_*.jsonl`` summaries.
+- ``python tools/obs_dump.py --boot [--router URL | <model_dir>]`` —
+  the cold-start view (WORKFLOWS.md §21): per-replica boot waterfall
+  (phase durations process-birth → first token, restore bandwidth,
+  compile share of time-to-ready) from a live router's ``/replicas``
+  boot block or from dumped metrics snapshots + ``boot_phase`` /
+  ``boot_ready`` flight breadcrumbs, with a slowest-phase callout
+  naming the fix.
 - ``--tail N`` — how many trailing flight events to print (default 10).
 
 Reads only; stdlib only — safe to run against a production model_dir
@@ -428,6 +435,148 @@ def dump_capacity(model_dir=None, router_url=None) -> int:
     return 0 if (shown or usage) else 1
 
 
+#: boot phases in ledger order (mirrors observability/boot.py PHASES —
+#: kept literal so this tool stays import-free for --boot)
+_BOOT_PHASES = ("init", "bootstrap", "restore", "compile", "warmup")
+
+#: fat-phase → fix, the WORKFLOWS.md §21 runbook in one line each
+_BOOT_FIXES = {
+    "init": "trim process init: lazy imports, defer device/backend setup",
+    "bootstrap": "check coordinator reachability and barrier stragglers",
+    "restore": "streamed / sharded restore — raise restore bandwidth",
+    "compile": "AOT-warm the pad ladder or persist the jit cache",
+    "warmup": "cap trie pre-warm work or pre-warm from a snapshot",
+}
+
+
+def _boot_row(hid, b: dict) -> str:
+    phases = b.get("phases") or {}
+
+    def _s(name):
+        v = phases.get(name)
+        return f"{v:.2f}" if v is not None else "-"
+
+    ttr = b.get("time_to_ready_s")
+    ttft = b.get("ttft_from_birth_ms")
+    bw = (b.get("restore") or {}).get("bandwidth_bps")
+    comp = (b.get("compile") or {}).get("boot_seconds")
+    share = (f"{comp / ttr:.0%}" if comp is not None and ttr else "-")
+    return (f"  {str(hid):>7} {str(b.get('state') or '-'):>9} "
+            + " ".join(f"{_s(p):>7}" for p in _BOOT_PHASES)
+            + f" {(f'{ttr:.2f}' if ttr is not None else '-'):>8}"
+            + f" {(f'{ttft:.0f}' if ttft is not None else '-'):>8}"
+            + f" {(f'{bw / 1e6:.1f}' if bw else '-'):>8}"
+            + f" {share:>7}")
+
+
+_BOOT_HEADER = (f"  {'replica':>7} {'state':>9} "
+                + " ".join(f"{p[:7]:>7}" for p in _BOOT_PHASES)
+                + f" {'ready_s':>8} {'ttft_ms':>8} {'rst_mbs':>8} "
+                f"{'cmp%':>7}")
+
+
+def _boot_callout(tables: dict) -> None:
+    """Name the fattest boot phase across replicas — where the next
+    second of time-to-ready comes from — and its runbook fix."""
+    totals: dict = collections.Counter()
+    for b in tables.values():
+        for p, v in (b.get("phases") or {}).items():
+            if p in _BOOT_FIXES and v:
+                totals[p] += v
+    if not totals:
+        return
+    top = max(totals, key=totals.get)
+    whole = sum(totals.values())
+    print(f"  slowest phase: {top} ({totals[top]:.2f}s of {whole:.2f}s "
+          f"summed boot, {totals[top] / whole:.0%}) — "
+          f"{_BOOT_FIXES[top]} (WORKFLOWS.md §21)")
+
+
+def dump_boot(model_dir=None, router_url=None, tail: int = 10) -> int:
+    """``--boot``: the per-replica cold-start waterfall — phase seconds
+    from process birth to first token, restore bandwidth, and compile's
+    share of time-to-ready — live from a Router's /replicas boot block,
+    or offline from metrics snapshots + boot_* flight breadcrumbs."""
+    if router_url:
+        target = router_url.rstrip("/")
+        if not target.endswith("/replicas"):
+            target += "/replicas"
+        body = json.loads(urllib.request.urlopen(target, timeout=5).read())
+        boot = body.get("boot") or {}
+        print(f"== boot: {target} ({len(boot)} replicas reporting)")
+        if not boot:
+            print("  (no boot ledgers yet — replicas on a pre-ledger "
+                  "build, or none snapshotted/pushed so far)")
+            return 1
+        print(_BOOT_HEADER)
+        for hid in sorted(boot):
+            print(_boot_row(hid, boot[hid]))
+        _boot_callout(boot)
+        return 0
+
+    # offline: last per-host boot/* gauges out of the metrics snapshots,
+    # then the boot breadcrumbs out of the flight dumps
+    logs = sorted(glob.glob(os.path.join(model_dir, "metrics", "*.jsonl")))
+    logs = [p for p in logs
+            if not os.path.basename(p).startswith("usage_")]
+    tables: dict = {}
+    for p in logs:
+        rows = _load_jsonl(p)
+        if not rows:
+            continue
+        flat = rows[-1].get("metrics", {})
+        if not any(k.startswith("boot/") for k in flat):
+            continue
+        host = os.path.basename(p).rsplit(".", 1)[0]
+        if host.startswith("metrics-"):
+            host = host[len("metrics-"):]
+        phases = {
+            name: flat[g] for name, g in (
+                ("init", "boot/init_seconds"),
+                ("bootstrap", "boot/bootstrap_seconds"),
+                ("restore", "boot/restore_seconds"),
+                ("compile", "boot/compile_wall_seconds"),
+                ("warmup", "boot/warmup_seconds"),
+            ) if g in flat
+        }
+        tables[host] = {
+            "state": None,   # gauges carry numbers, not the FSM
+            "phases": phases,
+            "time_to_ready_s": flat.get("boot/time_to_ready_seconds"),
+            "ttft_from_birth_ms": flat.get("boot/ttft_from_birth_ms"),
+            "restore": {"bandwidth_bps":
+                        flat.get("boot/restore_bandwidth_bps")},
+            "compile": {"boot_count": flat.get("boot/compile_count"),
+                        "boot_seconds": flat.get("boot/compile_seconds")},
+        }
+    print(f"== boot: {model_dir} ({len(tables)} hosts with boot/* "
+          f"gauges)")
+    if tables:
+        print(_BOOT_HEADER)
+        for hid in sorted(tables):
+            print(_boot_row(hid, tables[hid]))
+        _boot_callout(tables)
+
+    shown_crumbs = 0
+    for p in sorted(glob.glob(
+            os.path.join(model_dir, "debug", "flight_*.jsonl"))):
+        events = [e for e in _load_jsonl(p)
+                  if e.get("kind") in ("boot_phase", "boot_ready",
+                                       "boot_epoch")]
+        if not events:
+            continue
+        shown_crumbs += len(events)
+        print(f"\n  boot breadcrumbs: {p} "
+              f"(last {min(tail, len(events))} of {len(events)})")
+        for e in events[-tail:]:
+            print(_fmt_event(e))
+    if not tables and not shown_crumbs:
+        print(f"  (no boot/* gauges or boot_* flight events under "
+              f"{model_dir} — pre-ledger run, or replicas never pushed)")
+        return 1
+    return 0
+
+
 def _fmt_trace_event(e: dict, t0: float) -> str:
     extra = {k: v for k, v in e.items()
              if k not in ("ts", "dur", "name", "proc", "pid", "trace",
@@ -543,6 +692,12 @@ def main(argv=None) -> int:
                          "(live via --router, or from a model_dir's last "
                          "metrics snapshots) + top-waste-bucket callout "
                          "and usage-log summaries")
+    ap.add_argument("--boot", action="store_true",
+                    help="per-replica boot waterfall (phase seconds "
+                         "birth → first token, restore bandwidth, "
+                         "compile share) live via --router or from a "
+                         "model_dir's snapshots + flight breadcrumbs, "
+                         "with a slowest-phase callout")
     args = ap.parse_args(argv)
     if not args.model_dir and not args.url and not args.router:
         ap.error("give a model_dir, --url, --router, or a combination")
@@ -555,7 +710,13 @@ def main(argv=None) -> int:
     if args.capacity and not (args.router or args.model_dir):
         ap.error("--capacity needs --router (live) or a model_dir "
                  "(snapshots)")
+    if args.boot and not (args.router or args.model_dir):
+        ap.error("--boot needs --router (live) or a model_dir "
+                 "(snapshots/flight dumps)")
 
+    if args.boot:
+        return dump_boot(model_dir=args.model_dir,
+                         router_url=args.router, tail=args.tail)
     if args.capacity:
         return dump_capacity(model_dir=args.model_dir,
                              router_url=args.router)

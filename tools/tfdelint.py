@@ -134,6 +134,15 @@ LOCKED_CLASSES: Dict[Tuple[str, str], LockSpec] = {
         # caller-held lock)
         exempt_methods=("_compact_locked",),
     ),
+    # boot & readiness (PR 17): phase edges arrive from the owner's
+    # boot thread while /load handler threads snapshot() and the
+    # module-level serving-path marks fan in from the batcher step loop
+    ("tfde_tpu/observability/boot.py", "BootLedger"): LockSpec(
+        lock="_lock",
+        # called only from begin()/end()/ready()/new_epoch() with the
+        # lock already held (the _locked suffix is the contract)
+        exempt_methods=("_close_open_locked",),
+    ),
 }
 
 #: files whose jax.random.split calls must be temperature-guarded

@@ -86,6 +86,17 @@
 #                                   is gated. TFDE_CAPACITY_BUDGET_BYTES
 #                                   forwards the same way and pins the
 #                                   headroom model's memory budget.)
+#        TFDE_BOOT_READY_REQUIRE=off tools/tier1.sh
+#                                  (re-run with the router's readiness
+#                                   gate disabled — traffic places on
+#                                   any live replica regardless of its
+#                                   boot state, the pre-PR-17 behaviour;
+#                                   observability/boot.py +
+#                                   inference/router.py.
+#                                   TFDE_BOOT_READY_GRACE_S forwards
+#                                   the same way: seconds a never-ready
+#                                   booting replica is shielded from
+#                                   the staleness down-marker.)
 #
 # Also prints DOTS_DELTA (this run's DOTS_PASSED minus the previous
 # run's, from /tmp/_t1.passed) so a regression is visible at a glance
@@ -94,10 +105,12 @@ set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
 
 rm -f /tmp/_t1.log
-# 24 min: the suite has grown a subsystem per PR — PR 10's memwatch
-# default-on registrations plus two new test files pushed a loaded box
-# past the old 1140s budget (a fully-green run was killed at 93%)
-timeout -k 10 1440 env JAX_PLATFORMS=cpu \
+# 30 min: the suite has grown a subsystem per PR — PR 10's memwatch
+# default-on registrations pushed a loaded box past the old 1140s
+# budget (a fully-green run was killed at 93%), and the boot/readiness
+# drills (a third cold-booting replica child in the kill drill) pushed
+# a loaded box past 1440s (killed at ~70%)
+timeout -k 10 1800 env JAX_PLATFORMS=cpu \
     TFDE_GRAD_TRANSPORT="${TFDE_GRAD_TRANSPORT:-fp32}" \
     TFDE_OPT_SHARDING="${TFDE_OPT_SHARDING:-replicated}" \
     TFDE_PREFIX_CACHE="${TFDE_PREFIX_CACHE:-off}" \
@@ -112,6 +125,8 @@ timeout -k 10 1440 env JAX_PLATFORMS=cpu \
     TFDE_ADMIT_KV_HEADROOM="${TFDE_ADMIT_KV_HEADROOM:-0}" \
     TFDE_USAGE_LOG="${TFDE_USAGE_LOG:-off}" \
     TFDE_CAPACITY_BUDGET_BYTES="${TFDE_CAPACITY_BUDGET_BYTES:-0}" \
+    TFDE_BOOT_READY_REQUIRE="${TFDE_BOOT_READY_REQUIRE:-on}" \
+    TFDE_BOOT_READY_GRACE_S="${TFDE_BOOT_READY_GRACE_S:-120}" \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     --durations=10 \
