@@ -1678,6 +1678,81 @@ def _bench_serve(clock: _Clock, smoke: bool) -> dict:
     )
     out["serve_prefix_parity_ok"] = warm_toks == ref_toks
 
+    # ---- paged-KV A/B (inference/paged.py): same byte budget, short ----
+    # requests. The block pool's capacity claim needs a number: a dense
+    # batcher allocates max_len cells per row up front, so a fixed KV
+    # byte budget affords batch = budget / row_bytes rows; the paged
+    # batcher allocates blocks_for(prompt + new + 1) blocks per row, so
+    # short requests (1 block here vs max_len/block = 5 dense) pack ~5x
+    # more concurrent rows into the SAME bytes. Both sides run under the
+    # same TFDE_CAPACITY_BUDGET_BYTES; the paged pool is sized to exactly
+    # the dense slab's bytes, and max in-flight rows is measured from the
+    # actual step loop, not computed. Greedy parity across the two runs
+    # rides along (same stream, same rids).
+    ab_batch = 2 if smoke else 4
+    ab_max_len, ab_block_rows = 80, 16 if smoke else 32
+    ab_new, ab_nreq = 6, (2 * ab_block_rows)
+    ab_model = GPT(vocab_size=512, hidden_size=64, depth=2, num_heads=2,
+                   mlp_dim=128, max_position=128, dtype=jnp.float32)
+    ab_params = ab_model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng3 = np.random.default_rng(11)
+    ab_reqs = [rng3.integers(0, ab_model.vocab_size, int(rng3.integers(4, 9)))
+               for _ in range(ab_nreq)]
+
+    def ab_run(paged: bool, budget: int):
+        from tfde_tpu.inference.prefix_cache import DEFAULT_BLOCK as _blk
+        kwargs = dict(batch_size=ab_batch, max_len=ab_max_len,
+                      scan_depth=depth, paged=False)
+        if paged:
+            usable = ab_batch * ab_max_len // _blk
+            kwargs = dict(batch_size=ab_block_rows, max_len=ab_max_len,
+                          scan_depth=depth, paged=True,
+                          pool_blocks=usable + 1)
+        prev_budget = os.environ.get("TFDE_CAPACITY_BUDGET_BYTES")
+        os.environ["TFDE_CAPACITY_BUDGET_BYTES"] = str(budget)
+        try:
+            b = ContinuousBatcher(ab_model, ab_params, **kwargs)
+        finally:
+            if prev_budget is None:
+                os.environ.pop("TFDE_CAPACITY_BUDGET_BYTES", None)
+            else:
+                os.environ["TFDE_CAPACITY_BUDGET_BYTES"] = prev_budget
+        for p in ab_reqs:
+            b.submit(p, ab_new)
+        fin, inflight, blk_active, blk_free = [], 0, 0, None
+        while not b.idle:
+            fin.extend(b.step())
+            inflight = max(inflight,
+                           sum(r is not None for r in b._req))
+            kv = b.kv_stats()
+            if "pool_blocks_active" in kv:
+                blk_active = max(blk_active, int(kv["pool_blocks_active"]))
+                free = int(kv["pool_blocks_free"])
+                blk_free = free if blk_free is None else min(blk_free, free)
+        toks = [list(map(int, t)) for _, t in sorted(fin)]
+        return toks, inflight, blk_active, blk_free, b.kv_stats()
+
+    # the budget is the DENSE slab's bytes — measured, not assumed
+    from tfde_tpu.observability.capacity import kv_slab_bytes as _ksb
+    probe = ContinuousBatcher(ab_model, ab_params, batch_size=ab_batch,
+                              max_len=ab_max_len, scan_depth=depth)
+    ab_budget = int(_ksb(probe._cache))
+    del probe
+    dense_toks, dense_rows, _a, _f, _kv = ab_run(False, ab_budget)
+    paged_toks, paged_rows, blk_active, blk_free, pkv = ab_run(
+        True, ab_budget)
+    out["serve_paged_budget_bytes"] = ab_budget
+    out["serve_max_inflight_rows"] = int(paged_rows)
+    out["serve_max_inflight_rows_dense"] = int(dense_rows)
+    out["serve_paged_inflight_gain"] = round(
+        paged_rows / max(dense_rows, 1), 2)
+    out["serve_kv_blocks_active"] = int(blk_active)
+    out["serve_kv_blocks_free"] = int(0 if blk_free is None else blk_free)
+    out["serve_paged_kv_waste_frac"] = round(
+        float(pkv.get("waste_frac", 0.0)), 4)
+    out["serve_paged_parity_ok"] = paged_toks == dense_toks
+
     # ---- tracing A/B (observability/trace.py): same stream, ring on ----
     # The zero-cost-when-off claim needs a number: re-run the serving
     # stream with every request carrying a trace id and the process ring
@@ -1996,6 +2071,17 @@ def _bench_serve_cluster(smoke: bool) -> dict:
         if occ:
             out["serve_cluster_kv_occupancy"] = round(
                 sum(occ) / len(occ), 4)
+        # block-pool columns (paged replicas only — the kv/pool_blocks_*
+        # gauges exist exactly when TFDE_PAGED_KV reached the children):
+        # summed across the fleet like headroom, the capacity story in
+        # blocks instead of rows
+        blk_act = [h["kv/pool_blocks_active"] for h in flat_hosts.values()
+                   if "kv/pool_blocks_active" in h]
+        if blk_act:
+            out["serve_cluster_kv_blocks_active"] = int(sum(blk_act))
+            out["serve_cluster_kv_blocks_free"] = int(sum(
+                h.get("kv/pool_blocks_free", 0)
+                for h in flat_hosts.values()))
         # cold-boot columns (informational, gate:false): the children
         # pushed their boot/* ledger gauges; report the slowest replica's
         # time-to-ready, its boot-attributed compile wall, and the mean

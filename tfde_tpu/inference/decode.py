@@ -56,7 +56,8 @@ def _make_model_step(decode_model, params):
     return model_step
 
 
-def _decode_clone(model, rolling: bool = False):
+def _decode_clone(model, rolling: bool = False, paged_blocks=None,
+                  kv_block=None):
     """The serving twin of a training model: decode on, remat off (remat
     only shapes the backward pass, which decode doesn't have — a training
     config with remat must not make the model unservable).
@@ -66,7 +67,12 @@ def _decode_clone(model, rolling: bool = False):
     sliding window — decode memory O(window) instead of O(budget). Only
     paths that NEVER rewind the cache may pass it (generate /
     generate_ragged / beam_search); speculative decoding's rewind would
-    alias committed slots."""
+    alias committed slots.
+
+    paged_blocks engages the paged KV pool (transformer.MultiHeadAttention
+    paged_blocks/kv_block, TFDE_PAGED_KV): K/V in one shared block pool
+    indexed through per-row block tables (inference/paged.py owns the
+    host-side allocation). Mutually exclusive with rolling."""
     if not hasattr(model, "decode"):
         raise ValueError(
             f"{type(model).__name__} has no decode mode — autoregressive "
@@ -78,6 +84,21 @@ def _decode_clone(model, rolling: bool = False):
     if (rolling and getattr(model, "sliding_window", None)
             and hasattr(model, "rolling_cache")):
         kw["rolling_cache"] = True
+    if paged_blocks is not None:
+        if rolling:
+            raise ValueError(
+                "paged_blocks and rolling are mutually exclusive cache "
+                "layouts"
+            )
+        if not hasattr(model, "paged_blocks"):
+            raise ValueError(
+                f"{type(model).__name__} has no paged KV support — "
+                f"TFDE_PAGED_KV needs a model threading paged_blocks "
+                f"through its attention layers (GPT)"
+            )
+        kw["paged_blocks"] = int(paged_blocks)
+        if kv_block is not None:
+            kw["kv_block"] = int(kv_block)
     return model.clone(**kw)
 
 
@@ -103,16 +124,19 @@ def validate_budget(model, prompt_len: int, max_new_tokens: int) -> int:
 
 
 def init_cache(model, batch_size: int, max_len: int,
-               rolling: bool = False):
+               rolling: bool = False, paged_blocks=None, kv_block=None):
     """Zero-filled "cache" collection for `model.clone(decode=True)` sized to
     a [batch_size, max_len] generation budget (window-bounded when
-    `rolling` — must match the decode clone's flag).
+    `rolling`, pool-shaped when `paged_blocks` — must match the decode
+    clone's flags).
 
     Uses `jax.eval_shape` on the decode-mode init, so no model compute (and
     no real parameter init) runs — only the cache pytree's shapes/dtypes are
     derived, then materialized as zeros.
     """
-    decode_model = _decode_clone(model, rolling=rolling)
+    decode_model = _decode_clone(model, rolling=rolling,
+                                 paged_blocks=paged_blocks,
+                                 kv_block=kv_block)
     tokens = jax.ShapeDtypeStruct((batch_size, max_len), jnp.int32)
 
     def _init(tokens):

@@ -1,0 +1,581 @@
+"""Paged KV: one block-granular pool shared by the prefix trie and rows.
+
+The dense serving cache allocates every row `max_len` cells up front, so
+`kv/waste_frac` (observability/capacity.py) reports everything a short
+request never touches as burned HBM, and the prefix trie keeps a SECOND
+copy of every cached prefix outside the slab. This module replaces both
+with the vLLM-style layout the capacity ledger was built to motivate
+(ROADMAP item 1):
+
+- **BlockPool** — the host-side allocator for the physical pool the
+  model owns as `pool_key`/`pool_value` cache variables ([num_blocks,
+  block, kv_heads, head_dim] per layer; models/transformer.py
+  `_paged_attention`). Blocks are refcounted so one physical block can
+  back the trie AND any number of active rows at once; block 0 is the
+  pinned null block (unallocated table slots point there, junk writes
+  land there). Free-list state is lock-guarded (`_lock` — the batcher's
+  step loop writes while HTTP handler threads read `stats()`; listed in
+  tools/tfdelint.py LOCKED_CLASSES).
+- **PagedPrefixCache** — the trie re-pointed at the pool: nodes hold
+  block IDS, not device segments, so a warm admission is "point the
+  row's block table at the matched blocks and incref them" (zero copy,
+  zero scatter) and a cold admission's complete prompt blocks join the
+  trie by incref alone. Eviction (LRU childless leaves, op-stamp
+  protected — the dense trie's exact policy) decrefs back to the pool,
+  and the pool calls back into it when allocation starves: ONE shared
+  LRU across cached prefixes and free space.
+- **`set_block_tables`** — host tables -> every layer's `block_table`
+  leaf (the per-row logical-block -> pool-block map the gather uses).
+
+Safety invariants (shared with `_paged_attention`'s docstring):
+- the trie holds only COMPLETE prompt blocks, and a warm row's first
+  write position (its block-aligned pre_len) opens a fresh private
+  block — shared blocks are never written after insertion;
+- junk writes (pad feeds of frozen or not-yet-admitted rows) land
+  beyond the writer's committed count: in its own allocated cells
+  (overwritten position-exactly before any validity mask reaches them)
+  or in the null block;
+- a freed row's table is re-pointed at the null block BEFORE its next
+  program runs, so its frozen one-past-committed pad writes can never
+  hit a reallocated block.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfde_tpu.observability import metrics
+from tfde_tpu.observability import trace as _trace
+from tfde_tpu.inference.prefix_cache import (
+    DEFAULT_BYTE_BUDGET,
+    is_index_leaf,
+)
+
+#: the null block: unallocated table slots point here, out-of-range
+#: writes are routed here — never allocated, never read through a mask
+NULL_BLOCK = 0
+
+
+def blocks_for(tokens: int, block: int) -> int:
+    """Pool blocks covering `tokens` cells (ceil division)."""
+    return -(-int(tokens) // int(block))
+
+
+def set_block_tables(cache, tables) -> object:
+    """Replace every layer's `block_table` leaf with host `tables`
+    ([B, nmax] int32). Each leaf gets its OWN device buffer (fresh
+    `jnp.asarray` per leaf) — the donated decode scan consumes its cache
+    argument, so aliasing one buffer across layers would hand jit the
+    same donated buffer twice (the `_set_index_counters` host-mode
+    rule)."""
+    tables = np.asarray(tables, np.int32)
+
+    def put(path, leaf):
+        if str(getattr(path[-1], "key", path[-1])) == "block_table":
+            return jnp.asarray(tables)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(put, cache)
+
+
+def pool_leaf_name(dense_name: str) -> str:
+    """Map a dense cache leaf name to its paged twin — the primed
+    hand-off ships `cached_key`/`cached_value` segments (layout-agnostic
+    [P, heads, dim]); the decode side lands them in `pool_key`/
+    `pool_value`."""
+    return (dense_name
+            .replace("cached_key", "pool_key")
+            .replace("cached_value", "pool_value"))
+
+
+def pool_bytes(cache) -> int:
+    """Total pool K/V bytes of a paged batcher cache (index leaves and
+    block tables excluded) — the paged ledger's capacity baseline."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+        name = str(getattr(path[-1], "key", path[-1]))
+        if is_index_leaf(path) or name == "block_table":
+            continue
+        total += int(leaf.nbytes)
+    return total
+
+
+class PoolExhausted(RuntimeError):
+    """Allocation could not be satisfied even after trie eviction —
+    admission's capacity gate exists to make this unreachable."""
+
+
+class BlockPool:
+    """Refcounted free-list allocator over the physical KV pool.
+
+    IDs are ints in [1, num_blocks) (0 is the null block). `alloc` takes
+    from the free list lowest-id-first (deterministic tests), calling the
+    registered evictor — the paged prefix trie — when it starves.
+    Listed in tools/tfdelint.py LOCKED_CLASSES: all shared state under
+    `_lock`; the evictor is invoked OUTSIDE the lock (it frees blocks
+    back through `free`, which takes the lock itself).
+    """
+
+    def __init__(self, num_blocks: int, block: int,
+                 registry: Optional[metrics.Registry] = None):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the pinned null "
+                f"block), got {num_blocks}"
+            )
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        self._lock = threading.Lock()
+        self._n = int(num_blocks)
+        self._block = int(block)
+        self._ref = np.zeros(self._n, np.int64)
+        self._ref[NULL_BLOCK] = 1          # pinned forever
+        self._free: List[int] = list(range(self._n - 1, 0, -1))  # pop -> 1
+        self._evictor: Optional[Callable[[int], int]] = None
+        self._reg = registry or metrics.default_registry()
+
+    # -- read surface --------------------------------------------------------
+    @property
+    def num_blocks(self) -> int:
+        return self._n
+
+    @property
+    def block(self) -> int:
+        return self._block
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def refcount(self, bid: int) -> int:
+        with self._lock:
+            return int(self._ref[bid])
+
+    def stats(self) -> dict:
+        with self._lock:
+            free = len(self._free)
+        return {
+            "total": self._n - 1,         # allocatable (null excluded)
+            "free": free,
+            "active": self._n - 1 - free,
+            "block": self._block,
+        }
+
+    # -- allocation ----------------------------------------------------------
+    def set_evictor(self, fn: Optional[Callable[[int], int]]) -> None:
+        """`fn(need_blocks) -> freed_blocks`, called un-locked when
+        `alloc` starves — the paged prefix trie's LRU drain."""
+        with self._lock:
+            self._evictor = fn
+
+    def available(self, evictable: int = 0) -> int:
+        """Blocks an allocation could obtain right now: the free list
+        plus what the evictor could reclaim (admission's capacity
+        gate)."""
+        with self._lock:
+            return len(self._free) + int(evictable)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take `n` blocks (refcount 1 each). Starvation drains the
+        evictor once; still short raises PoolExhausted with everything
+        rolled back."""
+        if n <= 0:
+            return []
+        got = self._take(n)
+        if len(got) < n and self._evictor is not None:
+            self._evictor(n - len(got))
+            got += self._take(n - len(got))
+        if len(got) < n:
+            self.free(got)
+            raise PoolExhausted(
+                f"need {n} KV blocks, pool has {len(got)} even after "
+                f"eviction (size the pool or gate admission)"
+            )
+        return got
+
+    def incref(self, ids) -> None:
+        """Share already-allocated blocks (warm admission / trie
+        insert)."""
+        with self._lock:
+            for b in ids:
+                if self._ref[b] < 1:
+                    raise ValueError(f"incref of unallocated block {b}")
+                self._ref[b] += 1
+
+    def free(self, ids) -> None:
+        """Drop one reference per id; a block at refcount 0 returns to
+        the free list."""
+        with self._lock:
+            for b in ids:
+                b = int(b)
+                if b == NULL_BLOCK:
+                    raise ValueError("the null block is pinned")
+                if self._ref[b] < 1:
+                    raise ValueError(f"double free of block {b}")
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    self._free.append(b)
+
+    def _take(self, n: int) -> List[int]:
+        with self._lock:
+            self._free.sort(reverse=True)   # deterministic lowest-first
+            got = []
+            while self._free and len(got) < n:
+                b = self._free.pop()
+                self._ref[b] = 1
+                got.append(b)
+            return got
+
+    # -- defrag --------------------------------------------------------------
+    def defrag(self) -> dict:
+        """Compact live blocks to the lowest ids; returns {old: new} for
+        every moved block and rewrites the pool's own refcounts/free
+        list. Fixed-size blocks can't fragment *allocatability* (any
+        free block serves any request), so this exists for locality and
+        for the device-side compaction drill — the caller must apply the
+        plan to the device pool and every block table (`apply_defrag`)
+        BEFORE the next program runs."""
+        with self._lock:
+            live = sorted(int(b) for b in range(1, self._n)
+                          if self._ref[b] > 0)
+            plan = {}
+            nxt = 1
+            for b in live:
+                if b != nxt:
+                    plan[b] = nxt
+                nxt += 1
+            if plan:
+                ref = np.zeros_like(self._ref)
+                ref[NULL_BLOCK] = 1
+                for b in live:
+                    ref[plan.get(b, b)] = self._ref[b]
+                self._ref = ref
+                self._free = list(range(self._n - 1, nxt - 1, -1))
+            return plan
+
+
+def apply_defrag(cache, tables: np.ndarray, plan: dict):
+    """Apply a `BlockPool.defrag` plan: gather every pool leaf's rows
+    into their new ids and rewrite the host tables. Returns (cache,
+    tables). One gather per leaf — defrag is a maintenance action, not
+    a hot-path one."""
+    if not plan:
+        return cache, tables
+    n = None
+    for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+        if str(getattr(path[-1], "key", path[-1])) == "pool_key":
+            n = leaf.shape[0]
+            break
+    perm = np.arange(n, dtype=np.int32)
+    for old, new in plan.items():
+        perm[new] = old
+
+    def mv(path, leaf):
+        name = str(getattr(path[-1], "key", path[-1]))
+        if name in ("pool_key", "pool_value"):
+            return leaf[jnp.asarray(perm)]
+        return leaf
+
+    cache = jax.tree_util.tree_map_with_path(mv, cache)
+    tables = np.asarray(
+        [[plan.get(int(b), int(b)) for b in row] for row in tables],
+        np.int32,
+    )
+    return cache, tables
+
+
+class _Node:
+    """One block of one cached prefix path (IDs, not segments)."""
+
+    __slots__ = ("key", "parent", "children", "bid", "last_used", "op")
+
+    def __init__(self, key, parent, bid: int):
+        self.key = key
+        self.parent = parent
+        self.children: dict = {}
+        self.bid = bid
+        self.last_used = 0
+        self.op = 0
+
+
+class PagedPrefixCache:
+    """The prefix trie re-pointed at the block pool.
+
+    Same token-block trie, LRU policy, op-stamp protection, and gauge
+    surface as `prefix_cache.PrefixCache`, but a node holds a pool block
+    ID the trie has ONE refcount on — lookup hands matched IDs to warm
+    admission (which increfs them into the row's table), insert adopts a
+    cold row's already-written blocks by incref (zero copy), and
+    eviction decrefs back to the pool. Registered as the pool's evictor,
+    so allocation pressure drains the trie LRU-first: one LRU shared
+    between cached prefixes and free space.
+
+    Single-threaded like the dense trie: only the batcher's step loop
+    touches it (the pool's lock covers the cross-thread reads).
+    """
+
+    def __init__(self, pool: BlockPool, block_bytes: float,
+                 byte_budget: int = DEFAULT_BYTE_BUDGET,
+                 registry: Optional[metrics.Registry] = None):
+        if byte_budget < 1:
+            raise ValueError(f"byte_budget must be >= 1, got {byte_budget}")
+        self._pool = pool
+        self._block = pool.block
+        self._block_bytes = float(block_bytes)
+        self._budget = int(byte_budget)
+        self._root = _Node(None, None, NULL_BLOCK)
+        self._segments = 0
+        self._clock = 0
+        self._op = 0
+        self._hits = 0
+        self._misses = 0
+        self._reused_tokens = 0
+        self._bytes_saved = 0
+        self._evictions = 0
+        self._reg = registry or metrics.default_registry()
+
+    # -- public -------------------------------------------------------------
+    @property
+    def block(self) -> int:
+        return self._block
+
+    @property
+    def byte_budget(self) -> int:
+        return self._budget
+
+    @property
+    def resident_bytes(self) -> int:
+        return int(self._segments * self._block_bytes)
+
+    @property
+    def segments(self) -> int:
+        return self._segments
+
+    def stats(self) -> dict:
+        total = self._hits + self._misses
+        return {
+            "hits": self._hits,
+            "misses": self._misses,
+            "hit_rate": self._hits / total if total else 0.0,
+            "reused_tokens": self._reused_tokens,
+            "bytes": self.resident_bytes,
+            "bytes_saved": self._bytes_saved,
+            "segments": self._segments,
+            "evictions": self._evictions,
+        }
+
+    def lookup(self, tokens, trace: Optional[str] = None,
+               claim: bool = False):
+        """Longest cached prefix usable for prompt `tokens`: ``(L,
+        [block ids])`` (L a block multiple, >= 1 suffix token left to
+        prefill) or ``(0, None)``. `claim=True` increfs the matched
+        blocks for the caller (warm admission's table reference), so no
+        eviction between plan and wave can invalidate the IDs — the
+        caller owns one `pool.free` per claimed block (`release` undoes
+        a partial claim)."""
+        tokens = np.asarray(tokens).reshape(-1)
+        p = int(tokens.size)
+        self._op += 1
+        usable = max((p - 1) // self._block, 0)
+        node, segs = self._root, []
+        while len(segs) < usable:
+            b = len(segs)
+            key = tuple(
+                int(t) for t in tokens[b * self._block:(b + 1) * self._block]
+            )
+            child = node.children.get(key)
+            if child is None:
+                break
+            segs.append(child)
+            node = child
+        if not segs:
+            self._misses += 1
+            self._publish()
+            if trace is not None:
+                _trace.event("serve/prefix_lookup", trace=trace,
+                             hit=False, reused_tokens=0)
+            return 0, None
+        for s in segs:
+            self._clock += 1
+            s.last_used = self._clock
+            s.op = self._op
+        ids = [s.bid for s in segs]
+        if claim:
+            self._pool.incref(ids)
+        n = len(segs)
+        self._hits += 1
+        self._reused_tokens += n * self._block
+        self._bytes_saved += int(n * self._block_bytes)
+        self._publish()
+        if trace is not None:
+            _trace.event("serve/prefix_lookup", trace=trace, hit=True,
+                         reused_tokens=n * self._block, prompt_tokens=p)
+        return n * self._block, ids
+
+    def release(self, ids) -> None:
+        """Undo a claim (a warm plan that shortened or dropped its
+        prefix after lookup)."""
+        self._pool.free(ids)
+
+    def insert(self, tokens, block_ids) -> int:
+        """Adopt the complete blocks of `tokens` whose K/V live in
+        `block_ids` (the admitting row's table prefix, already written
+        this wave). New nodes incref their block — the trie's own
+        reference, independent of the row's. Returns NEW blocks
+        adopted; already-resident prefixes are LRU-touched only (the
+        row keeps its own private copy of the duplicate block — merging
+        would mean rewriting a live table mid-flight). Budget overruns
+        evict LRU-first; an unevictable overflow stops the walk."""
+        tokens = np.asarray(tokens).reshape(-1)
+        nb = min(int(tokens.size) // self._block, len(block_ids))
+        if nb == 0:
+            return 0
+        self._op += 1
+        node, created = self._root, 0
+        for b in range(nb):
+            key = tuple(
+                int(t) for t in tokens[b * self._block:(b + 1) * self._block]
+            )
+            child = node.children.get(key)
+            if child is None:
+                if ((self._segments + 1) * self._block_bytes > self._budget
+                        and not self._evict_blocks(1)):
+                    break
+                bid = int(block_ids[b])
+                self._pool.incref([bid])
+                child = _Node(key, node, bid)
+                node.children[key] = child
+                self._segments += 1
+                created += 1
+            self._clock += 1
+            child.last_used = self._clock
+            child.op = self._op
+            node = child
+        self._publish()
+        return created
+
+    def evictable_blocks(self) -> int:
+        """Childless segments outside the current op — what `evict`
+        could reclaim right now (the admission capacity gate's slack
+        term)."""
+        count = 0
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if not node.children and node.op != self._op:
+                count += 1
+        return count
+
+    def evict(self, need_blocks: int) -> int:
+        """Free >= `need_blocks` trie references LRU-first (childless
+        nodes, op-stamp protected); returns blocks freed. The pool's
+        registered evictor — a freed block only reaches the free list
+        once every sharing row has also released it."""
+        return self._evict_blocks(need_blocks)
+
+    # -- internals ----------------------------------------------------------
+    def _evict_blocks(self, need: int) -> int:
+        freed = 0
+        while freed < need:
+            victim, stack = None, [self._root]
+            while stack:
+                nxt = stack.pop()
+                for child in nxt.children.values():
+                    if child.children:
+                        stack.append(child)
+                    elif child.op != self._op and (
+                            victim is None
+                            or child.last_used < victim.last_used):
+                        victim = child
+            if victim is None:
+                break
+            del victim.parent.children[victim.key]
+            self._pool.free([victim.bid])
+            victim.bid = NULL_BLOCK
+            freed += 1
+            self._segments -= 1
+            self._evictions += 1
+        if freed:
+            self._publish()
+        return freed
+
+    def _publish(self) -> None:
+        g = self._reg.gauge
+        total = self._hits + self._misses
+        g("serving/prefix_hits").set(self._hits)
+        g("serving/prefix_misses").set(self._misses)
+        g("serving/prefix_hit_rate").set(
+            self._hits / total if total else 0.0
+        )
+        g("serving/prefix_reused_tokens").set(self._reused_tokens)
+        g("serving/prefix_bytes").set(self.resident_bytes)
+        g("serving/prefix_bytes_saved").set(self._bytes_saved)
+        g("serving/prefix_segments").set(self._segments)
+        g("serving/prefix_evictions").set(self._evictions)
+        ref = evictable = 0
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node.op == self._op:
+                ref += 1
+            elif not node.children:
+                evictable += 1
+        g("kv/trie_blocks").set(self._segments)
+        g("kv/trie_bytes").set(self.resident_bytes)
+        g("kv/trie_referenced_frac").set(
+            ref / self._segments if self._segments else 0.0
+        )
+        g("kv/trie_evictable_bytes").set(
+            int(evictable * self._block_bytes)
+        )
+
+
+def resolve_paged(spec, pool: BlockPool, block_bytes: float
+                  ) -> Optional[PagedPrefixCache]:
+    """`prefix_cache.resolve` for paged mode: same ``TFDE_PREFIX_CACHE``
+    normalization, but the result shares `pool` instead of holding
+    device segments. A dense `PrefixCache` instance is refused — its
+    segments can't back block tables."""
+    if spec is None:
+        spec = os.environ.get("TFDE_PREFIX_CACHE", "off").strip().lower()
+        if spec in ("", "off", "0", "false", "no"):
+            return None
+        if spec in ("on", "1", "true", "yes"):
+            return PagedPrefixCache(pool, block_bytes)
+        try:
+            return PagedPrefixCache(pool, block_bytes,
+                                    byte_budget=int(spec))
+        except ValueError:
+            warnings.warn(
+                f"TFDE_PREFIX_CACHE={spec!r} is not a recognized value "
+                f"(off/on/<int byte budget>); prefix cache stays off",
+                stacklevel=2,
+            )
+            return None
+    if isinstance(spec, PagedPrefixCache):
+        if spec._pool is not pool:
+            raise ValueError(
+                "prefix_cache instance was built over a different "
+                "BlockPool than this batcher's"
+            )
+        return spec
+    if spec in (False, 0, "off"):
+        return None
+    if spec in (True, "on"):
+        return PagedPrefixCache(pool, block_bytes)
+    if isinstance(spec, int):
+        return PagedPrefixCache(pool, block_bytes, byte_budget=spec)
+    raise ValueError(
+        f"unrecognized prefix_cache spec for paged mode: {spec!r} "
+        f"(a dense PrefixCache cannot back block tables)"
+    )

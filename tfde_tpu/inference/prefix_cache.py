@@ -46,11 +46,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tfde_tpu import knobs
 from tfde_tpu.observability import metrics
 from tfde_tpu.observability import trace as _trace
 
 DEFAULT_BYTE_BUDGET = 256 * 1024 * 1024
-DEFAULT_BLOCK = 16
+#: single source of truth for the trie chunk AND the paged pool's block
+#: granularity (TFDE_KV_BLOCK) — inference/paged.py imports this too
+DEFAULT_BLOCK = knobs.env_int("TFDE_KV_BLOCK")
 
 #: cache-collection leaves that are bookkeeping, not K/V — never cached
 INDEX_LEAVES = ("cache_index", "position_index")

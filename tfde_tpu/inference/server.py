@@ -70,7 +70,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tfde_tpu import knobs
 from tfde_tpu.inference import admission as _admission
+from tfde_tpu.inference import paged as _paged
 from tfde_tpu.inference.decode import (
     _decode_clone,
     init_cache,
@@ -78,6 +80,7 @@ from tfde_tpu.inference.decode import (
     validate_budget,
 )
 from tfde_tpu.inference.prefix_cache import (
+    DEFAULT_BLOCK,
     is_index_leaf,
     leaf_name,
     resolve as _resolve_prefix,
@@ -330,6 +333,83 @@ def _scatter_primed_rows(cache, kv, rows):
     return jax.tree_util.tree_map_with_path(merge, cache)
 
 
+@functools.partial(jax.jit, static_argnames=("model",), donate_argnums=(1,))
+def _paged_prefill_chunk(model, cache, params, tokens, idx, take, last_in,
+                         prev):
+    """ONE chunk of paged prefill over the FULL batch — the pad-ladder
+    compile collapse.
+
+    The dense path compiles a prefill per (prompt bucket, wave width)
+    cell; under paging the writes scatter through each row's block
+    table, so admission instead feeds prompts through this single
+    [B, C] program chunk-by-chunk: `tokens` carries chunk j of each
+    admitting row's suffix (pad elsewhere), `idx` [B] the chunk's start
+    position per row — an admitting row's `pre_len + j*C`, an exhausted
+    or non-wave row's committed count. Any shape of (prompt length,
+    admitting rows) is just a different DATA pattern, so the program
+    compiles ONCE per batcher (tests/test_paged.py pins it).
+
+    Junk discipline: rows not writing real tokens this chunk still
+    write C pad K/V cells, all beyond their committed count — into
+    their own allocated-uncommitted cells (overwritten position-exactly
+    before any validity mask reaches them) or the null block. `take`
+    marks rows whose TRUE last prompt position falls in this chunk (at
+    chunk-local `last_in`); their final-position logits replace their
+    slot in the `prev` [B, V] carry, so after the last chunk every
+    admitting row's first-token logits are in hand without per-bucket
+    gather programs. Donates `cache` like every prefill."""
+    cache = _set_index_counters(cache, idx)
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, tokens, train=False,
+        mutable=["cache"],
+    )
+    ar = jnp.arange(tokens.shape[0])
+    out = jnp.where(take[:, None],
+                    logits[ar, last_in].astype(jnp.float32), prev)
+    return mutated["cache"], out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("temperature", "top_k", "top_p", "min_p",
+                     "repetition_penalty"),
+)
+def _sample_first(logits, rng, seen, temperature, top_k, top_p, min_p,
+                  repetition_penalty):
+    """First-token sampling for a paged admission wave: the chunk loop
+    above hands back last-position logits; this samples them with the
+    full config (presence mask included — `seen` rows are rebuilt host-
+    side from prompt ids, the primed-wave idiom). Compiled per padded
+    wave width on the usual ladder; tiny (no cache, no model)."""
+    tok = sample_logits(
+        logits, rng, temperature=temperature, top_k=top_k, top_p=top_p,
+        min_p=min_p, repetition_penalty=repetition_penalty, seen=seen,
+    )
+    if seen is not None:
+        seen = seen.at[jnp.arange(tok.shape[0]), tok].set(True)
+    return tok, seen
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_primed_blocks(cache, kv, blk):
+    """Paged twin of `_scatter_primed_rows`: land shipped host K/V
+    (re-chunked to [R, NB, block, ...], dense leaf names) into the pool
+    blocks `blk` [R, NB] in one donated update. Slots past a row's
+    prompt blocks carry the null block and zero payload — identical-
+    value duplicate writes, so scatter order never matters. Block
+    tables and index counters pass through (the host uploaded tables
+    already)."""
+
+    def merge(path, big):
+        name = str(getattr(path[-1], "key", path[-1]))
+        if is_index_leaf(path) or name == "block_table":
+            return big
+        seg = kv[_paged.pool_leaf_name(leaf_name(path))]
+        return big.at[blk].set(seg.astype(big.dtype))
+
+    return jax.tree_util.tree_map_with_path(merge, cache)
+
+
 @dataclasses.dataclass
 class PrimedRequest:
     """A prefill-role replica's hand-off unit: everything a decode
@@ -404,6 +484,13 @@ class _PriorityDeque:
     def append(self, item,
                priority: str = _admission.DEFAULT_PRIORITY) -> None:
         self._lanes[priority].append(item)
+
+    def appendleft(self, item,
+                   priority: str = _admission.DEFAULT_PRIORITY) -> None:
+        """Put a dequeued item BACK at the front of its lane — the
+        capacity-gate requeue (the item keeps its FIFO slot; nothing
+        behind it in the lane overtakes it)."""
+        self._lanes[priority].appendleft(item)
 
     def popleft(self):
         for lane in self._lanes.values():
@@ -511,6 +598,10 @@ class _BatcherBase:
         # unread stream entry would leak
         self._track_progress = False
         self._stream: dict = {}  # rid -> {"tokens", "taken", "done"}
+        # paged KV (TFDE_PAGED_KV): only ContinuousBatcher implements the
+        # block-pool layout; the flag lives on the base so the shared
+        # admission/step machinery can branch safely from any subclass
+        self._paged = False
         # recompile-sentinel fingerprint tag + the memory-ledger program
         # names this instance already registered (one interrogation per
         # pad-ladder bucket, not per wave)
@@ -723,6 +814,7 @@ class _BatcherBase:
             if self._req[r] == rid:
                 self._usage.finish(rid, len(self._out[r]),
                                    outcome="cancelled")
+                self._release_row(r)
                 self._req[r] = None
                 self._out[r] = []
                 self._budget[r] = 0
@@ -822,6 +914,34 @@ class _BatcherBase:
         which caches, which sampling config."""
         raise NotImplementedError
 
+    def _release_row(self, r: int) -> None:
+        """Row `r` just left the batch (completion / cancel) — return
+        any per-row cache resources. The dense slab has none; the paged
+        batcher frees the row's pool blocks and re-points its table at
+        the null block."""
+
+    def _admission_cost(self, item) -> int:
+        """Pool blocks queue `item` will claim at admission (0 for the
+        dense slab, whose per-row cost is the row itself)."""
+        return 0
+
+    def _admit_capacity(self, need: int) -> bool:
+        """Can the cache grant `need` more blocks right now (free list +
+        evictable trie)? The dense slab always can — a free row IS the
+        capacity. On False the item goes back to the FRONT of its lane
+        and admission stalls until a completion frees blocks."""
+        return True
+
+    def _admission_cells(self, kind: str, key, item) -> tuple:
+        """(allocated cells, real tokens) one admitted request cost the
+        prefill — the ledger's pad-waste unit. Dense: the pad-ladder
+        bucket vs the true prompt (suffix for warm groups). The paged
+        batcher overrides with block-granular numbers."""
+        _rid, prompt, _budget, _pr, _x = item
+        if kind == "warm":
+            return int(key[1]), int(prompt.size) - int(key[0])
+        return int(key), int(prompt.size)
+
     # -- internals ----------------------------------------------------------
     def _take_token(self, r: int, t: int) -> list:
         """Record a sampled token for row r; frees the row on completion."""
@@ -856,6 +976,7 @@ class _BatcherBase:
             self._deadline_at.pop(rid, None)
             self._usage.finish(rid, n, outcome="ok")
             done = (rid, np.asarray(self._out[r], np.int32))
+            self._release_row(r)
             self._req[r] = None
             self._out[r] = []
             self._committed[r] = 0
@@ -959,9 +1080,11 @@ class _BatcherBase:
         the same call."""
         finished = []
         reg = metrics.default_registry()
-        while self._queue and self.free_rows:
+        stalled = False
+        while self._queue and self.free_rows and not stalled:
             free = [r for r in range(self._b) if self._req[r] is None]
             wave = []
+            reserved = 0
             while self._queue and len(wave) < len(free):
                 item = self._queue.popleft()
                 # deadline shed happens HERE, at dequeue: a request whose
@@ -970,6 +1093,18 @@ class _BatcherBase:
                 # wave on tokens nobody is waiting for
                 if self._maybe_shed(item):
                     continue
+                # block-capacity gate (paged only): a request whose
+                # lifetime blocks don't fit the pool right now goes BACK
+                # to the front of its lane — admission stalls (head-of-
+                # line, deliberately: skipping ahead would starve big
+                # requests forever) until completions free blocks
+                need = self._admission_cost(item)
+                if need and not self._admit_capacity(reserved + need):
+                    self._requeue_front(item)
+                    reg.counter("serving/admit_capacity_stall").incr()
+                    stalled = True
+                    break
+                reserved += need
                 wave.append(item)
             taken = 0
             for kind, key, group in self._plan_wave(wave):
@@ -1005,10 +1140,10 @@ class _BatcherBase:
                 # pad-ladder accounting: the prefill program computed/
                 # wrote `alloc` cells per row (the group's bucket; for
                 # warm groups only the SUFFIX bucket — the prefix K/V
-                # landed unpadded), of which each request's true token
-                # count is real — the rest is the transient pad waste
-                # the ledger sizes paged-KV's win by
-                alloc = key[1] if kind == "warm" else int(key)
+                # landed unpadded; for paged groups the FRESH BLOCKS
+                # granted, so the histogram reads intra-block slack), of
+                # which each request's true token count is real — the
+                # rest is the waste the ledger sizes paged-KV's win by
                 for i, (rid, prompt, budget, _pr, _x) in enumerate(group):
                     r = rows[i]
                     self._req[r] = rid
@@ -1016,8 +1151,8 @@ class _BatcherBase:
                     self._budget[r] = budget
                     self._committed[r] = prompt.size
                     if self._ledger is not None:
-                        used = (prompt.size - key[0] if kind == "warm"
-                                else prompt.size)
+                        alloc, used = self._admission_cells(
+                            kind, key, group[i])
                         self._ledger.note_admission(kind, alloc, int(used))
                     self._usage.admitted(rid)
                     t0 = self._submitted_at.pop(rid, None)
@@ -1047,6 +1182,15 @@ class _BatcherBase:
                     finished.extend(self._take_token(r, int(toks[i])))
             self._mark_dirty()
         return finished
+
+    def _requeue_front(self, item) -> None:
+        """Put a dequeued-but-not-admittable item back at the head of
+        its priority lane (capacity stall — nothing overtakes it)."""
+        self._queue.appendleft(
+            item,
+            priority=self._priority.get(item[0],
+                                        _admission.DEFAULT_PRIORITY),
+        )
 
     def _maybe_shed(self, item) -> bool:
         """Deadline/TTL shedding: True when `item`'s queue wait already
@@ -1143,6 +1287,8 @@ class ContinuousBatcher(_BatcherBase):
         prefix_cache=None,
         role: str = "both",
         admission_ctl=None,
+        paged: Optional[bool] = None,
+        pool_blocks: Optional[int] = None,
     ):
         if repetition_penalty <= 0.0:
             raise ValueError(
@@ -1171,9 +1317,60 @@ class ContinuousBatcher(_BatcherBase):
         )
         self._vocab = model.vocab_size
 
+        # paged KV (TFDE_PAGED_KV, inference/paged.py): swap the dense
+        # per-row slab for the shared block pool + per-row block tables.
+        # `paged=None` defers to the knob; the dense path below stays
+        # byte-identical when off. `self._decode_model` remains the
+        # DENSE clone either way — prime() and the row templates speak
+        # the dense layout (the primed hand-off is layout-agnostic);
+        # only the resident batch cache and its programs go paged.
+        self._paged = (knobs.env_flag("TFDE_PAGED_KV") if paged is None
+                       else bool(paged))
+        if self._paged:
+            block = DEFAULT_BLOCK
+            self._kv_block = int(block)
+            # +1 cell: the decode scan writes one-past-committed for
+            # frozen rows, so a full row still has a mapped (or null)
+            # slot to take the junk write
+            self._nmax = -(-(self._max_len + 1) // block)
+            self._chunk = min(
+                max(1, knobs.env_int("TFDE_PAGED_PREFILL_CHUNK")),
+                self._max_len,
+            )
+            # default pool: every row can hold a full table, plus the
+            # null block — capacity-neutral vs the dense slab; size it
+            # DOWN (the bench's A/B) to serve more rows than the dense
+            # slab could under the same byte envelope
+            nblocks = (int(pool_blocks) if pool_blocks is not None
+                       else batch_size * self._nmax + 1)
+            if nblocks < self._nmax + 1:
+                raise ValueError(
+                    f"pool_blocks={nblocks} cannot hold even one "
+                    f"max-length row ({self._nmax} blocks + null)"
+                )
+            self._paged_model = _decode_clone(
+                model, paged_blocks=nblocks, kv_block=block)
+            raw = init_cache(model, batch_size, self._max_len,
+                             paged_blocks=nblocks, kv_block=block)
+            self._pool = _paged.BlockPool(nblocks, block)
+            self._tables = np.zeros((batch_size, self._nmax), np.int32)
+            self._row_blocks: list = [[] for _ in range(batch_size)]
+            self._shared_cells = np.zeros(batch_size, np.int64)
+            self._tables_dirty = False
+            # dense batch shapes (abstract — never materialized) still
+            # seed the row templates below: prime() prefills on the
+            # dense row layout
+            raw_shapes = jax.eval_shape(functools.partial(
+                init_cache, model, batch_size, self._max_len))
+        else:
+            self._paged_model = None
+            self._pool = None
+            raw = init_cache(model, batch_size, self._max_len)
+            raw_shapes = raw
+        # the decode scan's model: paged clone when on, dense otherwise
+        self._scan_model = self._paged_model or self._decode_model
         # index leaves become [B] vectors ONCE, so the scan carry shape is
         # stable from the first tick (the per-row decode-attention branch)
-        raw = init_cache(model, batch_size, self._max_len)
         self._cache = _set_index_counters(
             raw, np.zeros(batch_size, np.int32)
         )
@@ -1198,13 +1395,23 @@ class ContinuousBatcher(_BatcherBase):
                 else jax.ShapeDtypeStruct(
                     (_rp,) + s1.shape[1:], s1.dtype
                 ),
-                one, raw,
+                one, raw_shapes,
             )
             if rp >= batch_size:
                 break
             rp = min(rp * 2, batch_size)
-        # prefix-KV cache (prefix_cache.py): None = every admission cold
-        self._prefix = _resolve_prefix(prefix_cache)
+        # prefix-KV cache: None = every admission cold. Paged mode
+        # builds the trie over the POOL (block ids, zero-copy sharing)
+        # and registers it as the pool's eviction valve — allocation
+        # pressure drains cached prefixes LRU-first
+        if self._paged:
+            block_bytes = _paged.pool_bytes(self._cache) / float(nblocks)
+            self._prefix = _paged.resolve_paged(
+                prefix_cache, self._pool, block_bytes)
+            if self._prefix is not None:
+                self._pool.set_evictor(self._prefix.evict)
+        else:
+            self._prefix = _resolve_prefix(prefix_cache)
         # device-resident loop state (tok/idx/budget/done); rebuilt from
         # host bookkeeping whenever admission desyncs it
         self._dev = None
@@ -1247,6 +1454,16 @@ class ContinuousBatcher(_BatcherBase):
         )
         t0 = time.perf_counter()
         with span("serving/decode"):
+            if self._paged and self._tables_dirty:
+                # a released row's DEVICE table still points at its old
+                # blocks, and the frozen row keeps writing pad K/V at
+                # its stale position every tick — re-point it at the
+                # null block BEFORE any compiled program runs, or a
+                # reallocated block would take those writes
+                self._cache = _paged.set_block_tables(
+                    self._cache, self._tables)
+                self._tables_dirty = False
+                self._dispatches += 1
             if self._dev is None:
                 self._upload_state()
             tok, idx, budget, done = self._dev
@@ -1254,7 +1471,7 @@ class ContinuousBatcher(_BatcherBase):
             self._mem_register(
                 f"serve/decode/k{depth}",
                 functools.partial(
-                    _decode_scan, self._decode_model, depth=depth,
+                    _decode_scan, self._scan_model, depth=depth,
                     eos_id=self._eos, pad_id=self._pad, **self._sampling,
                 ),
                 (self._cache, self._params, tok, idx, budget, done,
@@ -1268,7 +1485,7 @@ class ContinuousBatcher(_BatcherBase):
             rc = _recompile.site("serve/decode", stable=True)
             with rc.watch(self._rc_tag, depth, traces=traced or None):
                 out = _decode_scan(
-                    self._decode_model, self._cache, self._params, tok, idx,
+                    self._scan_model, self._cache, self._params, tok, idx,
                     budget, done, self._seen, rng, depth=depth,
                     eos_id=self._eos, pad_id=self._pad, **self._sampling,
                 )
@@ -1319,6 +1536,18 @@ class ContinuousBatcher(_BatcherBase):
                 f"repetition_penalty is on; got "
                 f"[{int(prompt.min())}, {int(prompt.max())}]"
             )
+        if self._paged:
+            need = _paged.blocks_for(
+                int(prompt.size) + int(max_new_tokens) + 1,
+                self._kv_block)
+            if need > self._pool.num_blocks - 1:
+                # queue-time, like the vocab check: the capacity gate
+                # would requeue this request at the lane head forever
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool holds "
+                    f"{self._pool.num_blocks - 1}; raise pool_blocks or "
+                    f"shrink the request"
+                )
         super()._validate_submit(prompt, max_new_tokens)
 
     def _pick_depth(self, active) -> int:
@@ -1421,6 +1650,8 @@ class ContinuousBatcher(_BatcherBase):
         return self._prefix
 
     def _plan_wave(self, wave) -> list:
+        if self._paged:
+            return self._plan_paged_wave(wave)
         if self._prefix is None:
             return super()._plan_wave(wave)
         cold: dict = collections.OrderedDict()
@@ -1476,7 +1707,287 @@ class ContinuousBatcher(_BatcherBase):
     def _run_group(self, kind: str, key, group, rows) -> np.ndarray:
         if kind == "warm":
             return self._warm_wave(key, group, rows)
+        if kind == "paged":
+            return self._paged_wave(key, group, rows)
         return super()._run_group(kind, key, group, rows)
+
+    # -- paged KV (TFDE_PAGED_KV) --------------------------------------------
+    @property
+    def paged(self) -> bool:
+        return self._paged
+
+    @property
+    def block_pool(self):
+        """The shared BlockPool (None when dense) — bench/tests read
+        its stats; nothing else should allocate from it."""
+        return self._pool
+
+    def _init_capacity(self, cache, cells_per_row=None) -> None:
+        if not self._paged:
+            return super()._init_capacity(cache, cells_per_row)
+        cells = int(cells_per_row if cells_per_row is not None
+                    else self._max_len)
+        self._ledger = _capacity.PagedCapacityLedger(
+            self._b, cells, _paged.pool_bytes(cache),
+            self._pool.num_blocks, self._kv_block, self._paged_snapshot,
+        )
+        self._cap_model = _capacity.PagedCapacityModel(self._ledger)
+
+    def _paged_snapshot(self) -> dict:
+        """The paged ledger's duck-typed pool view (observability never
+        imports inference): pool stats + the trie/sharing split."""
+        snap = self._pool.stats()
+        snap["trie_blocks"] = (self._prefix.segments
+                               if self._prefix is not None else 0)
+        snap["shared_cells"] = int(self._shared_cells.sum())
+        return snap
+
+    def _release_row(self, r: int) -> None:
+        if not self._paged:
+            return
+        if self._row_blocks[r]:
+            self._pool.free(self._row_blocks[r])
+            self._row_blocks[r] = []
+        self._tables[r, :] = 0
+        self._shared_cells[r] = 0
+        # the device copy of this table still points at the freed
+        # blocks; step()/the next wave re-uploads before any program
+        # runs (the freed-row junk-write hazard)
+        self._tables_dirty = True
+
+    def _admission_cost(self, item) -> int:
+        if not self._paged:
+            return 0
+        _rid, prompt, budget, _pr = item
+        # full lifetime, sharing ignored: a warm match only lowers the
+        # real claim, so the gate errs toward stalling one wave early,
+        # never toward PoolExhausted mid-wave
+        return _paged.blocks_for(int(prompt.size) + int(budget) + 1,
+                                 self._kv_block)
+
+    def _admit_capacity(self, need: int) -> bool:
+        if not self._paged:
+            return True
+        evictable = (self._prefix.evictable_blocks()
+                     if self._prefix is not None else 0)
+        return self._pool.available(evictable) >= need
+
+    def _admission_cells(self, kind: str, key, item) -> tuple:
+        if not self._paged:
+            return super()._admission_cells(kind, key, item)
+        _rid, prompt, _budget, _pr, extra = item
+        pre = int(extra[0]) if (kind == "paged" and extra is not None) else 0
+        block = self._kv_block
+        alloc = (_paged.blocks_for(int(prompt.size), block)
+                 - pre // block) * block
+        return alloc, int(prompt.size) - pre
+
+    def _plan_paged_wave(self, wave) -> list:
+        """Paged admission planning: cold and warm collapse into ONE
+        'paged' group — the chunk program is shape-blind to prompt
+        length and wave membership, so there is nothing to group BY
+        except the chunk width (its only static). Primed hand-offs keep
+        their per-bucket grouping (the shipped K/V stack is shaped by
+        the bucket). Warm lookups CLAIM their matched blocks here at
+        plan time (incref), so nothing between plan and wave — another
+        item's allocation draining the trie included — can invalidate
+        the ids; the claim is the row's own reference, released with
+        the rest of its blocks. A same-wave duplicate prompt still
+        misses (its twin's blocks enter the trie only after the wave) —
+        the dense intra-wave semantics."""
+        items: list = []
+        primed: dict = collections.OrderedDict()
+        for rid, prompt, budget, pr in wave:
+            if pr is not None:
+                bucket = next(b for b in self._buckets
+                              if b >= prompt.size)
+                primed.setdefault(bucket, []).append(
+                    (rid, prompt, budget, pr, None)
+                )
+                continue
+            pre_len, ids = 0, None
+            if self._prefix is not None:
+                pre_len, ids = self._prefix.lookup(
+                    prompt, trace=self._trace_ids.get(rid), claim=True)
+            items.append((rid, prompt, budget, None, (pre_len, ids)))
+        plans = [("paged", self._chunk, items)] if items else []
+        plans += [("primed", b, g) for b, g in primed.items()]
+        return plans
+
+    def _paged_wave(self, chunk: int, group, rows) -> np.ndarray:
+        """Admit a paged wave: point each row's table at its claimed
+        trie blocks plus freshly-allocated lifetime blocks, then feed
+        every suffix through the ONE full-batch chunk program — warm
+        admission's prefix cost is the incref, not a scatter.
+
+        Non-wave rows ride along as pad feeds at their committed index
+        (their junk lands in their own uncommitted cells or the null
+        block); exhausted wave rows pad at their prompt end. After the
+        last chunk each admitting row's true last-position logits sit
+        in the [B, V] carry; one small ladder-width program samples the
+        first tokens. Cold rows then seed the trie by ADOPTING their
+        own complete prompt blocks (incref — zero copy)."""
+        n = len(group)
+        block = self._kv_block
+        starts = np.zeros(n, np.int64)
+        plens = np.zeros(n, np.int64)
+        for i, (rid, prompt, budget, _pr, extra) in enumerate(group):
+            r = rows[i]
+            pre_len, ids = extra if extra is not None else (0, None)
+            shared = [int(b) for b in ids] if ids else []
+            nblk = _paged.blocks_for(prompt.size + budget + 1, block)
+            fresh = self._pool.alloc(nblk - len(shared))
+            held = shared + fresh
+            self._row_blocks[r] = held
+            self._tables[r, :len(held)] = held
+            self._tables[r, len(held):] = 0
+            self._shared_cells[r] = pre_len
+            starts[i] = pre_len
+            plens[i] = prompt.size
+        self._cache = _paged.set_block_tables(self._cache, self._tables)
+        self._tables_dirty = False
+        self._dispatches += 1
+        nchunks = -(-int((plens - starts).max()) // chunk)
+        prev = jnp.zeros((self._b, self._vocab), jnp.float32)
+        for j in range(nchunks):
+            tokens = np.full((self._b, chunk), self._pad, np.int32)
+            idx = np.asarray(self._committed, np.int32)
+            take = np.zeros(self._b, bool)
+            last_in = np.zeros(self._b, np.int32)
+            for i in range(n):
+                r = rows[i]
+                prompt = group[i][1]
+                s = int(starts[i]) + j * chunk
+                e = min(int(plens[i]), s + chunk)
+                if s < plens[i]:
+                    tokens[r, :e - s] = prompt[s:e]
+                    idx[r] = s
+                    if e == plens[i]:
+                        take[r] = True
+                        last_in[r] = e - 1 - s
+                else:
+                    idx[r] = plens[i]  # exhausted: pads beyond own prompt
+            args = (self._cache, self._params, jnp.asarray(tokens),
+                    jnp.asarray(idx), jnp.asarray(take),
+                    jnp.asarray(last_in), prev)
+            self._mem_register(
+                f"serve/prefill_paged/c{chunk}",
+                functools.partial(_paged_prefill_chunk, self._paged_model),
+                args, donated=self._cache,
+            )
+            self._cache, prev = _paged_prefill_chunk(
+                self._paged_model, *args)
+            self._dispatches += 1
+        rp = _pad_wave(n, self._b)
+        pick = np.asarray([rows[i if i < n else 0] for i in range(rp)],
+                          np.int32)
+        wave_logits = prev[jnp.asarray(pick)]
+        self._dispatches += 1
+        seen_dev = None
+        if self._seen is not None:
+            seen_rows = np.zeros((rp, self._vocab), bool)
+            for i in range(rp):
+                seen_rows[i, group[i if i < n else 0][1]] = True
+            seen_dev = jnp.asarray(seen_rows)
+        rng = None
+        if self._sampling["temperature"] != 0.0:
+            self._rng, rng = jax.random.split(self._rng)
+        tok, seen_out = _sample_first(wave_logits, rng, seen_dev,
+                                      **self._sampling)
+        self._dispatches += 1
+        if seen_out is not None:
+            rows_pad = np.asarray(
+                list(rows) + [rows[0]] * (rp - n), np.int32)
+            if rp > n:
+                # the dense dup-row rule: duplicate scatter targets must
+                # carry identical values (padding rows drew their own
+                # first token under temperature > 0)
+                sel = np.arange(rp)
+                sel[n:] = 0
+                seen_out = seen_out[jnp.asarray(sel)]
+            self._seen = self._seen.at[jnp.asarray(rows_pad)].set(seen_out)
+            self._dispatches += 1
+        tok_np = _fetch(tok)
+        self._syncs += 1
+        if self._prefix is not None:
+            for i in range(n):
+                _rid, prompt, _budget, _pr, extra = group[i]
+                if extra is not None and extra[0]:
+                    continue  # warm rows don't re-insert (dense parity)
+                nb = prompt.size // block
+                if nb:
+                    self._prefix.insert(
+                        prompt, self._row_blocks[rows[i]][:nb])
+        return tok_np
+
+    def _primed_paged_wave(self, bucket: int, group, rows) -> np.ndarray:
+        """Primed hand-off under paging: allocate each row's lifetime
+        blocks, re-chunk the shipped host K/V (dense leaf names,
+        layout-agnostic [P, ...] segments) to block granularity, and
+        land it with ONE donated pool scatter — still zero model flops
+        on the decode replica. Compiled per (bucket, wave width) like
+        the dense primed path: the K/V stack is shipped data; there is
+        no program to collapse."""
+        n = len(group)
+        block = self._kv_block
+        rp = _pad_wave(n, self._b)
+        nb_bucket = _paged.blocks_for(bucket, block)
+        blk = np.zeros((rp, nb_bucket), np.int32)
+        toks = np.zeros(rp, np.int64)
+        seen_rows = (
+            np.zeros((rp, self._vocab), bool)
+            if self._seen is not None else None
+        )
+        sample = group[0][3].kv
+        stacked = {
+            _paged.pool_leaf_name(name): np.zeros(
+                (rp, nb_bucket, block) + arr.shape[1:], arr.dtype)
+            for name, arr in sample.items()
+        }
+        for i in range(rp):
+            _rid, prompt, budget, pr, _x = group[i if i < n else 0]
+            if i < n:
+                r = rows[i]
+                nblk = _paged.blocks_for(prompt.size + budget + 1, block)
+                fresh = self._pool.alloc(nblk)
+                self._row_blocks[r] = fresh
+                self._tables[r, :nblk] = fresh
+                self._tables[r, nblk:] = 0
+                self._shared_cells[r] = 0
+                nbp = _paged.blocks_for(prompt.size, block)
+                blk[i, :nbp] = fresh[:nbp]
+                for name, arr in pr.kv.items():
+                    dst = stacked[_paged.pool_leaf_name(name)]
+                    flat = dst[i].reshape(
+                        (nb_bucket * block,) + arr.shape[1:])
+                    flat[:arr.shape[0]] = arr
+            # padding rows (i >= n) keep null targets AND zero payload:
+            # every duplicate write to block 0 lands the same zeros, so
+            # scatter order never matters
+            toks[i] = pr.first_token
+            if seen_rows is not None:
+                seen_rows[i, prompt] = True
+                seen_rows[i, pr.first_token] = True
+        self._cache = _paged.set_block_tables(self._cache, self._tables)
+        self._tables_dirty = False
+        self._dispatches += 1
+        kv_dev = {name: jnp.asarray(b) for name, b in stacked.items()}
+        blk_dev = jnp.asarray(blk)
+        self._mem_register(
+            f"serve/prefill_primed/b{bucket}r{rp}",
+            _scatter_primed_blocks,
+            (self._cache, kv_dev, blk_dev),
+            donated=self._cache,
+        )
+        self._cache = _scatter_primed_blocks(self._cache, kv_dev, blk_dev)
+        self._dispatches += 1
+        if seen_rows is not None:
+            rows_pad = np.asarray(
+                list(rows) + [rows[0]] * (rp - n), np.int32)
+            self._seen = self._seen.at[jnp.asarray(rows_pad)].set(
+                jnp.asarray(seen_rows))
+            self._dispatches += 1
+        return toks  # first tokens are host-known: no sync on this path
 
     def _warm_wave(self, key, group, rows) -> np.ndarray:
         """Admit rows whose prompt prefix is cached: land the prefix K/V
@@ -1577,7 +2088,11 @@ class ContinuousBatcher(_BatcherBase):
             **self._sampling,
         )
         self._dispatches += 1
-        if self._prefix is not None:
+        if self._prefix is not None and not self._paged:
+            # the paged trie holds POOL BLOCK IDS; prime() runs on the
+            # dense row layout (the hand-off is layout-agnostic), so
+            # its segments have no block to adopt — only locally
+            # admitted prompts seed the paged trie
             self._prefix.insert(prompts[0, :prompt.size], row_cache, 0)
         kv = {}
         for path, leaf in jax.tree_util.tree_leaves_with_path(row_cache):
@@ -1603,6 +2118,8 @@ class ContinuousBatcher(_BatcherBase):
         """Admit rows primed on another replica: stack the shipped host
         K/V, one donated multi-row scatter, zero model flops here — the
         decode scan never waits behind a long-prompt prefill."""
+        if self._paged:
+            return self._primed_paged_wave(bucket, group, rows)
         n = len(group)
         rp = _pad_wave(n, self._b)
         rows_pad = np.asarray(rows + [rows[0]] * (rp - n), np.int32)

@@ -230,6 +230,24 @@ _register(
          "Serving prefix-KV cache default for every ContinuousBatcher: "
          "off (default), on (default budget), or an integer byte budget.",
          "inference/prefix_cache.py"),
+    Knob("TFDE_PAGED_KV", "flag", False, (),
+         "Paged KV serving: replace the dense per-row KV slabs with one "
+         "block-granular pool shared by the prefix trie and active decode "
+         "rows (inference/paged.py). Off (default) keeps the dense path "
+         "byte-identical.",
+         "inference/paged.py, inference/server.py"),
+    Knob("TFDE_KV_BLOCK", "int", 16, (),
+         "KV block size in tokens — the single source of truth for both "
+         "the prefix trie's chunk length and the paged pool's block "
+         "granularity. Any positive value works; 16 matches the trie's "
+         "historical chunking.",
+         "inference/paged.py, inference/prefix_cache.py"),
+    Knob("TFDE_PAGED_PREFILL_CHUNK", "int", 64, (),
+         "Token chunk width of the single paged prefill program; cold "
+         "and warm admission feed prompts through it chunk-by-chunk so "
+         "one static program covers every (prompt length, rows) shape "
+         "(clamped to max_len at batcher construction).",
+         "inference/paged.py, inference/server.py"),
     Knob("TFDE_ADMIT_", "spec", None, (),
          "Serving admission-control family prefix (see members below); "
          "all caps default off, so admission control is opt-in.",

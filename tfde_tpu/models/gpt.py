@@ -54,6 +54,11 @@ class GPT(nn.Module):
     # rolling_cache) — set by _decode_clone(rolling=True) on paths that
     # never rewind the cache
     rolling_cache: bool = False
+    # paged KV pool (transformer.MultiHeadAttention paged_blocks/kv_block)
+    # — set by inference/paged._paged_clone under TFDE_PAGED_KV; None keeps
+    # the dense per-row slabs
+    paged_blocks: Optional[int] = None
+    kv_block: int = 16
     ln_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5 (models/convert.py)
     # 'learned' = GPT-2 absolute wpe table; 'rope' = rotary q/k rotation
     # (ops/rotary.py) — no position table, relative-position attention,
@@ -223,6 +228,8 @@ class GPT(nn.Module):
             window=self.sliding_window,
             window_pattern=self.sliding_window_pattern,
             rolling_cache=self.rolling_cache,
+            paged_blocks=self.paged_blocks,
+            kv_block=self.kv_block,
             attn_scale=self.attn_scale,
             attn_logit_cap=self.attn_logit_cap,
             norm=self.norm,

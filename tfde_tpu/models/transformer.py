@@ -122,6 +122,19 @@ class MultiHeadAttention(nn.Module):
     # rewind (inference/decode.generate/generate_ragged/beam_search) turn
     # it on via _decode_clone(rolling=True).
     rolling_cache: bool = False
+    # paged KV cache (decode only, TFDE_PAGED_KV): K/V live in ONE shared
+    # physical pool of `paged_blocks` blocks x `kv_block` tokens
+    # ("pool_key"/"pool_value" cache vars) and each row carries a
+    # "block_table" [B, nmax] mapping its logical block to a pool block.
+    # Writes scatter by (table[pos // kv_block], pos % kv_block); attention
+    # gathers the row's table back into position order, so the SAME static
+    # program serves every (prompt length, rows) shape — the pad-ladder
+    # compile collapse (inference/paged.py owns allocation/refcounts).
+    # Block 0 is the null block: unallocated table slots point there and
+    # out-of-range writes are routed there, so junk never lands in a live
+    # block. Mutually exclusive with rolling_cache.
+    paged_blocks: Optional[int] = None
+    kv_block: int = 16
 
     @property
     def kv_heads(self) -> int:
@@ -256,6 +269,14 @@ class MultiHeadAttention(nn.Module):
         overwrite the last entries instead). inference/decode.generate sizes
         the cache to prompt + max_new_tokens exactly and can never overflow;
         direct drivers of this layer own the same invariant."""
+        if self.paged_blocks is not None:
+            if self.rolling_cache and self.window is not None:
+                raise NotImplementedError(
+                    "paged_blocks and rolling_cache are mutually exclusive "
+                    "cache layouts (a rolling slot can alias any pool "
+                    "block); pick one"
+                )
+            return self._paged_attention(q, k, v, batch)
         is_filled = self.has_variable("cache", "cached_key")
         rolling = self.rolling_cache and self.window is not None
         cache_shape = list(k.shape)
@@ -341,6 +362,96 @@ class MultiHeadAttention(nn.Module):
         # grouped_attention == reference_attention at kv_heads == num_heads;
         # with GQA the kv_heads-shaped cache feeds the einsum directly (no
         # expanded copy on the bandwidth-bound decode path)
+        return attn_lib.grouped_attention(
+            q, k_all, v_all, mask=valid, scale=self.attn_scale,
+            logit_cap=self.attn_logit_cap,
+        )
+
+    def _paged_attention(self, q, k, v, batch) -> jax.Array:
+        """Paged decode attention: write this call's K/V into pool blocks
+        through the row's block table, gather the table back into position
+        order, attend under the same `j <= index + i` validity mask as the
+        dense path.
+
+        Bit-exactness with the dense slab: the gathered [B, nmax*block]
+        keys are in position order (table slot s holds positions
+        [s*block, (s+1)*block)), so column j of the gather IS position j —
+        identical to the dense cache column-for-column up to max_len, plus
+        trailing columns the mask zeroes exactly (grouped_attention masks
+        with finfo.min, so masked weights are exactly 0.0 and garbage
+        columns contribute exact-zero terms to both the softmax numerator
+        and denominator).
+
+        Junk-write invariant (same as dense, plus the null-block routing):
+        any write at a position beyond a row's committed count lands either
+        in the row's own allocated-but-uncommitted cells (overwritten
+        position-exactly before any mask reaches them), in an unallocated
+        table slot (block 0), or past the table entirely (`slot >= nmax`,
+        routed to block 0). Shared (refcounted) trie blocks are never
+        written: the trie only holds COMPLETE prompt blocks, and a warm
+        row's first write position >= pre_len is block-aligned into its
+        own private block."""
+        is_filled = self.has_variable("cache", "pool_key")
+        block = self.kv_block
+        bsz = k.shape[0]
+        pool_shape = (self.paged_blocks, block, k.shape[2], k.shape[3])
+        pool_key = self.variable("cache", "pool_key", jnp.zeros,
+                                 pool_shape, k.dtype)
+        pool_value = self.variable("cache", "pool_value", jnp.zeros,
+                                   pool_shape, v.dtype)
+        # nmax from the init call's [B, max_len] budget input; +1 because
+        # the decode scan writes one-past-committed for finished rows
+        block_table = self.variable(
+            "cache", "block_table", jnp.zeros,
+            (bsz, -(-(k.shape[1] + 1) // block)), jnp.int32)
+        cache_index = self.variable("cache", "cache_index",
+                                    lambda: jnp.zeros((), jnp.int32))
+        if not is_filled:
+            # init pass: pool/table variables just created — plain causal
+            # attention over the budget input, exactly like the dense init
+            q, k = self._rotate(q, k, jnp.zeros((), jnp.int32))
+            return attn_lib.grouped_attention(
+                q, k, v, causal=True, window=self.window,
+                scale=self.attn_scale, logit_cap=self.attn_logit_cap,
+            )
+        sq = q.shape[1]
+        nmax = block_table.value.shape[1]
+        idx = cache_index.value
+        q, k = self._rotate(q, k, idx)
+        # scalar (shared) or [B] per-row indices both become [B] — the
+        # paged program is per-row by construction
+        idxv = jnp.broadcast_to(jnp.asarray(idx, jnp.int32), (bsz,))
+        pos = idxv[:, None] + jnp.arange(sq, dtype=jnp.int32)  # [B, sq]
+        slot = pos // block
+        off = pos % block
+        table = block_table.value  # [B, nmax]
+        rows = jnp.arange(bsz, dtype=jnp.int32)[:, None]
+        # out-of-table writes go to the null block, never a live one
+        blk = jnp.where(slot < nmax,
+                        table[rows, jnp.clip(slot, 0, nmax - 1)], 0)
+        # sanitize the write: junk positions (a rider row pad-fed past its
+        # committed count during a chunked prefill) can carry non-finite
+        # activations — e.g. a learned position embedding looked up past
+        # max_position fills NaN — and a masked column's exact-zero weight
+        # still poisons the output through 0 * NaN. nan_to_num is identity
+        # on every finite (legit) value, so bit-exactness is untouched;
+        # it only guarantees the POOL itself never holds a non-finite cell
+        k_pool = pool_key.value.at[blk, off].set(
+            jnp.nan_to_num(k.astype(pool_key.value.dtype)))
+        v_pool = pool_value.value.at[blk, off].set(
+            jnp.nan_to_num(v.astype(pool_value.value.dtype)))
+        # gather the row's table into position order: [B, nmax*block, Kv, D]
+        k_all = k_pool[table].reshape(bsz, nmax * block, *k.shape[2:])
+        v_all = v_pool[table].reshape(bsz, nmax * block, *v.shape[2:])
+        cols = jnp.arange(nmax * block, dtype=jnp.int32)[None, None, :]
+        valid = cols <= pos[:, :, None]  # [B, sq, nmax*block]
+        if self.window is not None:
+            valid = jnp.logical_and(valid, pos[:, :, None] - cols
+                                    < self.window)
+        valid = valid[:, None]
+        pool_key.value = constrain(k_pool, None, None, "tensor")
+        pool_value.value = constrain(v_pool, None, None, "tensor")
+        cache_index.value = idx + sq
         return attn_lib.grouped_attention(
             q, k_all, v_all, mask=valid, scale=self.attn_scale,
             logit_cap=self.attn_logit_cap,
@@ -511,6 +622,8 @@ class TransformerBlock(nn.Module):
     quant: Optional[str] = None  # int8 serving twins (MultiHeadAttention)
     window: Optional[int] = None  # sliding window (MultiHeadAttention)
     rolling_cache: bool = False  # window-bounded decode cache (MHA)
+    paged_blocks: Optional[int] = None  # paged KV pool (MultiHeadAttention)
+    kv_block: int = 16  # paged pool block size in tokens (TFDE_KV_BLOCK)
     attn_scale: Optional[float] = None    # Gemma-2 (MultiHeadAttention)
     attn_logit_cap: Optional[float] = None
     norm_style: str = "pre"
@@ -559,6 +672,8 @@ class TransformerBlock(nn.Module):
             quant=self.quant,
             window=self.window,
             rolling_cache=self.rolling_cache,
+            paged_blocks=self.paged_blocks,
+            kv_block=self.kv_block,
             attn_scale=self.attn_scale,
             attn_logit_cap=self.attn_logit_cap,
             use_bias=self.use_bias,
@@ -689,6 +804,8 @@ class Encoder(nn.Module):
     # odd blocks full attention (the Gemma-2 local/global interleave)
     window_pattern: str = "all"
     rolling_cache: bool = False
+    paged_blocks: Optional[int] = None
+    kv_block: int = 16
     attn_scale: Optional[float] = None
     attn_logit_cap: Optional[float] = None
     norm_style: str = "pre"
@@ -758,6 +875,8 @@ class Encoder(nn.Module):
                         if self.window_pattern == "all" or i % 2 == 0
                         else None),
                 rolling_cache=self.rolling_cache,
+                paged_blocks=self.paged_blocks,
+                kv_block=self.kv_block,
                 attn_scale=self.attn_scale,
                 attn_logit_cap=self.attn_logit_cap,
                 norm_style=self.norm_style,

@@ -5,14 +5,20 @@ eliminating pad-ladder waste — but nothing measured that waste, so the
 win could be neither sized in advance nor proven after. This module is
 the capacity half of the observability stack, three legs:
 
-- **CapacityLedger** — the dense-slab occupancy picture. The batcher
-  reports committed cells (the true per-row index) per decode round and
+- **CapacityLedger** — the occupancy picture, one subclass per cache
+  layout. The dense base reports the per-row slab: the batcher feeds
+  committed cells (the true per-row index) per decode round and
   pad-ladder allocation per admission wave; the ledger publishes the
   ``kv/{allocated_bytes,used_bytes,waste_frac,rows_active,rows_free}``
   gauges plus per-bucket pad-waste counters and a unit-interval waste
   histogram. ``kv/used_bytes`` is exact against
   `memwatch.device_bytes` over the live cache cells (tests pin 20%),
   because the per-cell cost is derived from the slab's own leaf bytes.
+  `PagedCapacityLedger` re-bases the same gauges on the block pool
+  (``TFDE_PAGED_KV``): allocated bytes are the blocks actually held,
+  so ``kv/waste_frac`` collapses to intra-block slack — the measured
+  statement of what paging reclaimed — and the ``kv/pool_blocks_*``
+  gauges split the pool into active/trie/free.
 - **CapacityModel** — headroom: memory budget (``TFDE_CAPACITY_BUDGET_
   BYTES``, 0 = slab-derived) folded with the measured per-row cost into
   ``kv/headroom_rows`` / ``kv/headroom_tokens``. `ReplicaServer /load`
@@ -207,6 +213,108 @@ class CapacityLedger:
             }
 
 
+class PagedCapacityLedger(CapacityLedger):
+    """Block-pool KV occupancy (``TFDE_PAGED_KV``).
+
+    The dense ledger's denominator is the whole pre-carved slab, so
+    ``kv/waste_frac`` charges every cell a short request never touches.
+    Under paging a row only holds the blocks it was granted, so the
+    honest denominator is the blocks ACTUALLY HELD (active rows + trie)
+    and the remaining waste is intra-block slack plus not-yet-decoded
+    lifetime blocks — the ISSUE's acceptance bound. `snapshot` is a
+    duck-typed callable (observability never imports inference)
+    returning::
+
+        {"total": .., "free": .., "active": ..,   # BlockPool.stats()
+         "trie_blocks": ..,                        # trie-held (refs)
+         "shared_cells": ..}                       # sum over rows of
+                                                   # trie-shared pre_len
+
+    ``used_bytes`` counts each resident token once: row-committed cells
+    minus the trie-shared cells they'd double-count, plus the trie's own
+    blocks. A block the trie evicted while a row still holds it is
+    undercounted by that row's shared cells — waste reads slightly high,
+    never low. Inherits `note_admission` (fed fresh-block cells per
+    admission, so the pad-waste histogram measures intra-block slack)
+    and the dense lock discipline.
+    """
+
+    def __init__(self, batch_size: int, cells_per_row: int,
+                 pool_bytes: int, num_blocks: int, block: int,
+                 snapshot,
+                 registry: Optional[metrics.Registry] = None):
+        super().__init__(batch_size, cells_per_row, pool_bytes,
+                         registry=registry)
+        if num_blocks < 2 or block < 1:
+            raise ValueError(
+                f"need num_blocks >= 2 and block >= 1, got "
+                f"{num_blocks}/{block}"
+            )
+        self._block = int(block)
+        self._blocks_total = int(num_blocks) - 1  # null block excluded
+        # per-cell cost re-based on the POOL's geometry (the null block
+        # included in the denominator: it is real allocated HBM)
+        self._cell_bytes = pool_bytes / float(num_blocks * block)
+        self._snapshot = snapshot
+
+    @property
+    def block(self) -> int:
+        return self._block
+
+    @property
+    def block_bytes(self) -> float:
+        return self._cell_bytes * self._block
+
+    @property
+    def row_bytes(self) -> float:
+        """Worst-case per-row cost: a full block table — the headroom
+        model's conservative admission unit."""
+        blocks_per_row = -(-self._cells // self._block)
+        return self.block_bytes * blocks_per_row
+
+    def observe(self, committed, req) -> dict:
+        used = 0
+        active = 0
+        for r in range(self._b):
+            if req[r] is not None:
+                active += 1
+                used += int(committed[r])
+        snap = self._snapshot()
+        trie_blocks = int(snap.get("trie_blocks", 0))
+        shared = int(snap.get("shared_cells", 0))
+        held = int(snap["active"])  # rows + trie, refcount-deduped
+        free = int(snap["free"])
+        used_cells = max(used - shared, 0) + trie_blocks * self._block
+        with self._lock:
+            self._used_cells = used_cells
+            self._rows_active = active
+        allocated = held * self.block_bytes
+        used_bytes = used_cells * self._cell_bytes
+        waste = (1.0 - used_bytes / allocated) if allocated else 0.0
+        g = self._reg.gauge
+        g("kv/allocated_bytes").set(allocated)
+        g("kv/used_bytes").set(used_bytes)
+        g("kv/waste_frac").set(waste)
+        g("kv/rows_active").set(active)
+        g("kv/rows_free").set(self._b - active)
+        g("kv/pool_blocks_total").set(self._blocks_total)
+        g("kv/pool_blocks_free").set(free)
+        g("kv/pool_blocks_active").set(held - trie_blocks)
+        g("kv/pool_blocks_trie").set(trie_blocks)
+        return {
+            "allocated_bytes": allocated,
+            "used_bytes": used_bytes,
+            "used_cells": used_cells,
+            "waste_frac": waste,
+            "rows_active": active,
+            "rows_free": self._b - active,
+            "pool_blocks_total": self._blocks_total,
+            "pool_blocks_free": free,
+            "pool_blocks_active": held - trie_blocks,
+            "pool_blocks_trie": trie_blocks,
+        }
+
+
 class CapacityModel:
     """Headroom: how many more rows/tokens fit before the memory budget.
 
@@ -242,6 +350,37 @@ class CapacityModel:
                        max(0, int(spare // self._ledger.row_bytes)))
             tokens = min(rows_free * self._ledger.cells_per_row,
                          max(0, int(spare // self._ledger.cell_bytes)))
+        g = self._reg.gauge
+        g("kv/headroom_rows").set(rows)
+        g("kv/headroom_tokens").set(tokens)
+        return {"headroom_rows": rows, "headroom_tokens": tokens}
+
+
+class PagedCapacityModel(CapacityModel):
+    """Headroom over a block pool: the admission currency is BLOCKS.
+
+    With no byte budget, what fits is whatever the free list (plus
+    nothing — trie slack is the admission gate's business) can grant:
+    ``headroom_tokens`` is the free blocks' cells and ``headroom_rows``
+    conservatively prices a row at a full block table (the worst case a
+    request may claim; the actual per-request block gate lives in the
+    batcher's `_admit_capacity`). A positive ``TFDE_CAPACITY_BUDGET_
+    BYTES`` first caps the grantable blocks at what the budget buys —
+    the same-envelope dense-vs-paged comparison the bench A/B runs.
+    """
+
+    def headroom(self, occ: dict) -> dict:
+        ledger = self._ledger
+        rows_free = int(occ["rows_free"])
+        free_blocks = int(occ.get("pool_blocks_free", 0))
+        if self.budget_bytes > 0:
+            held = int(occ.get("pool_blocks_active", 0)
+                       + occ.get("pool_blocks_trie", 0))
+            affordable = int(self.budget_bytes // ledger.block_bytes)
+            free_blocks = min(free_blocks, max(0, affordable - held))
+        blocks_per_row = -(-ledger.cells_per_row // ledger.block)
+        rows = min(rows_free, free_blocks // blocks_per_row)
+        tokens = free_blocks * ledger.block
         g = self._reg.gauge
         g("kv/headroom_rows").set(rows)
         g("kv/headroom_tokens").set(tokens)

@@ -131,6 +131,22 @@ def _serve_phase(inject: bool) -> None:
         if step > 200:
             raise RuntimeError("serve phase failed to drain")
 
+    # the same drain through the paged block pool: registers the paged
+    # prefill/decode sites and the pool's program footprint so the gate
+    # pins both layouts (the paged prefill is chunk-shaped, so mixed
+    # prompt lengths must NOT widen its compile count — the one-program
+    # claim the paged-KV PR makes)
+    paged = ContinuousBatcher(model, params, batch_size=4, max_len=48,
+                              scan_depth=4, paged=True)
+    for plen, n_new in [(3, 8), (6, 5), (4, 12), (7, 6), (3, 9), (5, 4)]:
+        paged.submit(rng.integers(0, model.vocab_size, plen), n_new)
+    step = 0
+    while not paged.idle:
+        paged.step()
+        step += 1
+        if step > 200:
+            raise RuntimeError("paged serve phase failed to drain")
+
 
 def observe() -> dict:
     """Run the workload; return {sites: {name: misses}, programs:

@@ -33,8 +33,11 @@ surfaces, composable in one invocation:
 - ``python tools/obs_dump.py --capacity [--router URL | <model_dir>]``
   — the KV-capacity view (WORKFLOWS.md §20): per-replica slab
   occupancy, pad-ladder waste, and headroom from the capacity ledger,
-  the top-waste-bucket callout (the cells paged-KV would reclaim), and
-  per-host ``metrics/usage_*.jsonl`` summaries.
+  the block-pool split (free / active / trie blocks with the
+  evictable-on-demand callout) when a replica runs paged KV
+  (``TFDE_PAGED_KV``, WORKFLOWS.md §22), the top-waste-bucket callout
+  (the cells paged-KV reclaims), and per-host
+  ``metrics/usage_*.jsonl`` summaries.
 - ``python tools/obs_dump.py --boot [--router URL | <model_dir>]`` —
   the cold-start view (WORKFLOWS.md §21): per-replica boot waterfall
   (phase durations process-birth → first token, restore bandwidth,
@@ -359,6 +362,54 @@ def _capacity_callout(per_bucket: dict) -> None:
           f"paged-KV slab reclaims (ROADMAP item 1)")
 
 
+_POOL_HEADER = (f"  {'host':>7} {'blocks':>7} {'free':>6} {'active':>7} "
+                f"{'trie':>6} {'pool_occ':>8} {'block_waste':>11}")
+
+
+def _pool_row(hid, kv: dict) -> str:
+    def _i(v):
+        return str(int(v)) if v is not None else "-"
+
+    total = kv.get("pool_blocks_total") or 0
+    free = kv.get("pool_blocks_free") or 0
+    act = kv.get("pool_blocks_active") or 0
+    trie = kv.get("pool_blocks_trie") or 0
+    occ = (act + trie) / total if total else 0.0
+    wf = kv.get("waste_frac")
+    return (f"  {str(hid):>7} {_i(total):>7} {_i(free):>6} {_i(act):>7} "
+            f"{_i(trie):>6} {occ:>8.3f} "
+            f"{(f'{wf:.3f}' if wf is not None else '-'):>11}")
+
+
+def _pool_section(per_host: dict) -> None:
+    """Block-pool view (paged KV, inference/paged.py): per replica the
+    pool split free / active-row / trie blocks, plus a fleet callout —
+    trie blocks are reclaimable on demand (the pool's evictor drains the
+    trie LRU before refusing an allocation), so real pressure is
+    active/total, not held/total. block_waste is the intra-block slack
+    fraction (committed tokens not filling their last block) — the only
+    waste mode a paged pool has left."""
+    rows = {h: kv for h, kv in per_host.items()
+            if kv.get("pool_blocks_total")}
+    if not rows:
+        return
+    print("  -- block pool (paged KV) --")
+    print(_POOL_HEADER)
+    tot = free = act = trie = 0
+    for hid in sorted(rows):
+        print(_pool_row(hid, rows[hid]))
+        tot += int(rows[hid].get("pool_blocks_total") or 0)
+        free += int(rows[hid].get("pool_blocks_free") or 0)
+        act += int(rows[hid].get("pool_blocks_active") or 0)
+        trie += int(rows[hid].get("pool_blocks_trie") or 0)
+    held = act + trie
+    if held:
+        print(f"  pool: {held}/{tot} blocks held, {free} free; "
+              f"{trie} ({trie / held:.0%} of held) are trie blocks — "
+              f"evictable on demand, so effective headroom is "
+              f"{free + trie} blocks")
+
+
 def dump_capacity(model_dir=None, router_url=None) -> int:
     """``--capacity``: the KV occupancy / pad-waste / headroom view —
     per replica from a LIVE router's /replicas kv table, or from the
@@ -377,6 +428,7 @@ def dump_capacity(model_dir=None, router_url=None) -> int:
         print(_CAPACITY_HEADER)
         for hid in sorted(kv):
             print(_capacity_row(hid, kv[hid]))
+        _pool_section(kv)
         per_bucket = {
             str(h["top_waste_bucket"]): h.get("top_waste_bucket_tokens", 0)
             for h in kv.values() if h.get("top_waste_bucket") is not None
@@ -391,6 +443,7 @@ def dump_capacity(model_dir=None, router_url=None) -> int:
     print(f"== capacity: {model_dir}")
     print(_CAPACITY_HEADER)
     per_bucket: dict = collections.Counter()
+    pool_hosts: dict = {}
     for p in logs:
         rows = _load_jsonl(p)
         if not rows:
@@ -412,6 +465,14 @@ def dump_capacity(model_dir=None, router_url=None) -> int:
             "headroom_tokens": flat.get("kv/headroom_tokens"),
             "trie_bytes": flat.get("kv/trie_bytes"),
         }))
+        if flat.get("kv/pool_blocks_total"):
+            pool_hosts[host] = {
+                "pool_blocks_total": flat.get("kv/pool_blocks_total"),
+                "pool_blocks_free": flat.get("kv/pool_blocks_free"),
+                "pool_blocks_active": flat.get("kv/pool_blocks_active"),
+                "pool_blocks_trie": flat.get("kv/pool_blocks_trie"),
+                "waste_frac": flat.get("kv/waste_frac"),
+            }
         pre = "kv/pad_waste_tokens/bucket_"
         for name, v in flat.items():
             if name.startswith(pre):
@@ -420,6 +481,7 @@ def dump_capacity(model_dir=None, router_url=None) -> int:
         print(f"  (no kv/* metrics in any snapshot under "
               f"{model_dir}/metrics — serving run without the ledger?)")
     else:
+        _pool_section(pool_hosts)
         _capacity_callout(dict(per_bucket))
 
     usage = sorted(glob.glob(

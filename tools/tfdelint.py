@@ -143,6 +143,16 @@ LOCKED_CLASSES: Dict[Tuple[str, str], LockSpec] = {
         # lock already held (the _locked suffix is the contract)
         exempt_methods=("_close_open_locked",),
     ),
+    # paged KV (PR 18): allocations/frees arrive from the batcher step
+    # loop under ReplicaServer.lock, but stats() is read from /load
+    # handler threads and the paged capacity ledger, so the free-list
+    # and refcounts carry their own lock
+    ("tfde_tpu/inference/paged.py", "BlockPool"): LockSpec(
+        lock="_lock",
+    ),
+    ("tfde_tpu/observability/capacity.py", "PagedCapacityLedger"): LockSpec(
+        lock="_lock",
+    ),
 }
 
 #: files whose jax.random.split calls must be temperature-guarded

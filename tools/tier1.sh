@@ -86,6 +86,17 @@
 #                                   is gated. TFDE_CAPACITY_BUDGET_BYTES
 #                                   forwards the same way and pins the
 #                                   headroom model's memory budget.)
+#        TFDE_PAGED_KV=on tools/tier1.sh
+#                                  (re-run with the block-granular paged
+#                                   KV pool enabled by default on every
+#                                   ContinuousBatcher — inference/paged.py;
+#                                   greedy outputs are pinned
+#                                   bit-identical to the dense slab, so
+#                                   the whole suite doubles as the
+#                                   paged-on parity sweep.
+#                                   TFDE_KV_BLOCK forwards the same way
+#                                   and must match the prefix trie's
+#                                   chunk size.)
 #        TFDE_BOOT_READY_REQUIRE=off tools/tier1.sh
 #                                  (re-run with the router's readiness
 #                                   gate disabled — traffic places on
@@ -125,6 +136,7 @@ timeout -k 10 1800 env JAX_PLATFORMS=cpu \
     TFDE_ADMIT_KV_HEADROOM="${TFDE_ADMIT_KV_HEADROOM:-0}" \
     TFDE_USAGE_LOG="${TFDE_USAGE_LOG:-off}" \
     TFDE_CAPACITY_BUDGET_BYTES="${TFDE_CAPACITY_BUDGET_BYTES:-0}" \
+    TFDE_PAGED_KV="${TFDE_PAGED_KV:-off}" \
     TFDE_BOOT_READY_REQUIRE="${TFDE_BOOT_READY_REQUIRE:-on}" \
     TFDE_BOOT_READY_GRACE_S="${TFDE_BOOT_READY_GRACE_S:-120}" \
     python -m pytest tests/ -q -m 'not slow' \
