@@ -263,7 +263,7 @@ def test_usage_totals_bit_exact_vs_solo_staggered(lm, rng, tmp_path,
     token, even across mid-flight admission on recycled rows."""
     monkeypatch.setenv("TFDE_USAGE_LOG", str(tmp_path / "usage.jsonl"))
     model, params = lm
-    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48)
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=48)
     reqs = [(rng.integers(0, 97, plen).astype(np.int64), n)
             for plen, n in [(3, 9), (5, 4), (2, 12), (7, 1), (4, 7)]]
     rids = [srv.submit(p, max_new_tokens=n) for p, n in reqs[:3]]

@@ -1,0 +1,463 @@
+"""Int8 KV cache (TFDE_KV_QUANT, ops/quant.kv_quantize + the
+transformer decode paths): the quantizer pinned bit-exact against a
+numpy hand oracle with its round-trip bound proven per vector, greedy
+serving parity int8-vs-fp through the REAL batcher (dense and paged,
+cold and warm-prefix, mid-flight cancel), the per-step logit-error
+bound, env-knob resolution, the compile pin (int8 adds ZERO extra
+prefill/decode programs), the dtype census + memwatch cross-check on
+int8 cells, and the stall-triggered pool defrag carrying the scale
+sidecars and the trie's block ids intact."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfde_tpu.inference import decode, paged, server
+from tfde_tpu.inference.server import ContinuousBatcher
+from tfde_tpu.models.gpt import gpt_tiny_test
+from tfde_tpu.observability import capacity, metrics
+from tfde_tpu.ops.quant import kv_dequantize, kv_quantize
+
+
+@pytest.fixture(scope="module")
+def lm():
+    m = gpt_tiny_test()
+    params = m.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+def _drain(b, reqs, budgets, max_steps=80):
+    ids = [b.submit(p, n) for p, n in zip(reqs, budgets)]
+    out = {}
+    for _ in range(max_steps):
+        for rid, toks in b.step():
+            out[rid] = list(map(int, toks))
+        if len(out) == len(ids):
+            break
+    assert len(out) == len(ids), "batcher did not drain"
+    return [out[i] for i in ids]
+
+
+def _match_rate(got, ref):
+    """Fraction of greedily matching tokens across the request set —
+    the acceptance metric (greedy-match >= 0.98)."""
+    hit = tot = 0
+    for g, r in zip(got, ref):
+        tot += max(len(g), len(r))
+        hit += sum(1 for a, b in zip(g, r) if a == b)
+    return hit / max(tot, 1)
+
+
+# the test_paged request stream: two admission waves over three rows,
+# one duplicate prompt (the warm trie case), mixed budgets
+_PROMPTS = [np.arange(3, 10) % 97, np.arange(5, 11) % 97,
+            np.arange(40, 59) % 97, np.arange(7, 12) % 97,
+            np.arange(40, 59) % 97]
+_BUDGETS = [8, 5, 12, 6, 9]
+
+
+# --------------------------------------------------------------------------
+# kv_quantize / kv_dequantize: oracle, bound, junk tolerance
+# --------------------------------------------------------------------------
+
+def _np_kv_quantize(x):
+    xf = np.nan_to_num(np.asarray(x, np.float32), posinf=0.0, neginf=0.0)
+    amax = np.max(np.abs(xf), axis=-1)
+    scale = np.maximum(amax, 1e-12) / 127.0
+    q = np.clip(np.round(xf / scale[..., None]), -127, 127).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def test_kv_quantize_matches_numpy_oracle(rng):
+    x = rng.standard_normal((3, 5, 4, 8)).astype(np.float32) * 7.0
+    q, s = kv_quantize(jnp.asarray(x))
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    assert q.shape == x.shape and s.shape == x.shape[:-1]
+    qr, sr = _np_kv_quantize(x)
+    np.testing.assert_array_equal(np.asarray(q), qr)
+    np.testing.assert_allclose(np.asarray(s), sr, rtol=0, atol=0)
+
+
+def test_kv_roundtrip_error_bound(rng):
+    """|x - dequant(quant(x))| <= amax/254 per vector: half a quant step
+    at the per-(position, head) grain — the bound the logit-error
+    budget in ISSUE/BASELINE derives from."""
+    x = rng.standard_normal((4, 9, 2, 16)).astype(np.float32)
+    x[0, 0] *= 1e3                    # wide dynamic range across vectors
+    x[1, 1] *= 1e-4
+    q, s = kv_quantize(jnp.asarray(x))
+    back = np.asarray(kv_dequantize(q, s, jnp.float32))
+    amax = np.max(np.abs(x), axis=-1, keepdims=True)
+    bound = amax / 254.0 + 1e-7
+    assert (np.abs(back - x) <= bound).all()
+    # dequantize honors the requested storage dtype
+    assert kv_dequantize(q, s, jnp.bfloat16).dtype == jnp.bfloat16
+
+
+def test_kv_quantize_survives_nonfinite_junk():
+    """Junk positions (the uninitialized-cache / masked-column hazard)
+    must not poison the scale or round-trip to NaN."""
+    x = np.zeros((2, 3, 4), np.float32)
+    x[0, 0, 0] = np.nan
+    x[1, 2, 1] = np.inf
+    x[0, 1, 2] = 5.0
+    q, s = kv_quantize(jnp.asarray(x))
+    assert np.isfinite(np.asarray(s)).all()
+    back = np.asarray(kv_dequantize(q, s, jnp.float32))
+    assert np.isfinite(back).all()
+    assert back[0, 1, 2] == pytest.approx(5.0, rel=1e-2)
+    # all-zero vectors quantize to zero, not to garbage via a 0 scale
+    assert (np.asarray(q)[1, :2] == 0).all()
+
+
+# --------------------------------------------------------------------------
+# Greedy parity through the real batcher: dense/paged x cold/warm
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("use_paged", [False, True])
+@pytest.mark.parametrize("prefix", [False, True])
+def test_int8_greedy_parity_multiwave(lm, use_paged, prefix):
+    model, params = lm
+    kw = dict(batch_size=3, max_len=48, scan_depth=4, prefix_cache=prefix)
+    ref = _drain(ContinuousBatcher(model, params, paged=False, **kw),
+                 _PROMPTS, _BUDGETS)
+    bq = ContinuousBatcher(model, params, paged=use_paged,
+                           kv_quant="int8", **kw)
+    got = _drain(bq, _PROMPTS, _BUDGETS)
+    assert _match_rate(got, ref) >= 0.98
+    if prefix:
+        assert bq._prefix.stats()["hits"] >= 1   # warm path exercised
+
+
+@pytest.mark.parametrize("use_paged", [False, True])
+def test_int8_parity_with_midflight_cancel(lm, use_paged):
+    """Cancel one row mid-decode: the survivors' int8 streams still
+    match the fp streams of the identical cancel schedule, and (paged)
+    the pool drains back to the trie-only residue."""
+    model, params = lm
+
+    def run(kv_quant):
+        b = ContinuousBatcher(model, params, batch_size=3, max_len=48,
+                              scan_depth=2, prefix_cache=False,
+                              paged=use_paged, kv_quant=kv_quant)
+        rids = [b.submit(p, n) for p, n in zip(_PROMPTS[:3], _BUDGETS[:3])]
+        out = {}
+        out.update(b.step())
+        assert b.cancel(rids[1])
+        for _ in range(60):
+            out.update(b.step())
+            if b.idle:
+                break
+        if use_paged:
+            assert b.block_pool.stats()["active"] == 0
+        return [list(map(int, out[r])) for r in (rids[0], rids[2])]
+
+    assert _match_rate(run("int8"), run("fp")) >= 0.98
+
+
+# --------------------------------------------------------------------------
+# Logit error: per-step bound against the fp reference
+# --------------------------------------------------------------------------
+
+def test_int8_logit_error_bounded_per_step(lm):
+    """Prefill + 6 greedy decode steps, logits captured per step from
+    the fp and int8 dense caches: max-abs logit error stays under the
+    budget the round-trip bound implies for this depth/width (observed
+    ~0.01; budget 0.1), and the argmax never flips."""
+    model, params = lm
+    prompt = (np.arange(11) * 5 + 2) % 97
+
+    def run(kv_quant):
+        dm = decode._decode_clone(model, kv_quant=kv_quant)
+        cache = decode.init_cache(model, 1, 24, kv_quant=kv_quant)
+        toks = jnp.asarray(prompt[None, :], jnp.int32)
+        logits, mut = dm.apply({"params": params, "cache": cache}, toks,
+                               train=False, mutable=["cache"])
+        cache = mut["cache"]
+        outs = [np.asarray(logits[:, -1], np.float32)]
+        tok = int(jnp.argmax(logits[0, -1]))
+        for _ in range(6):
+            logits, mut = dm.apply(
+                {"params": params, "cache": cache},
+                jnp.asarray([[tok]], jnp.int32), train=False,
+                mutable=["cache"])
+            cache = mut["cache"]
+            outs.append(np.asarray(logits[:, -1], np.float32))
+            tok = int(jnp.argmax(logits[0, -1]))
+        return outs, cache
+
+    fp, cache_fp = run(None)
+    q8, cache_q8 = run("int8")
+    for a, b in zip(fp, q8):
+        assert np.max(np.abs(a - b)) < 0.1
+        assert int(np.argmax(a)) == int(np.argmax(b))
+    # the cells themselves honor the round-trip bound plus a small
+    # propagation allowance: layer-0 cells see identical inputs in both
+    # runs (pure quantization error, amax/254); deeper layers project
+    # hidden states that already absorbed the lower layers' quant error
+    c = int(prompt.size) + 6
+
+    def leaves(cache, name):
+        return [leaf for p, leaf in
+                jax.tree_util.tree_leaves_with_path(cache)
+                if str(getattr(p[-1], "key", p[-1])) == name]
+
+    for kname in ("cached_key", "cached_value"):
+        for ql, sl, fl in zip(leaves(cache_q8, kname),
+                              leaves(cache_q8, kname + "_scale"),
+                              leaves(cache_fp, kname)):
+            back = np.asarray(kv_dequantize(ql, sl, jnp.float32))[:, :c]
+            ref = np.asarray(fl, np.float32)[:, :c]
+            bound = (np.max(np.abs(ref), -1, keepdims=True) / 254.0
+                     + 0.02)
+            assert (np.abs(back - ref) <= bound).all()
+
+
+# --------------------------------------------------------------------------
+# Env-knob resolution
+# --------------------------------------------------------------------------
+
+def _scale_leaves(cache):
+    return [str(getattr(p[-1], "key", p[-1])) for p, _ in
+            jax.tree_util.tree_leaves_with_path(cache)
+            if str(getattr(p[-1], "key", p[-1])).endswith("_scale")]
+
+
+def test_env_knob_selects_kv_quant(lm, monkeypatch):
+    model, params = lm
+    kw = dict(batch_size=2, max_len=32, scan_depth=2, prefix_cache=False)
+    monkeypatch.setenv("TFDE_KV_QUANT", "int8")
+    b = ContinuousBatcher(model, params, **kw)
+    assert b._kv_quant == "int8" and _scale_leaves(b._cache)
+    monkeypatch.setenv("TFDE_KV_QUANT", "fp")
+    b = ContinuousBatcher(model, params, **kw)
+    assert b._kv_quant is None and not _scale_leaves(b._cache)
+    # junk spelling: warn-and-default, never a crash mid-boot
+    monkeypatch.setenv("TFDE_KV_QUANT", "int5")
+    b = ContinuousBatcher(model, params, **kw)
+    assert b._kv_quant is None
+    # the explicit constructor arg overrides the env
+    b = ContinuousBatcher(model, params, kv_quant="int8", **kw)
+    assert b._kv_quant == "int8" and _scale_leaves(b._cache)
+
+
+def test_int8_refuses_rolling_and_bad_spelling(lm):
+    model, params = lm
+    with pytest.raises(ValueError, match="rolling"):
+        decode._decode_clone(model, rolling=True, kv_quant="int8")
+    with pytest.raises(ValueError, match="kv_quant"):
+        decode._decode_clone(model, kv_quant="int4")
+
+
+def test_int8_headroom_vs_fp_allocated_bytes(lm):
+    """The point of the exercise: at fp32 storage the int8 slab prices
+    >= 1.8x more rows into the same bytes (head_dim 8 here -> payload
+    4x smaller, scale sidecar 1/8 of a cell: ratio 4 / 1.5 = 2.67)."""
+    model, params = lm
+    kw = dict(batch_size=2, max_len=32, scan_depth=2, prefix_cache=False)
+    fp = ContinuousBatcher(model, params, kv_quant="fp", **kw)
+    q8 = ContinuousBatcher(model, params, kv_quant="int8", **kw)
+    ratio = fp.kv_stats()["allocated_bytes"] / q8.kv_stats()["allocated_bytes"]
+    assert ratio >= 1.8
+
+
+# --------------------------------------------------------------------------
+# Compile pin: int8 adds ZERO extra prefill/decode programs
+# --------------------------------------------------------------------------
+
+def _program_count():
+    return sum(f._cache_size() for f in (
+        server._decode_scan, server._prefill_rows, server._prefill_suffix,
+        server._paged_prefill_chunk))
+
+
+@pytest.mark.parametrize("use_paged", [False, True])
+def test_int8_compiles_no_extra_programs(lm, use_paged):
+    """Same request stream, fresh shape (batch 3 / max_len 44 is unique
+    to this test): the int8 drain must add exactly as many program
+    signatures as the fp drain — quantization changes leaf dtypes, not
+    the static program set."""
+    model, params = lm
+    kw = dict(batch_size=3, max_len=44, scan_depth=3, prefix_cache=False,
+              paged=use_paged)
+    deltas = []
+    for kv_quant in ("fp", "int8"):
+        before = _program_count()
+        _drain(ContinuousBatcher(model, params, kv_quant=kv_quant, **kw),
+               _PROMPTS, _BUDGETS)
+        deltas.append(_program_count() - before)
+    assert deltas[1] <= deltas[0], (
+        f"int8 compiled {deltas[1]} programs where fp compiled "
+        f"{deltas[0]} — the zero-extra-programs claim regressed"
+    )
+
+
+# --------------------------------------------------------------------------
+# Census + ledger: dtype-true byte accounting, memwatch cross-check
+# --------------------------------------------------------------------------
+
+def test_kv_dtype_census_hand_computed(lm):
+    model, _ = lm
+    # fp32 dense cache, B=2, S=16: per layer 2 x [2,16,4,8] f32 = 8192 B
+    fp = decode.init_cache(model, 2, 16)
+    c = capacity.kv_dtype_census(fp)
+    assert c["kv_dtype"] == "float32" and c["kv_quant_bits"] == 32
+    assert c["kv_payload_bytes"] == 2 * 2 * (2 * 16 * 4 * 8) * 4
+    assert c["kv_scale_bytes"] == 0
+    assert c["kv_fp32_equiv_bytes"] == c["kv_payload_bytes"]
+    # int8: payload shrinks 4x, scale sidecars [2,16,4] f32 appear
+    q8 = decode.init_cache(model, 2, 16, kv_quant="int8")
+    c = capacity.kv_dtype_census(q8)
+    assert c["kv_dtype"] == "int8" and c["kv_quant_bits"] == 8
+    assert c["kv_payload_bytes"] == 2 * 2 * (2 * 16 * 4 * 8)
+    assert c["kv_scale_bytes"] == 2 * 2 * (2 * 16 * 4) * 4
+    assert c["kv_fp32_equiv_bytes"] == 4 * c["kv_payload_bytes"]
+
+
+def test_int8_ledger_census_gauges_published(lm):
+    model, params = lm
+    b = ContinuousBatcher(model, params, batch_size=2, max_len=32,
+                          scan_depth=2, prefix_cache=False, kv_quant="int8")
+    s = b.kv_stats()
+    assert s["kv_quant_bits"] == 8
+    assert s["kv_payload_bytes"] + s["kv_scale_bytes"] == s["allocated_bytes"]
+    assert s["kv_fp32_equiv_bytes"] == 4 * s["kv_payload_bytes"]
+    reg = metrics.default_registry()
+    assert reg.get("kv/quant_bits").value == 8
+    assert reg.get("kv/payload_bytes").value == s["kv_payload_bytes"]
+
+
+def test_int8_used_bytes_matches_memwatch_device_bytes(lm, rng):
+    """The satellite-2 pin on int8 cells: mid-flight, the ledger's
+    used_bytes (per-cell cost from the slab's OWN bytes — int8 payload
+    plus fp32 scale sidecars) tracks memwatch.device_bytes over the
+    live cache cells within 20%."""
+    from tfde_tpu.inference.prefix_cache import is_index_leaf
+    from tfde_tpu.observability import memwatch
+
+    model, params = lm
+    srv = ContinuousBatcher(model, params, batch_size=3, max_len=48,
+                            kv_quant="int8")
+    for plen, n in [(5, 24), (9, 20), (3, 28)]:
+        srv.submit(rng.integers(0, 97, plen).astype(np.int64), n)
+    for _ in range(2):
+        srv.step()
+    s = srv.kv_stats()
+    assert s["rows_active"] == 3 and s["used_cells"] > 0
+    live = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(srv._cache):
+        if is_index_leaf(path):
+            continue
+        for r in range(3):
+            if srv._req[r] is not None and srv._committed[r]:
+                live.append(leaf[r: r + 1, : int(srv._committed[r])])
+    measured = memwatch.device_bytes(live)
+    assert measured > 0
+    assert s["used_bytes"] == pytest.approx(measured, rel=0.2)
+    srv.run()
+
+
+# --------------------------------------------------------------------------
+# Stall-triggered defrag: scale sidecars, trie ids, parity
+# --------------------------------------------------------------------------
+
+def test_pool_fragmentation_measure():
+    pool = paged.BlockPool(10, 16)
+    assert pool.fragmentation() == 0.0        # empty
+    a = pool.alloc(6)
+    assert pool.fragmentation() == 0.0        # dense prefix
+    pool.free([a[0], a[2], a[4]])             # live {2, 4, 6}
+    assert pool.fragmentation() == pytest.approx(0.5)
+    pool.defrag()                             # live -> {1, 2, 3}
+    assert pool.fragmentation() == 0.0
+
+
+def test_apply_defrag_moves_scale_sidecars():
+    n, blk = 6, 4
+    ids = jnp.arange(n, dtype=jnp.float32)
+    cache = {"layer": {
+        "pool_key": ids[:, None, None, None]
+        * jnp.ones((n, blk, 1, 1), jnp.float32),
+        "pool_key_scale": ids[:, None, None]
+        * jnp.ones((n, blk, 1), jnp.float32),
+        "pool_value": jnp.zeros((n, blk, 1, 1), jnp.float32),
+        "pool_value_scale": jnp.zeros((n, blk, 1), jnp.float32),
+    }}
+    tables = np.asarray([[4, 2, 0]], np.int32)
+    cache, tables = paged.apply_defrag(cache, tables, {2: 1, 4: 2})
+    assert tables.tolist() == [[2, 1, 0]]
+    sc = np.asarray(cache["layer"]["pool_key_scale"])[:, 0, 0]
+    assert sc[1] == 2.0 and sc[2] == 4.0      # sidecar followed its payload
+
+
+def test_trie_remap_follows_defrag_plan():
+    pool = paged.BlockPool(8, 4)
+    trie = paged.PagedPrefixCache(pool, block_bytes=64.0)
+    ids = pool.alloc(2)
+    toks = np.arange(9) % 7                   # 2 complete blocks
+    assert trie.insert(toks, ids) == 2
+    assert trie.remap({ids[0]: 6, ids[1]: 7}) == 2
+    got, matched = trie.lookup(toks)
+    assert got == 8 and matched == [6, 7]
+    assert trie.remap({}) == 0
+
+
+def test_stall_hook_fires_on_capacity_stall(lm, monkeypatch):
+    """The wiring: an admission that cannot fit the pool must invoke
+    _on_capacity_stall on the stall path."""
+    model, params = lm
+    b = ContinuousBatcher(model, params, batch_size=2, max_len=48,
+                          scan_depth=2, prefix_cache=False, paged=True,
+                          pool_blocks=5)          # 4 allocatable blocks
+    fired = []
+    monkeypatch.setattr(b, "_on_capacity_stall", lambda: fired.append(1))
+    first = b.submit(np.arange(25) % 97, 4)       # 2 blocks: admitted
+    b.step()
+    rid = b.submit(np.arange(40) % 97, 4)         # needs 3, 1 free: stalls
+    b.step()
+    assert fired
+    b.cancel(rid)
+    b.cancel(first)
+
+
+def test_defrag_on_stall_preserves_outputs(lm, monkeypatch):
+    """The end-to-end parity pin: with the threshold knob armed, a
+    defrag fired mid-flight on a fragmented int8 pool leaves every
+    token stream bit-identical, moves the trie's blocks, bumps the
+    kv/pool_defrags counter and drops a flightrec breadcrumb."""
+    from tfde_tpu.observability import flightrec
+
+    model, params = lm
+    prompts = _PROMPTS + [np.arange(17, 30) % 97]
+    budgets = _BUDGETS + [7]
+
+    def run(thr):
+        monkeypatch.setenv("TFDE_KV_DEFRAG_THRESHOLD", thr)
+        b = ContinuousBatcher(model, params, batch_size=3, max_len=48,
+                              scan_depth=2, prefix_cache=True, paged=True,
+                              kv_quant="int8")
+        ids = [b.submit(p, n) for p, n in zip(prompts, budgets)]
+        out, fired = {}, 0
+        for _ in range(80):
+            if b._pool.fragmentation() > 0 and not fired:
+                b._on_capacity_stall()
+                fired += 1
+            for rid, toks in b.step():
+                out[rid] = list(map(int, toks))
+            if len(out) == len(ids):
+                break
+        assert len(out) == len(ids)
+        return [out[i] for i in ids]
+
+    before = metrics.default_registry().counter("kv/pool_defrags").value
+    ref = run("0")                            # 0 disables: no defrag
+    assert metrics.default_registry().counter("kv/pool_defrags").value \
+        == before
+    got = run("0.01")
+    assert got == ref
+    after = metrics.default_registry().counter("kv/pool_defrags").value
+    assert after >= before + 1
+    crumbs = [e for e in flightrec.default_recorder().events()
+              if e["kind"] == "kv_defrag"]
+    assert crumbs and crumbs[-1]["moved"] >= 1

@@ -397,7 +397,7 @@ _REPLICA_CHILD = textwrap.dedent(
         max(time.perf_counter() - t0, 1e-9))
     os.remove(ckpt)
     led.begin("compile")
-    b = ContinuousBatcher(model, params, batch_size=2, max_len=64)
+    b = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=64)
     rng = np.random.default_rng(rid)
     for ln in (4, 6):   # warm the compiles before announcing the port
         b.submit(rng.integers(1, 90, ln), 6)

@@ -206,7 +206,7 @@ def test_paged_parity_vs_solo_generate(lm):
     """Each batched-paged output must equal the same request run alone
     through decode.generate — the no-scheduler reference."""
     model, params = lm
-    bp = ContinuousBatcher(model, params, batch_size=3, max_len=48,
+    bp = ContinuousBatcher(model, params, kv_quant="fp", batch_size=3, max_len=48,
                            scan_depth=4, paged=True, prefix_cache=False)
     got = _drain(bp, _PROMPTS, _BUDGETS)
     for p, n, toks in zip(_PROMPTS, _BUDGETS, got):

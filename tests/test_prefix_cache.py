@@ -178,7 +178,7 @@ def test_batcher_prefix_parity_greedy(lm, rng):
         for k in (3, 5, 2, 6)
     ]
     pc = PrefixCache(block=4)
-    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=64,
                             prefix_cache=pc)
     assert srv.prefix_cache is pc
     done = {}
@@ -239,7 +239,7 @@ def test_batcher_prefix_parity_long_suffix(lm, rng):
     model, params = lm
     sysp = rng.integers(1, 90, 32).astype(np.int64)
     pc = PrefixCache(block=16)
-    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=64,
                             prefix_cache=pc)
     done = {}
     r0 = srv.submit(sysp, 6)            # cold: seeds both prefix blocks
@@ -261,7 +261,7 @@ def test_batcher_prefix_parity_shrunk_prefix(lm, rng):
     the SLICED prefix K/V still matches solo bit for bit."""
     model, params = lm
     pc = PrefixCache(block=8)
-    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=64,
                             prompt_buckets=(8, 48, 64), prefix_cache=pc)
     base = rng.integers(1, 90, 32).astype(np.int64)
     done = {}
@@ -290,7 +290,7 @@ def test_batcher_prefix_parity_repetition_penalty(lm, rng):
         for k in (3, 4)
     ]
     pc = PrefixCache(block=4)
-    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64,
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=64,
                             repetition_penalty=1.3, prefix_cache=pc)
     done = {}
     r0 = srv.submit(prompts[0], 6)

@@ -29,6 +29,10 @@ def lm():
     return m, params
 
 
+# generate() is rolling-window and therefore always fp (int8 KV refuses
+# rolling), so every replica batcher in this file pins kv_quant="fp":
+# routing drills compare tokens bit-exact against this reference and
+# must stay exact under the TFDE_KV_QUANT=int8 tier-1 sweep.
 def _solo(model, params, prompt, n):
     prompt = np.asarray(prompt, np.int64)
     toks, lengths = generate(
@@ -39,7 +43,7 @@ def _solo(model, params, prompt, n):
 
 
 def _mk_replica(model, params, idx, role="both", batch=2):
-    b = ContinuousBatcher(model, params, batch_size=batch, max_len=64,
+    b = ContinuousBatcher(model, params, kv_quant="fp", batch_size=batch, max_len=64,
                           role=role)
     return ReplicaServer(b, replica_id=idx).start()
 
@@ -201,7 +205,7 @@ def test_prefill_tier_disaggregated_parity(lm, rng):
     """A prefill-role replica primes the prompt; the decode replica
     scatters the shipped K/V and streams — outputs must match solo."""
     model, params = lm
-    pre_b = ContinuousBatcher(model, params, batch_size=1, max_len=64,
+    pre_b = ContinuousBatcher(model, params, kv_quant="fp", batch_size=1, max_len=64,
                               role="prefill")
     pre = ReplicaServer(pre_b, replica_id=0).start()
     dec = _mk_replica(model, params, 1)
@@ -252,7 +256,7 @@ def test_replica_queue_full_maps_to_429_with_retry_after(lm, rng,
     from tfde_tpu.inference.admission import AdmissionController
 
     model, params = lm
-    b = ContinuousBatcher(model, params, batch_size=1, max_len=64,
+    b = ContinuousBatcher(model, params, kv_quant="fp", batch_size=1, max_len=64,
                           admission_ctl=AdmissionController(max_queue=1))
     rep = ReplicaServer(b, replica_id=0).start()
     try:
@@ -298,7 +302,7 @@ def test_router_rejects_fast_when_all_replicas_saturated(lm, rng,
     from tfde_tpu.observability import metrics
 
     model, params = lm
-    b = ContinuousBatcher(model, params, batch_size=1, max_len=64,
+    b = ContinuousBatcher(model, params, kv_quant="fp", batch_size=1, max_len=64,
                           admission_ctl=AdmissionController(max_queue=1))
     rep = ReplicaServer(b, replica_id=0).start()
     router = Router([rep.url]).start()
@@ -434,7 +438,7 @@ def _mk_booting_replica(model, params, idx, phase="warmup"):
     led = boot_lib.BootLedger(registry=metrics.Registry(),
                               compile_probe=lambda: (0, 0.0))
     led.begin(phase)
-    b = ContinuousBatcher(model, params, batch_size=2, max_len=64)
+    b = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=64)
     return ReplicaServer(b, replica_id=idx, boot_ledger=led).start(), led
 
 

@@ -19,6 +19,11 @@ def lm():
     return m, params
 
 
+# generate() is rolling-window and therefore always full-precision
+# (int8 KV refuses rolling), so tests that pin a batcher bit-exact
+# against this reference construct it with kv_quant="fp" — the
+# TFDE_KV_QUANT=int8 tier-1 sweep would otherwise flip near-tie
+# argmaxes (int8 parity is statistical, tests/test_kv_quant.py).
 def _solo(model, params, prompt, n, **kw):
     toks, lengths = generate(
         model, params, jnp.asarray(prompt[None, :], jnp.int32),
@@ -31,7 +36,7 @@ def _solo(model, params, prompt, n, **kw):
 @pytest.mark.slow
 def test_batch_of_varied_requests_matches_solo(lm, rng):
     model, params = lm
-    srv = ContinuousBatcher(model, params, batch_size=3, max_len=48)
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=3, max_len=48)
     reqs = {}
     for i, (plen, n) in enumerate([(3, 9), (5, 4), (2, 12), (7, 7), (4, 1),
                                    (6, 10), (3, 3)]):
@@ -51,7 +56,7 @@ def test_staggered_submission_mid_flight(lm, rng):
     """Requests submitted while others are mid-generation take freed rows
     and still match solo runs — the continuous part of the batching."""
     model, params = lm
-    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48)
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=48)
     p0 = rng.integers(0, 97, 4).astype(np.int64)
     p1 = rng.integers(0, 97, 3).astype(np.int64)
     r0 = srv.submit(p0, max_new_tokens=3)   # finishes quickly
@@ -75,7 +80,7 @@ def test_eos_and_instant_finish(lm, rng):
     free = _solo(model, params, prompt, 10)
     eos = int(free[2])  # third generated token
     ref = _solo(model, params, prompt, 10, eos_id=eos, pad_id=0)
-    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48,
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=48,
                             eos_id=eos)
     rid = srv.submit(prompt, max_new_tokens=10)
     one = srv.submit(prompt, max_new_tokens=1)  # budget-1: first token only
@@ -90,7 +95,7 @@ def test_rope_gqa_model(rng):
             max_position=64, dtype=jnp.float32, position="rope",
             num_kv_heads=2)
     params = m.init(jax.random.key(3), jnp.zeros((1, 8), jnp.int32))["params"]
-    srv = ContinuousBatcher(m, params, batch_size=2, max_len=40)
+    srv = ContinuousBatcher(m, params, kv_quant="fp", batch_size=2, max_len=40)
     prompts = [rng.integers(0, 97, p).astype(np.int64) for p in (3, 5, 4)]
     rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
     done = dict(srv.run())
@@ -285,7 +290,7 @@ def test_scan_depth_staggered_parity_sweep(lm, rng):
             for plen, n in [(3, 9), (5, 4), (2, 12), (7, 1), (4, 7)]]
     refs = [_solo(model, params, p, n) for p, n in reqs]
     for depth in (1, 2, 4):
-        srv = ContinuousBatcher(model, params, batch_size=2, max_len=48,
+        srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=48,
                                 scan_depth=depth)
         rids = [srv.submit(p, max_new_tokens=n) for p, n in reqs[:3]]
         done = dict(srv.step())  # late arrivals land on recycled rows
@@ -309,7 +314,7 @@ def test_eos_mid_scan(lm, rng):
     # depth-4 scan hits EOS on its 3rd tick — strictly mid-scan
     eos = int(free[3])
     ref = _solo(model, params, prompt, 12, eos_id=eos, pad_id=0)
-    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48,
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=48,
                             eos_id=eos, scan_depth=4)
     rid = srv.submit(prompt, max_new_tokens=12)
     done = dict(srv.run())
@@ -328,7 +333,7 @@ def test_budget_one_admitted_mid_flight(lm, rng):
     p_long = rng.integers(0, 97, 3).astype(np.int64)
     p_short = rng.integers(0, 97, 5).astype(np.int64)
     p_one = rng.integers(0, 97, 4).astype(np.int64)
-    srv = ContinuousBatcher(model, params, batch_size=2, max_len=48,
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=48,
                             scan_depth=2)
     r_long = srv.submit(p_long, max_new_tokens=12)
     r_short = srv.submit(p_short, max_new_tokens=3)
@@ -447,9 +452,9 @@ def test_role_split_primed_handoff_parity(lm, rng):
     plainly-submitted ones."""
     model, params = lm
     prompts = [rng.integers(1, 90, k).astype(np.int64) for k in (3, 7, 5, 4)]
-    pre = ContinuousBatcher(model, params, batch_size=1, max_len=64,
+    pre = ContinuousBatcher(model, params, kv_quant="fp", batch_size=1, max_len=64,
                             role="prefill")
-    dec = ContinuousBatcher(model, params, batch_size=4, max_len=64,
+    dec = ContinuousBatcher(model, params, kv_quant="fp", batch_size=4, max_len=64,
                             role="decode")
     primed = [pre.prime(p, 8) for p in prompts[:3]]
     rids = [dec.submit_primed(pr) for pr in primed]
@@ -469,7 +474,7 @@ def test_progress_streaming_matches_final_output(lm, rng):
     output — the SSE streaming surface (router.py) rides on this."""
     model, params = lm
     p = rng.integers(1, 90, 5).astype(np.int64)
-    srv = ContinuousBatcher(model, params, batch_size=2, max_len=64)
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=2, max_len=64)
     srv.enable_progress()
     rid = srv.submit(p, 6)
     got, done = [], False
@@ -512,7 +517,7 @@ def test_cancel_frees_row_and_queue(lm, rng):
     scan stops spending ticks on them, and the progress entry never
     leaks. The recycled row must then serve fresh work bit-identically."""
     model, params = lm
-    srv = ContinuousBatcher(model, params, batch_size=1, max_len=64)
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=1, max_len=64)
     srv.enable_progress()
     p = rng.integers(1, 90, 5).astype(np.int64)
     active = srv.submit(p, 40)
@@ -544,7 +549,7 @@ def test_admission_depth_cap_rejects_with_queue_full(lm, rng):
 
     model, params = lm
     srv = ContinuousBatcher(
-        model, params, batch_size=1, max_len=48,
+        model, params, kv_quant="fp", batch_size=1, max_len=48,
         admission_ctl=AdmissionController(max_queue=1),
     )
     p = rng.integers(1, 90, 4).astype(np.int64)
@@ -610,7 +615,7 @@ def test_priority_ordered_dequeue(lm, rng):
             order.append(rid)
     assert order == [blocker, r_in, r_ba, r_be]
     # parity rode along: re-run one of each against solo
-    srv2 = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    srv2 = ContinuousBatcher(model, params, kv_quant="fp", batch_size=1, max_len=48)
     rid = srv2.submit(p, 3, priority="best_effort")
     np.testing.assert_array_equal(dict(srv2.run())[rid],
                                   _solo(model, params, p, 3))
@@ -627,7 +632,7 @@ def test_expired_deadline_shed_before_prefill(lm, rng):
     model, params = lm
     reg = metrics.default_registry()
     reg.reset("serving/shed")
-    srv = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=1, max_len=48)
     srv.enable_progress()
     p = rng.integers(1, 90, 4).astype(np.int64)
     blocker = srv.submit(p, 6)
@@ -656,7 +661,7 @@ def test_forced_overload_fault_rejects_then_recovers(lm, rng):
     from tfde_tpu.resilience.faults import OverloadFault
 
     model, params = lm
-    srv = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=1, max_len=48)
     p = rng.integers(1, 90, 3).astype(np.int64)
     OverloadFault(seconds=30.0).fire("test")
     try:
@@ -698,7 +703,7 @@ def test_kv_headroom_gate_rejects_with_kv_payload(lm, rng):
 
     model, params = lm
     srv = ContinuousBatcher(
-        model, params, batch_size=2, max_len=48,
+        model, params, kv_quant="fp", batch_size=2, max_len=48,
         admission_ctl=AdmissionController(min_headroom_rows=2),
     )
     p = rng.integers(1, 90, 4).astype(np.int64)
@@ -751,7 +756,7 @@ def test_kv_headroom_default_off_admits_identically(lm, rng, monkeypatch):
     before this PR — memory pressure alone must not reject."""
     monkeypatch.delenv("TFDE_ADMIT_KV_HEADROOM", raising=False)
     model, params = lm
-    srv = ContinuousBatcher(model, params, batch_size=1, max_len=48)
+    srv = ContinuousBatcher(model, params, kv_quant="fp", batch_size=1, max_len=48)
     assert srv._admission.min_headroom_rows == 0
     assert not srv._admission.enabled
     p = rng.integers(1, 90, 4).astype(np.int64)

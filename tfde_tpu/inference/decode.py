@@ -57,7 +57,7 @@ def _make_model_step(decode_model, params):
 
 
 def _decode_clone(model, rolling: bool = False, paged_blocks=None,
-                  kv_block=None):
+                  kv_block=None, kv_quant=None):
     """The serving twin of a training model: decode on, remat off (remat
     only shapes the backward pass, which decode doesn't have — a training
     config with remat must not make the model unservable).
@@ -72,7 +72,13 @@ def _decode_clone(model, rolling: bool = False, paged_blocks=None,
     paged_blocks engages the paged KV pool (transformer.MultiHeadAttention
     paged_blocks/kv_block, TFDE_PAGED_KV): K/V in one shared block pool
     indexed through per-row block tables (inference/paged.py owns the
-    host-side allocation). Mutually exclusive with rolling."""
+    host-side allocation). Mutually exclusive with rolling.
+
+    kv_quant='int8' engages the quantized KV cache (TFDE_KV_QUANT): int8
+    payload + per-(position, kv-head) fp32 scale sidecars in either cache
+    layout, dequantized inside the attention program. 'fp'/None keep the
+    full-precision cache byte-identical. Mutually exclusive with rolling
+    (the modular slot rewrite has no scale plane)."""
     if not hasattr(model, "decode"):
         raise ValueError(
             f"{type(model).__name__} has no decode mode — autoregressive "
@@ -99,6 +105,25 @@ def _decode_clone(model, rolling: bool = False, paged_blocks=None,
         kw["paged_blocks"] = int(paged_blocks)
         if kv_block is not None:
             kw["kv_block"] = int(kv_block)
+    if kv_quant in ("fp", None):
+        kv_quant = None  # 'fp' is the knob spelling of the default
+    elif kv_quant == "int8":
+        if rolling:
+            raise ValueError(
+                "kv_quant='int8' and rolling are mutually exclusive cache "
+                "layouts (no scale plane for the modular slot rewrite)"
+            )
+        if not hasattr(model, "kv_quant"):
+            raise ValueError(
+                f"{type(model).__name__} has no quantized-KV support — "
+                f"TFDE_KV_QUANT needs a model threading kv_quant through "
+                f"its attention layers (GPT)"
+            )
+        kw["kv_quant"] = "int8"
+    else:
+        raise ValueError(
+            f"kv_quant must be None, 'fp' or 'int8', got {kv_quant!r}"
+        )
     return model.clone(**kw)
 
 
@@ -124,11 +149,12 @@ def validate_budget(model, prompt_len: int, max_new_tokens: int) -> int:
 
 
 def init_cache(model, batch_size: int, max_len: int,
-               rolling: bool = False, paged_blocks=None, kv_block=None):
+               rolling: bool = False, paged_blocks=None, kv_block=None,
+               kv_quant=None):
     """Zero-filled "cache" collection for `model.clone(decode=True)` sized to
     a [batch_size, max_len] generation budget (window-bounded when
-    `rolling`, pool-shaped when `paged_blocks` — must match the decode
-    clone's flags).
+    `rolling`, pool-shaped when `paged_blocks`, int8 + scale sidecars when
+    `kv_quant='int8'` — must match the decode clone's flags).
 
     Uses `jax.eval_shape` on the decode-mode init, so no model compute (and
     no real parameter init) runs — only the cache pytree's shapes/dtypes are
@@ -136,7 +162,7 @@ def init_cache(model, batch_size: int, max_len: int,
     """
     decode_model = _decode_clone(model, rolling=rolling,
                                  paged_blocks=paged_blocks,
-                                 kv_block=kv_block)
+                                 kv_block=kv_block, kv_quant=kv_quant)
     tokens = jax.ShapeDtypeStruct((batch_size, max_len), jnp.int32)
 
     def _init(tokens):

@@ -235,6 +235,18 @@ class BlockPool:
             return got
 
     # -- defrag --------------------------------------------------------------
+    def fragmentation(self) -> float:
+        """Holes over the occupied span of live ids: 1 - live/max(live)
+        (0.0 = perfectly compact or empty). Fixed-size blocks can't
+        fragment allocatability, so this measures LOCALITY — how far the
+        live set has drifted up the id space — and is the stall-path
+        defrag trigger's threshold input (TFDE_KV_DEFRAG_THRESHOLD)."""
+        with self._lock:
+            live = [b for b in range(1, self._n) if self._ref[b] > 0]
+            if not live:
+                return 0.0
+            return 1.0 - len(live) / float(max(live))
+
     def defrag(self) -> dict:
         """Compact live blocks to the lowest ids; returns {old: new} for
         every moved block and rewrites the pool's own refcounts/free
@@ -280,7 +292,10 @@ def apply_defrag(cache, tables: np.ndarray, plan: dict):
 
     def mv(path, leaf):
         name = str(getattr(path[-1], "key", path[-1]))
-        if name in ("pool_key", "pool_value"):
+        # the int8 scale sidecars (TFDE_KV_QUANT) ride the same block ids
+        # as their payload, so they permute with it or dequant breaks
+        if name in ("pool_key", "pool_value",
+                    "pool_key_scale", "pool_value_scale"):
             return leaf[jnp.asarray(perm)]
         return leaf
 
@@ -461,6 +476,24 @@ class PagedPrefixCache:
             node = child
         self._publish()
         return created
+
+    def remap(self, plan: dict) -> int:
+        """Rewrite node block ids after a `BlockPool.defrag` — the trie's
+        references moved with the pool's refcounts, so every node whose
+        bid appears in the plan must follow it before the next lookup
+        hands stale ids to a warm admission. Returns nodes remapped."""
+        if not plan:
+            return 0
+        moved = 0
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            new = plan.get(node.bid)
+            if new is not None:
+                node.bid = new
+                moved += 1
+        return moved
 
     def evictable_blocks(self) -> int:
         """Childless segments outside the current op — what `evict`

@@ -932,6 +932,12 @@ class _BatcherBase:
         and admission stalls until a completion frees blocks."""
         return True
 
+    def _on_capacity_stall(self) -> None:
+        """Admission just stalled on cache capacity — a subclass may use
+        the pause for bounded maintenance (the paged batcher's
+        stall-triggered pool defrag). The dense slab has nothing to
+        compact."""
+
     def _admission_cells(self, kind: str, key, item) -> tuple:
         """(allocated cells, real tokens) one admitted request cost the
         prefill — the ledger's pad-waste unit. Dense: the pad-ladder
@@ -1102,6 +1108,7 @@ class _BatcherBase:
                 if need and not self._admit_capacity(reserved + need):
                     self._requeue_front(item)
                     reg.counter("serving/admit_capacity_stall").incr()
+                    self._on_capacity_stall()
                     stalled = True
                     break
                 reserved += need
@@ -1289,6 +1296,7 @@ class ContinuousBatcher(_BatcherBase):
         admission_ctl=None,
         paged: Optional[bool] = None,
         pool_blocks: Optional[int] = None,
+        kv_quant: Optional[str] = None,
     ):
         if repetition_penalty <= 0.0:
             raise ValueError(
@@ -1300,7 +1308,16 @@ class ContinuousBatcher(_BatcherBase):
         super().__init__(model, params, batch_size, max_len, eos_id,
                          pad_id, rng, prompt_buckets, role=role,
                          admission_ctl=admission_ctl)
-        self._decode_model = _decode_clone(model)
+        # quantized KV cache (TFDE_KV_QUANT, ops/quant.kv_quantize): int8
+        # payload + fp32 scale sidecars in every cache layout this batcher
+        # builds — the batch slab/pool, the row templates, the prefix trie
+        # slices and the primed hand-off all inherit the leaf set from
+        # init_cache, so ONE resolution here covers them all. 'fp' (the
+        # default) keeps every tree and program byte-identical to before.
+        kvq = (knobs.env_choice("TFDE_KV_QUANT") if kv_quant is None
+               else str(kv_quant))
+        self._kv_quant = None if kvq == "fp" else kvq
+        self._decode_model = _decode_clone(model, kv_quant=self._kv_quant)
         self._sampling = dict(
             temperature=float(temperature),
             top_k=top_k, top_p=top_p, min_p=min_p,
@@ -1349,9 +1366,11 @@ class ContinuousBatcher(_BatcherBase):
                     f"max-length row ({self._nmax} blocks + null)"
                 )
             self._paged_model = _decode_clone(
-                model, paged_blocks=nblocks, kv_block=block)
+                model, paged_blocks=nblocks, kv_block=block,
+                kv_quant=self._kv_quant)
             raw = init_cache(model, batch_size, self._max_len,
-                             paged_blocks=nblocks, kv_block=block)
+                             paged_blocks=nblocks, kv_block=block,
+                             kv_quant=self._kv_quant)
             self._pool = _paged.BlockPool(nblocks, block)
             self._tables = np.zeros((batch_size, self._nmax), np.int32)
             self._row_blocks: list = [[] for _ in range(batch_size)]
@@ -1361,11 +1380,13 @@ class ContinuousBatcher(_BatcherBase):
             # seed the row templates below: prime() prefills on the
             # dense row layout
             raw_shapes = jax.eval_shape(functools.partial(
-                init_cache, model, batch_size, self._max_len))
+                init_cache, model, batch_size, self._max_len,
+                kv_quant=self._kv_quant))
         else:
             self._paged_model = None
             self._pool = None
-            raw = init_cache(model, batch_size, self._max_len)
+            raw = init_cache(model, batch_size, self._max_len,
+                             kv_quant=self._kv_quant)
             raw_shapes = raw
         # the decode scan's model: paged clone when on, dense otherwise
         self._scan_model = self._paged_model or self._decode_model
@@ -1386,7 +1407,7 @@ class ContinuousBatcher(_BatcherBase):
         self._row_shapes: dict = {}
         one = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-            init_cache(model, 1, self._max_len),
+            init_cache(model, 1, self._max_len, kv_quant=self._kv_quant),
         )
         rp = 1
         while True:
@@ -1584,7 +1605,8 @@ class ContinuousBatcher(_BatcherBase):
         if rp not in self._row_shapes:
             self._row_shapes[rp] = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-                init_cache(self._model, rp, self._max_len),
+                init_cache(self._model, rp, self._max_len,
+                           kv_quant=self._kv_quant),
             )
         self._dispatches += 1
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
@@ -1730,6 +1752,7 @@ class ContinuousBatcher(_BatcherBase):
         self._ledger = _capacity.PagedCapacityLedger(
             self._b, cells, _paged.pool_bytes(cache),
             self._pool.num_blocks, self._kv_block, self._paged_snapshot,
+            census=_capacity.kv_dtype_census(cache),
         )
         self._cap_model = _capacity.PagedCapacityModel(self._ledger)
 
@@ -1771,6 +1794,42 @@ class ContinuousBatcher(_BatcherBase):
         evictable = (self._prefix.evictable_blocks()
                      if self._prefix is not None else 0)
         return self._pool.available(evictable) >= need
+
+    def _on_capacity_stall(self) -> None:
+        """Admission stalled on the pool: spend the pause compacting.
+
+        Fixed-size blocks can never fragment *allocatability* (any free
+        block serves any request), so this is purely a locality pass —
+        it squeezes live ids toward the bottom of the pool so gathers
+        walk a dense span.  Safe exactly here because the stall breaks
+        out of wave COLLECTION, before _plan_paged_wave claims warm
+        blocks: the only id holders are _row_blocks, the trie nodes and
+        the host tables, and all three are rewritten below.  The device
+        block_table copies go stale, so _tables_dirty forces a
+        re-upload before any program runs."""
+        if not self._paged:
+            return
+        thr = knobs.env_float("TFDE_KV_DEFRAG_THRESHOLD")
+        if not thr or thr <= 0:
+            return
+        frag = self._pool.fragmentation()
+        if frag < thr:
+            return
+        plan = self._pool.defrag()
+        if not plan:
+            return
+        self._cache, self._tables = _paged.apply_defrag(
+            self._cache, self._tables, plan)
+        self._row_blocks = [[plan.get(int(b), int(b)) for b in row]
+                            for row in self._row_blocks]
+        if self._prefix is not None:
+            self._prefix.remap(plan)
+        self._tables_dirty = True
+        metrics.default_registry().counter("kv/pool_defrags").incr()
+        from tfde_tpu.observability import flightrec
+        flightrec.record("kv_defrag", moved=len(plan),
+                         frag=round(float(frag), 3),
+                         free=self._pool.free_blocks)
 
     def _admission_cells(self, kind: str, key, item) -> tuple:
         if not self._paged:

@@ -248,6 +248,21 @@ _register(
          "one static program covers every (prompt length, rows) shape "
          "(clamped to max_len at batcher construction).",
          "inference/paged.py, inference/server.py"),
+    Knob("TFDE_KV_QUANT", "choice", "fp", ("fp", "int8"),
+         "KV-cache storage format for every ContinuousBatcher: fp "
+         "(default, byte-identical full precision) or int8 — quantized "
+         "payload + per-(position, kv-head) fp32 scale sidecars in "
+         "every cache layout (dense slab, paged pool, prefix trie), "
+         "dequantized inside the attention program "
+         "(ops/quant.kv_quantize). ~2x KV headroom at bf16, ~3.8x at "
+         "fp32, same static program count.",
+         "models/transformer.py, inference/server.py"),
+    Knob("TFDE_KV_DEFRAG_THRESHOLD", "float", 0.5, (),
+         "Paged-pool fragmentation ratio (holes / occupied span of live "
+         "block ids) above which an admission stall triggers one bounded "
+         "defrag pass (pool compaction + device permute + table/trie "
+         "remap). 0 disables stall-triggered defrag.",
+         "inference/server.py, inference/paged.py"),
     Knob("TFDE_ADMIT_", "spec", None, (),
          "Serving admission-control family prefix (see members below); "
          "all caps default off, so admission control is opt-in.",

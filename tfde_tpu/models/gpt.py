@@ -59,6 +59,13 @@ class GPT(nn.Module):
     # the dense per-row slabs
     paged_blocks: Optional[int] = None
     kv_block: int = 16
+    # None (fp) | 'int8': quantized KV cache (transformer.MultiHeadAttention
+    # kv_quant, TFDE_KV_QUANT) — int8 payload + per-(position, kv-head)
+    # fp32 scale sidecars in every cache layout (dense slab / paged pool),
+    # dequantized inside the attention program. Orthogonal to `quant`
+    # (weights): either, both, or neither. Serving-only like the cache
+    # itself; set by _decode_clone(kv_quant=...).
+    kv_quant: Optional[str] = None
     ln_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5 (models/convert.py)
     # 'learned' = GPT-2 absolute wpe table; 'rope' = rotary q/k rotation
     # (ops/rotary.py) — no position table, relative-position attention,
@@ -230,6 +237,7 @@ class GPT(nn.Module):
             rolling_cache=self.rolling_cache,
             paged_blocks=self.paged_blocks,
             kv_block=self.kv_block,
+            kv_quant=self.kv_quant,
             attn_scale=self.attn_scale,
             attn_logit_cap=self.attn_logit_cap,
             norm=self.norm,

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from tfde_tpu.ops import attention as attn_lib
-from tfde_tpu.ops.quant import QuantDenseGeneral
+from tfde_tpu.ops.quant import QuantDenseGeneral, kv_dequantize, kv_quantize
 from tfde_tpu.ops.rotary import apply_rotary
 from tfde_tpu.parallel.axes import batch_axes, constrain
 
@@ -135,6 +135,18 @@ class MultiHeadAttention(nn.Module):
     # block. Mutually exclusive with rolling_cache.
     paged_blocks: Optional[int] = None
     kv_block: int = 16
+    # None (fp) | 'int8': quantized KV cache (TFDE_KV_QUANT). K/V are
+    # stored int8 with one fp32 scale per (position, kv-head) — sidecar
+    # cache vars "cached_key_scale"/"cached_value_scale" (dense) or
+    # "pool_key_scale"/"pool_value_scale" (paged, organized per kv_block
+    # like the payload so trie sharing/refcounts carry quantized blocks
+    # for free). Quantize-on-write, dequantize fused into the attention
+    # read (ops/quant.kv_quantize/kv_dequantize) — the wire format never
+    # leaves the device program, and the cache footprint drops ~4x at
+    # fp32 / ~2x at bf16 (minus the 4/head_dim scale overhead). Same
+    # static program count as fp. Mutually exclusive with rolling_cache
+    # (a rolling slot rewrites scales out of order with its payload).
+    kv_quant: Optional[str] = None
 
     @property
     def kv_heads(self) -> int:
@@ -269,6 +281,10 @@ class MultiHeadAttention(nn.Module):
         overwrite the last entries instead). inference/decode.generate sizes
         the cache to prompt + max_new_tokens exactly and can never overflow;
         direct drivers of this layer own the same invariant."""
+        if self.kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"kv_quant must be None or 'int8', got {self.kv_quant!r}"
+            )
         if self.paged_blocks is not None:
             if self.rolling_cache and self.window is not None:
                 raise NotImplementedError(
@@ -279,13 +295,31 @@ class MultiHeadAttention(nn.Module):
             return self._paged_attention(q, k, v, batch)
         is_filled = self.has_variable("cache", "cached_key")
         rolling = self.rolling_cache and self.window is not None
+        quant = self.kv_quant == "int8"
+        if quant and rolling:
+            raise NotImplementedError(
+                "kv_quant='int8' and rolling_cache are mutually exclusive: "
+                "the rolling slot rewrite (slot = position mod window) "
+                "would need a second modular scatter for the scale sidecar "
+                "on the decode hot path; pick one"
+            )
         cache_shape = list(k.shape)
         if rolling:
             cache_shape[1] = min(cache_shape[1], self.window)
         cached_key = self.variable("cache", "cached_key", jnp.zeros,
-                                   tuple(cache_shape), k.dtype)
+                                   tuple(cache_shape),
+                                   jnp.int8 if quant else k.dtype)
         cached_value = self.variable("cache", "cached_value", jnp.zeros,
-                                     tuple(cache_shape), v.dtype)
+                                     tuple(cache_shape),
+                                     jnp.int8 if quant else v.dtype)
+        if quant:
+            # fp32 scale per (row, position, kv-head) — zeros dequantize
+            # to exact 0.0, matching the fp cache's zero fill
+            scale_shape = tuple(cache_shape[:2]) + (k.shape[2],)
+            key_scale = self.variable("cache", "cached_key_scale",
+                                      jnp.zeros, scale_shape, jnp.float32)
+            value_scale = self.variable("cache", "cached_value_scale",
+                                        jnp.zeros, scale_shape, jnp.float32)
         cache_index = self.variable("cache", "cache_index",
                                     lambda: jnp.zeros((), jnp.int32))
 
@@ -310,17 +344,31 @@ class MultiHeadAttention(nn.Module):
             return self._rolling_attention(
                 q, k, v, batch, cached_key, cached_value, cache_index
             )
+        if quant:
+            # quantize-on-write: the int8 payload + fp32 per-(position,
+            # head) scale are what the scatter below stores; attention
+            # reads dequantize after the scatter so this call's own
+            # tokens round-trip through the wire format too (parity with
+            # what a later step would read back)
+            k_w, k_sc = kv_quantize(k)
+            v_w, v_sc = kv_quantize(v)
+        else:
+            k_w, v_w = (k.astype(cached_key.value.dtype),
+                        v.astype(cached_value.value.dtype))
         if idx.ndim == 0:
             # shared index (generate / batch-1 speculation): one cheap
             # dynamic_update_slice covers every row
             k_all = jax.lax.dynamic_update_slice(
-                cached_key.value, k.astype(cached_key.value.dtype),
-                (0, idx, 0, 0)
+                cached_key.value, k_w, (0, idx, 0, 0)
             )
             v_all = jax.lax.dynamic_update_slice(
-                cached_value.value, v.astype(cached_value.value.dtype),
-                (0, idx, 0, 0)
+                cached_value.value, v_w, (0, idx, 0, 0)
             )
+            if quant:
+                ks_all = jax.lax.dynamic_update_slice(
+                    key_scale.value, k_sc, (0, idx, 0))
+                vs_all = jax.lax.dynamic_update_slice(
+                    value_scale.value, v_sc, (0, idx, 0))
             # [1, 1, Sq, max_len]: query (position idx+i) sees kv j<=idx+i
             pos_q = idx + jnp.arange(sq, dtype=jnp.int32)
             cols = jnp.arange(max_len, dtype=jnp.int32)[None, :]
@@ -343,10 +391,16 @@ class MultiHeadAttention(nn.Module):
                     cache, new, (i, 0, 0)
                 )
             )
-            k_all = write(cached_key.value,
-                          k.astype(cached_key.value.dtype), idx)
-            v_all = write(cached_value.value,
-                          v.astype(cached_value.value.dtype), idx)
+            k_all = write(cached_key.value, k_w, idx)
+            v_all = write(cached_value.value, v_w, idx)
+            if quant:
+                swrite = jax.vmap(
+                    lambda cache, new, i: jax.lax.dynamic_update_slice(
+                        cache, new, (i, 0)
+                    )
+                )
+                ks_all = swrite(key_scale.value, k_sc, idx)
+                vs_all = swrite(value_scale.value, v_sc, idx)
             # [B, 1, Sq, max_len]: row b's query i sits at idx[b]+i
             pos_w = idx[:, None] + jnp.arange(sq, dtype=jnp.int32)  # [B,sq]
             colsb = jnp.arange(max_len, dtype=jnp.int32)[None, None, :]
@@ -358,6 +412,14 @@ class MultiHeadAttention(nn.Module):
             valid = valid[:, None]
         cached_key.value = constrain(k_all, batch, None, "tensor")
         cached_value.value = constrain(v_all, batch, None, "tensor")
+        if quant:
+            key_scale.value = constrain(ks_all, batch, None, "tensor")
+            value_scale.value = constrain(vs_all, batch, None, "tensor")
+            # dequant fused into the attention read: elementwise
+            # int8 * fp32-scale feeding the einsum, so the fp copy lives
+            # only inside this program — HBM holds int8 + scales
+            k_all = kv_dequantize(k_all, ks_all, k.dtype)
+            v_all = kv_dequantize(v_all, vs_all, v.dtype)
         cache_index.value = idx + sq
         # grouped_attention == reference_attention at kv_heads == num_heads;
         # with GQA the kv_heads-shaped cache feeds the einsum directly (no
@@ -394,11 +456,23 @@ class MultiHeadAttention(nn.Module):
         is_filled = self.has_variable("cache", "pool_key")
         block = self.kv_block
         bsz = k.shape[0]
+        quant = self.kv_quant == "int8"
         pool_shape = (self.paged_blocks, block, k.shape[2], k.shape[3])
         pool_key = self.variable("cache", "pool_key", jnp.zeros,
-                                 pool_shape, k.dtype)
+                                 pool_shape,
+                                 jnp.int8 if quant else k.dtype)
         pool_value = self.variable("cache", "pool_value", jnp.zeros,
-                                   pool_shape, v.dtype)
+                                   pool_shape,
+                                   jnp.int8 if quant else v.dtype)
+        if quant:
+            # fp32 scale sidecar per pool block: [nblocks, block, Kv] rides
+            # the same block ids as the payload, so trie sharing, refcounts
+            # and defrag permutation carry the scales for free
+            key_scale = self.variable("cache", "pool_key_scale", jnp.zeros,
+                                      pool_shape[:3], jnp.float32)
+            value_scale = self.variable("cache", "pool_value_scale",
+                                        jnp.zeros, pool_shape[:3],
+                                        jnp.float32)
         # nmax from the init call's [B, max_len] budget input; +1 because
         # the decode scan writes one-past-committed for finished rows
         block_table = self.variable(
@@ -436,13 +510,30 @@ class MultiHeadAttention(nn.Module):
         # still poisons the output through 0 * NaN. nan_to_num is identity
         # on every finite (legit) value, so bit-exactness is untouched;
         # it only guarantees the POOL itself never holds a non-finite cell
-        k_pool = pool_key.value.at[blk, off].set(
-            jnp.nan_to_num(k.astype(pool_key.value.dtype)))
-        v_pool = pool_value.value.at[blk, off].set(
-            jnp.nan_to_num(v.astype(pool_value.value.dtype)))
-        # gather the row's table into position order: [B, nmax*block, Kv, D]
-        k_all = k_pool[table].reshape(bsz, nmax * block, *k.shape[2:])
-        v_all = v_pool[table].reshape(bsz, nmax * block, *v.shape[2:])
+        if quant:
+            # kv_quantize nan_to_nums internally — same sanitize guarantee
+            # as the fp write below, plus the scale itself stays finite
+            k_w, k_sc = kv_quantize(k)
+            v_w, v_sc = kv_quantize(v)
+            k_pool = pool_key.value.at[blk, off].set(k_w)
+            v_pool = pool_value.value.at[blk, off].set(v_w)
+            ks_pool = key_scale.value.at[blk, off].set(k_sc)
+            vs_pool = value_scale.value.at[blk, off].set(v_sc)
+            # gather payload + scales through the same table, dequant
+            # fused into the attention read: [B, nmax*block, Kv, D]
+            k_all = kv_dequantize(k_pool[table], ks_pool[table], k.dtype
+                                  ).reshape(bsz, nmax * block, *k.shape[2:])
+            v_all = kv_dequantize(v_pool[table], vs_pool[table], v.dtype
+                                  ).reshape(bsz, nmax * block, *v.shape[2:])
+        else:
+            k_pool = pool_key.value.at[blk, off].set(
+                jnp.nan_to_num(k.astype(pool_key.value.dtype)))
+            v_pool = pool_value.value.at[blk, off].set(
+                jnp.nan_to_num(v.astype(pool_value.value.dtype)))
+            # gather the row's table into position order:
+            # [B, nmax*block, Kv, D]
+            k_all = k_pool[table].reshape(bsz, nmax * block, *k.shape[2:])
+            v_all = v_pool[table].reshape(bsz, nmax * block, *v.shape[2:])
         cols = jnp.arange(nmax * block, dtype=jnp.int32)[None, None, :]
         valid = cols <= pos[:, :, None]  # [B, sq, nmax*block]
         if self.window is not None:
@@ -451,6 +542,9 @@ class MultiHeadAttention(nn.Module):
         valid = valid[:, None]
         pool_key.value = constrain(k_pool, None, None, "tensor")
         pool_value.value = constrain(v_pool, None, None, "tensor")
+        if quant:
+            key_scale.value = constrain(ks_pool, None, None, "tensor")
+            value_scale.value = constrain(vs_pool, None, None, "tensor")
         cache_index.value = idx + sq
         return attn_lib.grouped_attention(
             q, k_all, v_all, mask=valid, scale=self.attn_scale,
@@ -624,6 +718,7 @@ class TransformerBlock(nn.Module):
     rolling_cache: bool = False  # window-bounded decode cache (MHA)
     paged_blocks: Optional[int] = None  # paged KV pool (MultiHeadAttention)
     kv_block: int = 16  # paged pool block size in tokens (TFDE_KV_BLOCK)
+    kv_quant: Optional[str] = None  # int8 KV cache (MHA, TFDE_KV_QUANT)
     attn_scale: Optional[float] = None    # Gemma-2 (MultiHeadAttention)
     attn_logit_cap: Optional[float] = None
     norm_style: str = "pre"
@@ -674,6 +769,7 @@ class TransformerBlock(nn.Module):
             rolling_cache=self.rolling_cache,
             paged_blocks=self.paged_blocks,
             kv_block=self.kv_block,
+            kv_quant=self.kv_quant,
             attn_scale=self.attn_scale,
             attn_logit_cap=self.attn_logit_cap,
             use_bias=self.use_bias,
@@ -806,6 +902,7 @@ class Encoder(nn.Module):
     rolling_cache: bool = False
     paged_blocks: Optional[int] = None
     kv_block: int = 16
+    kv_quant: Optional[str] = None
     attn_scale: Optional[float] = None
     attn_logit_cap: Optional[float] = None
     norm_style: str = "pre"
@@ -877,6 +974,7 @@ class Encoder(nn.Module):
                 rolling_cache=self.rolling_cache,
                 paged_blocks=self.paged_blocks,
                 kv_block=self.kv_block,
+                kv_quant=self.kv_quant,
                 attn_scale=self.attn_scale,
                 attn_logit_cap=self.attn_logit_cap,
                 norm_style=self.norm_style,

@@ -81,6 +81,41 @@ def kv_slab_bytes(cache) -> int:
     return total
 
 
+def kv_dtype_census(cache) -> dict:
+    """Dtype split of a KV cache tree (index leaves and block tables
+    excluded): payload vs scale-sidecar bytes, the payload leaf dtype,
+    and the fp32-equivalent payload cost — what the same cells would
+    occupy unquantized at fp32 (the quantized-vs-fp delta obs_dump and
+    the bench report; for a bf16 model halve it mentally). Scale leaves
+    are the ``*_scale`` sidecars the int8 KV cache rides
+    (models/transformer.py); an fp cache has none, so its split is all
+    payload and ``kv_dtype`` names the storage float type."""
+    import jax
+
+    payload = scale = payload_elems = 0
+    dtype = None
+    for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+        if _is_index_path(path):
+            continue
+        name = str(getattr(path[-1], "key", path[-1]))
+        if name == "block_table":
+            continue
+        if name.endswith("_scale"):
+            scale += int(leaf.nbytes)
+        else:
+            payload += int(leaf.nbytes)
+            payload_elems += int(leaf.size)
+            dtype = str(leaf.dtype)
+            bits = int(leaf.dtype.itemsize) * 8
+    return {
+        "kv_dtype": dtype or "none",
+        "kv_quant_bits": bits if dtype else 0,
+        "kv_payload_bytes": payload,
+        "kv_scale_bytes": scale,
+        "kv_fp32_equiv_bytes": payload_elems * 4,
+    }
+
+
 class CapacityLedger:
     """Dense-slab KV occupancy and pad-ladder waste accounting.
 
@@ -93,7 +128,8 @@ class CapacityLedger:
 
     def __init__(self, batch_size: int, cells_per_row: int,
                  slab_bytes: int,
-                 registry: Optional[metrics.Registry] = None):
+                 registry: Optional[metrics.Registry] = None,
+                 census: Optional[dict] = None):
         if batch_size < 1 or cells_per_row < 1:
             raise ValueError(
                 f"need batch_size/cells_per_row >= 1, got "
@@ -103,6 +139,9 @@ class CapacityLedger:
         self._b = int(batch_size)
         self._cells = int(cells_per_row)
         self._slab_bytes = int(slab_bytes)
+        #: dtype split of the slab (kv_dtype_census) — prices the
+        #: quantized-vs-fp delta; empty when the builder predates it
+        self._census = dict(census or {})
         #: measured per-cell cost: the slab's own bytes over its cells,
         #: so used_bytes sums exactly to the slab when every row is full
         self._cell_bytes = self._slab_bytes / float(self._b * self._cells)
@@ -120,7 +159,7 @@ class CapacityLedger:
                    ) -> "CapacityLedger":
         """Build a ledger from a freshly-initialized dense slab."""
         return cls(batch_size, cells_per_row, kv_slab_bytes(cache),
-                   registry=registry)
+                   registry=registry, census=kv_dtype_census(cache))
 
     # -- read surface --------------------------------------------------------
     @property
@@ -139,6 +178,26 @@ class CapacityLedger:
     @property
     def cells_per_row(self) -> int:
         return self._cells
+
+    @property
+    def census(self) -> dict:
+        """The slab/pool dtype split (kv_dtype_census); {} when unknown."""
+        return dict(self._census)
+
+    def _publish_census(self) -> dict:
+        """Gauge + stats-dict surface of the dtype split: obs_dump's
+        --capacity quantized-vs-fp columns read these (the dtype string
+        itself rides the /load kv dict; kv/quant_bits is its numeric
+        twin for metrics-snapshot readers)."""
+        if not self._census:
+            return {}
+        g = self._reg.gauge
+        g("kv/quant_bits").set(self._census.get("kv_quant_bits", 0))
+        g("kv/payload_bytes").set(self._census.get("kv_payload_bytes", 0))
+        g("kv/scale_bytes").set(self._census.get("kv_scale_bytes", 0))
+        g("kv/fp32_equiv_bytes").set(
+            self._census.get("kv_fp32_equiv_bytes", 0))
+        return dict(self._census)
 
     # -- the per-round report ------------------------------------------------
     def observe(self, committed, req) -> dict:
@@ -162,7 +221,7 @@ class CapacityLedger:
         g("kv/waste_frac").set(waste)
         g("kv/rows_active").set(active)
         g("kv/rows_free").set(self._b - active)
-        return {
+        out = {
             "allocated_bytes": self._slab_bytes,
             "used_bytes": used_bytes,
             "used_cells": used,
@@ -170,6 +229,8 @@ class CapacityLedger:
             "rows_active": active,
             "rows_free": self._b - active,
         }
+        out.update(self._publish_census())
+        return out
 
     # -- the per-wave report -------------------------------------------------
     def note_admission(self, kind: str, bucket: int, used_tokens: int
@@ -242,9 +303,10 @@ class PagedCapacityLedger(CapacityLedger):
     def __init__(self, batch_size: int, cells_per_row: int,
                  pool_bytes: int, num_blocks: int, block: int,
                  snapshot,
-                 registry: Optional[metrics.Registry] = None):
+                 registry: Optional[metrics.Registry] = None,
+                 census: Optional[dict] = None):
         super().__init__(batch_size, cells_per_row, pool_bytes,
-                         registry=registry)
+                         registry=registry, census=census)
         if num_blocks < 2 or block < 1:
             raise ValueError(
                 f"need num_blocks >= 2 and block >= 1, got "
@@ -301,7 +363,7 @@ class PagedCapacityLedger(CapacityLedger):
         g("kv/pool_blocks_free").set(free)
         g("kv/pool_blocks_active").set(held - trie_blocks)
         g("kv/pool_blocks_trie").set(trie_blocks)
-        return {
+        out = {
             "allocated_bytes": allocated,
             "used_bytes": used_bytes,
             "used_cells": used_cells,
@@ -313,6 +375,8 @@ class PagedCapacityLedger(CapacityLedger):
             "pool_blocks_active": held - trie_blocks,
             "pool_blocks_trie": trie_blocks,
         }
+        out.update(self._publish_census())
+        return out
 
 
 class CapacityModel:

@@ -112,6 +112,39 @@ def int8_dot_general(
     return (y.astype(jnp.float32) * sx * kernel_scale).astype(dtype)
 
 
+def kv_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 KV-cache quantization: one fp32 scale per
+    (position, kv-head) — absmax over the trailing head_dim vector, the
+    finest grain that still writes one scale cell per cached token (a
+    coarser per-block scale would put a read-modify-rescale of the whole
+    block on the single-token decode hot path). Returns
+    (q int8 [x.shape], scale fp32 [x.shape[:-1]]).
+
+    Non-finite inputs are zeroed before the absmax: junk positions (a
+    rider row pad-fed past its committed count) can carry NaN/inf
+    activations, and one inf in a head vector would blow that vector's
+    scale while a NaN would poison the masked-attention output through
+    0 * NaN. Infinities map to 0 rather than nan_to_num's default
+    float32-max — max/127 rounds UP, and 127x the rounded-up scale
+    overflows straight back to inf on dequant. Zeroing is identity on
+    every finite (legit) value, so real tokens quantize bit-identically
+    with or without it."""
+    xf = jnp.nan_to_num(x.astype(jnp.float32), posinf=0.0, neginf=0.0)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize(q: jax.Array, scale: jax.Array,
+                  dtype: jnp.dtype) -> jax.Array:
+    """Inverse of `kv_quantize`: `q * scale` broadcast over the trailing
+    head_dim axis, cast to the attention compute dtype. Elementwise, so
+    XLA fuses it into the attention einsum's operand read — the int8
+    wire format never leaves the device program."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 class QuantDenseGeneral(nn.Module):
     """Serving twin of `nn.DenseGeneral` over int8 weights.
 

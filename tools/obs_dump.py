@@ -35,8 +35,11 @@ surfaces, composable in one invocation:
   occupancy, pad-ladder waste, and headroom from the capacity ledger,
   the block-pool split (free / active / trie blocks with the
   evictable-on-demand callout) when a replica runs paged KV
-  (``TFDE_PAGED_KV``, WORKFLOWS.md §22), the top-waste-bucket callout
-  (the cells paged-KV reclaims), and per-host
+  (``TFDE_PAGED_KV``, WORKFLOWS.md §22), the KV dtype census
+  (quantized-vs-fp byte split — int8 payload, fp32 scale sidecars,
+  fp32-equivalent — with the headroom callout priced in the active
+  dtype; ``TFDE_KV_QUANT``, WORKFLOWS.md §23), the top-waste-bucket
+  callout (the cells paged-KV reclaims), and per-host
   ``metrics/usage_*.jsonl`` summaries.
 - ``python tools/obs_dump.py --boot [--router URL | <model_dir>]`` —
   the cold-start view (WORKFLOWS.md §21): per-replica boot waterfall
@@ -410,6 +413,49 @@ def _pool_section(per_host: dict) -> None:
               f"{free + trie} blocks")
 
 
+_DTYPE_HEADER = (f"  {'host':>7} {'dtype':>7} {'bits':>5} "
+                 f"{'payload_mb':>11} {'scale_mb':>9} {'fp32_mb':>8} "
+                 f"{'saving':>7}")
+
+
+def _dtype_section(per_host: dict) -> None:
+    """KV dtype census (ops/quant.py int8 path): per replica the
+    quantized-vs-fp byte split — int8 payload cells vs their fp32
+    scale sidecars — against what the same cells cost at fp32.
+    headroom_rows in the table above is already priced in the active
+    dtype (the capacity models charge payload+scale per cell), so
+    `saving` is the admission-headroom multiplier TFDE_KV_QUANT=int8
+    buys at a fixed byte budget."""
+    rows = {h: kv for h, kv in per_host.items()
+            if kv.get("kv_payload_bytes")}
+    if not rows:
+        return
+    print("  -- kv dtype census --")
+    print(_DTYPE_HEADER)
+    quantized = []
+    for hid in sorted(rows):
+        kv = rows[hid]
+        bits = int(kv.get("kv_quant_bits") or 0)
+        dtype = kv.get("kv_dtype") or (
+            "int8" if bits == 8 else (f"fp{bits}" if bits else "?"))
+        pay = float(kv.get("kv_payload_bytes") or 0)
+        sc = float(kv.get("kv_scale_bytes") or 0)
+        fp = float(kv.get("kv_fp32_equiv_bytes") or 0)
+        saving = fp / (pay + sc) if (pay + sc) else 0.0
+        print(f"  {str(hid):>7} {dtype:>7} {bits:>5} {pay / 1e6:>11.1f} "
+              f"{sc / 1e6:>9.1f} {fp / 1e6:>8.1f} "
+              f"{f'{saving:.2f}x':>7}")
+        if bits and bits < 32 and saving > 1.0:
+            quantized.append((hid, dtype, saving, kv.get("headroom_rows")))
+    for hid, dtype, saving, hd in quantized:
+        if hd is None:
+            continue
+        print(f"  {hid}: headroom is priced at {dtype} cells + fp32 "
+              f"scales ({int(hd)} rows); the same byte budget at fp32 "
+              f"holds ~{int(int(hd) / saving)} rows "
+              f"({saving:.2f}x from TFDE_KV_QUANT)")
+
+
 def dump_capacity(model_dir=None, router_url=None) -> int:
     """``--capacity``: the KV occupancy / pad-waste / headroom view —
     per replica from a LIVE router's /replicas kv table, or from the
@@ -429,6 +475,7 @@ def dump_capacity(model_dir=None, router_url=None) -> int:
         for hid in sorted(kv):
             print(_capacity_row(hid, kv[hid]))
         _pool_section(kv)
+        _dtype_section(kv)
         per_bucket = {
             str(h["top_waste_bucket"]): h.get("top_waste_bucket_tokens", 0)
             for h in kv.values() if h.get("top_waste_bucket") is not None
@@ -444,6 +491,7 @@ def dump_capacity(model_dir=None, router_url=None) -> int:
     print(_CAPACITY_HEADER)
     per_bucket: dict = collections.Counter()
     pool_hosts: dict = {}
+    census_hosts: dict = {}
     for p in logs:
         rows = _load_jsonl(p)
         if not rows:
@@ -473,6 +521,14 @@ def dump_capacity(model_dir=None, router_url=None) -> int:
                 "pool_blocks_trie": flat.get("kv/pool_blocks_trie"),
                 "waste_frac": flat.get("kv/waste_frac"),
             }
+        if flat.get("kv/payload_bytes"):
+            census_hosts[host] = {
+                "kv_quant_bits": flat.get("kv/quant_bits"),
+                "kv_payload_bytes": flat.get("kv/payload_bytes"),
+                "kv_scale_bytes": flat.get("kv/scale_bytes"),
+                "kv_fp32_equiv_bytes": flat.get("kv/fp32_equiv_bytes"),
+                "headroom_rows": flat.get("kv/headroom_rows"),
+            }
         pre = "kv/pad_waste_tokens/bucket_"
         for name, v in flat.items():
             if name.startswith(pre):
@@ -482,6 +538,7 @@ def dump_capacity(model_dir=None, router_url=None) -> int:
               f"{model_dir}/metrics — serving run without the ledger?)")
     else:
         _pool_section(pool_hosts)
+        _dtype_section(census_hosts)
         _capacity_callout(dict(per_bucket))
 
     usage = sorted(glob.glob(

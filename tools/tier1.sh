@@ -97,6 +97,22 @@
 #                                   TFDE_KV_BLOCK forwards the same way
 #                                   and must match the prefix trie's
 #                                   chunk size.)
+#        TFDE_KV_QUANT=int8 tools/tier1.sh
+#                                  (re-run with the int8 quantized KV
+#                                   cache enabled by default on every
+#                                   ContinuousBatcher — ops/quant.py +
+#                                   inference/decode.py; blockwise int8
+#                                   payload + fp32 scale sidecars,
+#                                   dequantized inside the fused
+#                                   attention tick. Greedy parity is
+#                                   statistical (>=0.98), not
+#                                   bit-exact, so the parity-pinning
+#                                   tests request 'fp' explicitly.
+#                                   TFDE_KV_DEFRAG_THRESHOLD forwards
+#                                   the same way: pool fragmentation
+#                                   fraction above which an admission
+#                                   stall triggers a compaction pass
+#                                   (default 0.5; 0 = off).)
 #        TFDE_BOOT_READY_REQUIRE=off tools/tier1.sh
 #                                  (re-run with the router's readiness
 #                                   gate disabled — traffic places on
@@ -137,6 +153,8 @@ timeout -k 10 1800 env JAX_PLATFORMS=cpu \
     TFDE_USAGE_LOG="${TFDE_USAGE_LOG:-off}" \
     TFDE_CAPACITY_BUDGET_BYTES="${TFDE_CAPACITY_BUDGET_BYTES:-0}" \
     TFDE_PAGED_KV="${TFDE_PAGED_KV:-off}" \
+    TFDE_KV_QUANT="${TFDE_KV_QUANT:-fp}" \
+    TFDE_KV_DEFRAG_THRESHOLD="${TFDE_KV_DEFRAG_THRESHOLD:-0.5}" \
     TFDE_BOOT_READY_REQUIRE="${TFDE_BOOT_READY_REQUIRE:-on}" \
     TFDE_BOOT_READY_GRACE_S="${TFDE_BOOT_READY_GRACE_S:-120}" \
     python -m pytest tests/ -q -m 'not slow' \
